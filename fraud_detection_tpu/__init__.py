@@ -1,0 +1,27 @@
+"""fraud_detection_tpu — a TPU-native real-time fraud (phone-scam) detection framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of
+``wangwang2111/fraud-detection-spark-kafka-llm``: TF-IDF text featurization
+(Tokenizer -> StopWordsRemover -> HashingTF/CountVectorizer -> IDF), classical
+classifiers (logistic regression, decision tree, random forest, gradient-boosted
+trees), Kafka micro-batch streaming inference, evaluation/interpretability, and a
+pluggable LLM explanation layer — with the compute path on TPU via jit/pjit over a
+``jax.sharding.Mesh`` instead of Spark executors.
+
+Layer map (mirrors SURVEY.md §7):
+  featurize/   host text prep + device TF-IDF ops (the serve-time contract)
+  checkpoint/  Spark PipelineModel artifact reader + native checkpoint format
+  models/      scorers and trainers (linear, trees, boosting)
+  ops/         Pallas/XLA kernels (histograms, tree traversal, scatter TF)
+  parallel/    mesh construction, sharding helpers, collectives
+  stream/      Kafka micro-batching engine + in-process broker for tests
+  eval/        metrics (accuracy/P/R/F1/AUC), confusion matrices, plots
+  explain/     LLM explanation backends (OpenAI-compatible HTTP, on-pod JAX)
+  app/         Streamlit UI + CLI entry points
+  utils/       config, logging, profiling
+"""
+
+__version__ = "0.1.0"
+
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer  # noqa: F401
+from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline  # noqa: F401

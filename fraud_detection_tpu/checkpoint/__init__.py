@@ -1,0 +1,6 @@
+from fraud_detection_tpu.checkpoint.spark_artifact import (
+    SparkPipelineArtifact,
+    load_spark_pipeline,
+)
+
+__all__ = ["SparkPipelineArtifact", "load_spark_pipeline"]

@@ -1,0 +1,80 @@
+"""Host-side text preparation with Spark-parity semantics.
+
+This is the "contract layer": its behavior must match, token for token, what the
+reference's serving path does before features hit the classifier, because any
+drift silently shifts F1 against the shipped model artifact.
+
+Reference behavior being replicated (cited for the parity audit):
+  * clean_text: lowercase then strip every char not in ``[a-z ]`` — both the
+    train path (/root/reference/fraud_detection_spark.py:44) and the serve
+    path (/root/reference/utils/agent_api.py:144) apply ``lower`` +
+    ``regexp_replace('[^a-zA-Z ]', '')`` (space only, identical regexes).
+  * tokenize: Spark ``ml.feature.Tokenizer`` semantics — ``toLowerCase`` then
+    Java ``String.split("\\s")``: split on *single* whitespace chars, interior
+    and leading empty tokens are KEPT, trailing empty tokens are dropped
+    (Java split drops trailing empties). The shipped pipeline's stage 0 is a
+    plain Tokenizer (dialogue_classification_model/stages/0_Tokenizer_*).
+  * stop word removal: Spark ``StopWordsRemover`` with the default English
+    181-word list (serialized in stages/1_StopWordsRemover_*/metadata),
+    caseSensitive=false, locale=en.
+"""
+
+from __future__ import annotations
+
+import re
+from importlib import resources
+from typing import FrozenSet, List, Sequence
+
+# The reference's cleaning regex on already-lowercased text: both train and
+# serve remove [^a-zA-Z ] (tabs/newlines included — space is the only
+# whitespace that survives).
+_NON_ALPHA_SPACE = re.compile(r"[^a-z ]")
+_WS_SPLIT = re.compile(r"\s")
+
+
+def clean_text(text: str) -> str:
+    """Lowercase and strip every char not in ``[a-z ]`` (Spark-reference style)."""
+    return _NON_ALPHA_SPACE.sub("", text.lower())
+
+
+def tokenize(text: str) -> List[str]:
+    """Spark ``Tokenizer`` semantics: lowercase + Java ``split("\\s")``.
+
+    Java's split keeps interior/leading empty strings but drops trailing ones,
+    EXCEPT that splitting the empty string returns [""] (no match -> Java
+    returns the input itself). The empty token then flows through
+    StopWordsRemover (kept) and HashingTF (hashed into a real bucket), so this
+    degenerate case matters for parity on all-non-alphabetic inputs.
+    """
+    if text == "":
+        return [""]
+    parts = _WS_SPLIT.split(text.lower())
+    # Java String.split drops trailing empty strings.
+    while parts and parts[-1] == "":
+        parts.pop()
+    return parts
+
+
+def load_default_stopwords() -> List[str]:
+    """The 181-word default English stop list used by Spark's StopWordsRemover.
+
+    Stored as package data (extracted from the shipped artifact's
+    stages/1_StopWordsRemover_*/metadata defaultParamMap, which serializes
+    Spark's public default list verbatim).
+    """
+    data = resources.files("fraud_detection_tpu.data").joinpath("english_stopwords.txt").read_text()
+    return [w for w in data.splitlines() if w]
+
+
+class StopWordFilter:
+    """Spark ``StopWordsRemover`` with caseSensitive=false semantics."""
+
+    def __init__(self, stopwords: Sequence[str] | None = None, case_sensitive: bool = False):
+        words = list(stopwords) if stopwords is not None else load_default_stopwords()
+        self.case_sensitive = case_sensitive
+        self._set: FrozenSet[str] = frozenset(words if case_sensitive else [w.lower() for w in words])
+
+    def __call__(self, tokens: Sequence[str]) -> List[str]:
+        if self.case_sensitive:
+            return [t for t in tokens if t not in self._set]
+        return [t for t in tokens if t.lower() not in self._set]

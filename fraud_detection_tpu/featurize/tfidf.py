@@ -1,0 +1,144 @@
+"""TF-IDF featurization: host encoding to padded sparse batches + device ops.
+
+TPU-first design: instead of materializing Spark-style per-row sparse vectors
+(reference: HashingTF -> IDFModel stages, dialogue_classification_model/stages/{2,3}),
+the host emits fixed-shape padded (bucket_ids, counts) batches and the device
+turns them into whatever the consumer needs under one jit:
+
+  * ``tfidf_dense``       — scatter-add into a dense (B, F) TF-IDF matrix
+                            (feeds tree traversal / training).
+  * linear scoring        — never materializes features at all; the logistic
+                            scorer gathers ``idf*w`` per token and segment-sums
+                            (see models/linear.py). This is the serve-time fast
+                            path that replaces the reference's per-row Spark job
+                            (utils/agent_api.py:139-158, SURVEY Q7).
+
+Shapes are padded to power-of-two token lengths and caller-fixed batch sizes so
+XLA compiles a handful of programs total, then reuses them forever.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu.featurize.hashing import HashingTF
+from fraud_detection_tpu.featurize.text import StopWordFilter, clean_text, tokenize
+
+
+class EncodedBatch(NamedTuple):
+    """Fixed-shape sparse batch: per-row hashed-bucket ids and term counts.
+
+    ``ids`` is (B, L) int32, ``counts`` is (B, L) float32; padding has count 0
+    (its bucket id is 0 — harmless because every consumer weights by count).
+    """
+
+    ids: jax.Array
+    counts: jax.Array
+
+    @property
+    def batch_size(self) -> int:
+        return self.ids.shape[0]
+
+
+def _pad_len(n: int, minimum: int = 16) -> int:
+    return max(minimum, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+def tfidf_dense(ids: jax.Array, counts: jax.Array, idf: jax.Array) -> jax.Array:
+    """Scatter padded sparse rows into a dense (B, F) TF-IDF matrix.
+
+    Equivalent of Spark's HashingTF + IDFModel.transform output ("features"
+    column). One XLA scatter-add; fuses with downstream consumers under jit.
+    """
+    num_features = idf.shape[0]
+    batch = ids.shape[0]
+    dense = jnp.zeros((batch, num_features), counts.dtype)
+    rows = jnp.arange(batch, dtype=ids.dtype)[:, None]
+    dense = dense.at[rows, ids].add(counts)
+    return dense * idf[None, :]
+
+
+def idf_from_doc_freq(doc_freq: np.ndarray, num_docs: int) -> np.ndarray:
+    """Spark IDF formula: ln((numDocs + 1) / (docFreq + 1))."""
+    return np.log((num_docs + 1.0) / (doc_freq.astype(np.float64) + 1.0))
+
+
+@dataclass
+class HashingTfIdfFeaturizer:
+    """End-to-end Tokenizer -> StopWordsRemover -> HashingTF -> IDF featurizer.
+
+    Host side replicates the reference pipeline's text semantics exactly
+    (see featurize/text.py and featurize/hashing.py docstrings for the parity
+    contract); device side is jit-compiled scatter + scale.
+    """
+
+    num_features: int = 10000
+    idf: Optional[np.ndarray] = None  # None => raw TF (identity IDF)
+    binary_tf: bool = False
+    stop_filter: StopWordFilter = field(default_factory=StopWordFilter)
+    remove_stopwords: bool = True
+
+    def __post_init__(self):
+        self._hashing = HashingTF(self.num_features, binary=self.binary_tf)
+        if self.idf is not None:
+            self.idf = np.asarray(self.idf, np.float32)
+            if self.idf.shape != (self.num_features,):
+                raise ValueError(
+                    f"idf shape {self.idf.shape} != ({self.num_features},)")
+
+    # ---------------- host side ----------------
+
+    def tokens(self, text: str) -> List[str]:
+        toks = tokenize(clean_text(text))
+        if self.remove_stopwords:
+            toks = self.stop_filter(toks)
+        return toks
+
+    def sparse_row(self, text: str) -> Tuple[np.ndarray, np.ndarray]:
+        return self._hashing.transform_arrays(self.tokens(text))
+
+    def encode(self, texts: Sequence[str], batch_size: Optional[int] = None,
+               max_tokens: Optional[int] = None) -> EncodedBatch:
+        """Encode texts into a fixed-shape padded EncodedBatch (numpy, host).
+
+        batch_size pads/truncates the row count; max_tokens fixes L (defaults
+        to the padded max unique-bucket count in this batch). Rows beyond
+        len(texts) are all-padding.
+        """
+        rows = [self.sparse_row(t) for t in texts]
+        b = batch_size if batch_size is not None else len(rows)
+        if len(rows) > b:
+            raise ValueError(f"{len(rows)} texts > batch_size {b}")
+        width = max((len(i) for i, _ in rows), default=1)
+        length = max_tokens if max_tokens is not None else _pad_len(width)
+        ids = np.zeros((b, length), np.int32)
+        counts = np.zeros((b, length), np.float32)
+        for r, (idx, val) in enumerate(rows):
+            if len(idx) > length:  # extremely long transcript: keep top-count buckets
+                keep = np.argsort(-val)[:length]
+                keep.sort()
+                idx, val = idx[keep], val[keep]
+            ids[r, : len(idx)] = idx
+            counts[r, : len(val)] = val
+        return EncodedBatch(ids=ids, counts=counts)
+
+    # ---------------- device side ----------------
+
+    def idf_array(self) -> jnp.ndarray:
+        if self.idf is None:
+            return jnp.ones((self.num_features,), jnp.float32)
+        return jnp.asarray(self.idf)
+
+    def featurize_dense(self, texts: Sequence[str], batch_size: Optional[int] = None) -> jax.Array:
+        """Texts -> dense (B, F) TF-IDF device matrix (pads B to batch_size)."""
+        enc = self.encode(texts, batch_size=batch_size)
+        return _tfidf_dense_jit(jnp.asarray(enc.ids), jnp.asarray(enc.counts), self.idf_array())
+
+
+_tfidf_dense_jit = jax.jit(tfidf_dense)

@@ -1,0 +1,4 @@
+from fraud_detection_tpu.models.linear import LogisticRegression
+from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+__all__ = ["LogisticRegression", "ServingPipeline"]

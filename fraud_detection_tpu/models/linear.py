@@ -1,0 +1,97 @@
+"""Logistic-regression scorer, TPU-native.
+
+Replaces Spark's ``LogisticRegressionModel.transform`` (the final stage of the
+shipped serving pipeline, dialogue_classification_model/stages/4_LogisticRegression_*)
+with two jitted paths:
+
+  * dense:  margin = X @ w + b over a (B, F) TF-IDF matrix — one MXU matvec.
+  * sparse fused: for hashed-TF rows the margin is a gather + segment-sum over
+    the padded EncodedBatch — features are never materialized. ``idf * w`` is
+    folded into one effective weight vector at model-build time, so serve-time
+    work per token is a single gather-accumulate. This is the fast path that
+    replaces the reference's per-row 5-stage Spark job (utils/agent_api.py:139-158).
+
+Spark semantics replicated: rawPrediction = [-m, m], probability = sigmoid(m),
+prediction = 1 iff p > threshold (threshold 0.5 in the shipped artifact).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu.featurize.tfidf import EncodedBatch
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class LogisticRegression:
+    """Binary logistic regression parameters as a jax pytree.
+
+    ``weights`` are in *feature* space (post-IDF). For fused sparse scoring over
+    hashed term counts, use ``effective_weights = idf * weights`` (precomputed
+    via ``fold_idf``).
+    """
+
+    weights: jax.Array            # (F,) float32
+    intercept: jax.Array          # () float32
+    threshold: float = 0.5
+
+    @classmethod
+    def from_arrays(cls, weights, intercept, threshold: float = 0.5) -> "LogisticRegression":
+        return cls(
+            weights=jnp.asarray(np.asarray(weights, np.float32)),
+            intercept=jnp.asarray(np.float32(intercept)),
+            threshold=float(threshold),
+        )
+
+    def fold_idf(self, idf) -> "LogisticRegression":
+        """Fold an IDF vector into the weights (for raw term-count inputs)."""
+        return LogisticRegression(
+            weights=self.weights * jnp.asarray(idf, self.weights.dtype),
+            intercept=self.intercept,
+            threshold=self.threshold,
+        )
+
+
+def margin_dense(model: LogisticRegression, x: jax.Array) -> jax.Array:
+    """(B, F) dense features -> (B,) raw margin."""
+    return x @ model.weights + model.intercept
+
+
+def margin_encoded(model: LogisticRegression, ids: jax.Array, counts: jax.Array) -> jax.Array:
+    """Fused sparse scoring over padded (B, L) bucket ids / counts.
+
+    ``model.weights`` must already include the IDF factor (see ``fold_idf``);
+    padding rows have count 0 so they contribute nothing.
+    """
+    gathered = model.weights[ids]                # (B, L)
+    return jnp.sum(gathered * counts, axis=-1) + model.intercept
+
+
+@partial(jax.jit, static_argnames=())
+def _predict_dense(model: LogisticRegression, x: jax.Array):
+    m = margin_dense(model, x)
+    p = jax.nn.sigmoid(m)
+    return (p > model.threshold).astype(jnp.int32), p
+
+
+@jax.jit
+def _predict_encoded(model: LogisticRegression, ids: jax.Array, counts: jax.Array):
+    m = margin_encoded(model, ids, counts)
+    p = jax.nn.sigmoid(m)
+    return (p > model.threshold).astype(jnp.int32), p
+
+
+def predict_dense(model: LogisticRegression, x) -> tuple[jax.Array, jax.Array]:
+    """Dense path: returns (predictions int32 (B,), probability of class 1 (B,))."""
+    return _predict_dense(model, jnp.asarray(x))
+
+
+def predict_encoded(model: LogisticRegression, batch: EncodedBatch) -> tuple[jax.Array, jax.Array]:
+    """Fused sparse path over an EncodedBatch (idf must be folded into weights)."""
+    return _predict_encoded(model, jnp.asarray(batch.ids), jnp.asarray(batch.counts))

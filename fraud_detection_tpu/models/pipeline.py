@@ -1,0 +1,96 @@
+"""Serving pipeline: text in, (label, probability) out — the agent-facing API.
+
+TPU-native replacement for the reference's serve path
+(``DeepSeekClassificationAgent.predict_and_get_label``,
+/root/reference/utils/agent_api.py:155-175), which ran a full 5-stage Spark job
+per single-row DataFrame. Here the host tokenizes/hashes a whole micro-batch
+and one jitted program scores it; for logistic models the features are never
+materialized (gather/segment-sum fast path, models/linear.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fraud_detection_tpu.checkpoint.spark_artifact import SparkPipelineArtifact
+from fraud_detection_tpu.featurize.text import StopWordFilter
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.models import linear as linear_mod
+from fraud_detection_tpu.models.linear import LogisticRegression
+
+
+@dataclass
+class PredictionBatch:
+    labels: np.ndarray          # (N,) int32 — 1 = scam
+    probabilities: np.ndarray   # (N,) float32 — p(class=1)
+
+    def __iter__(self):
+        return iter(zip(self.labels.tolist(), self.probabilities.tolist()))
+
+
+class ServingPipeline:
+    """Featurizer + classifier bound together behind ``predict(texts)``.
+
+    Use ``from_spark_artifact`` to serve the reference's shipped model with
+    bit-parity semantics, or construct directly from a native featurizer +
+    model pair trained by this framework.
+    """
+
+    def __init__(self, featurizer: HashingTfIdfFeaturizer, model: LogisticRegression,
+                 fold_idf: bool = True, batch_size: int = 256):
+        self.featurizer = featurizer
+        self.batch_size = batch_size
+        # Fold IDF into the weights so the sparse fast path sees raw counts.
+        self._fused_model = model.fold_idf(featurizer.idf_array()) if fold_idf else model
+        self.model = model
+
+    @classmethod
+    def from_spark_artifact(cls, artifact: SparkPipelineArtifact, batch_size: int = 256) -> "ServingPipeline":
+        from fraud_detection_tpu.checkpoint.spark_artifact import RegexTokenizerStage
+
+        for s in artifact.stages:
+            if isinstance(s, RegexTokenizerStage):
+                raise NotImplementedError(
+                    "artifact uses RegexTokenizer; only plain Tokenizer semantics "
+                    f"are implemented (pattern={s.pattern!r}, gaps={s.gaps})")
+        htf = artifact.hashing_tf
+        idf_stage = artifact.idf
+        lr = artifact.logistic_regression
+        if htf is None or lr is None:
+            raise ValueError(
+                "artifact must contain HashingTF + LogisticRegression stages "
+                f"(got {[type(s).__name__ for s in artifact.stages]})")
+        sw = artifact.stopwords
+        featurizer = HashingTfIdfFeaturizer(
+            num_features=htf.num_features,
+            idf=None if idf_stage is None else idf_stage.idf.astype(np.float32),
+            binary_tf=htf.binary,
+            stop_filter=StopWordFilter(sw.stopwords, sw.case_sensitive) if sw else StopWordFilter(),
+            remove_stopwords=sw is not None,
+        )
+        model = LogisticRegression.from_arrays(
+            lr.coefficients, lr.intercept, threshold=lr.threshold)
+        return cls(featurizer, model, fold_idf=True, batch_size=batch_size)
+
+    def predict(self, texts: Sequence[str]) -> PredictionBatch:
+        """Score texts in fixed-size micro-batches (pads the tail batch)."""
+        labels: List[np.ndarray] = []
+        probs: List[np.ndarray] = []
+        for start in range(0, len(texts), self.batch_size):
+            chunk = list(texts[start : start + self.batch_size])
+            n = len(chunk)
+            enc = self.featurizer.encode(chunk, batch_size=self.batch_size)
+            lab, p = linear_mod.predict_encoded(self._fused_model, enc)
+            labels.append(np.asarray(lab)[:n])
+            probs.append(np.asarray(p)[:n])
+        if not labels:
+            return PredictionBatch(np.empty(0, np.int32), np.empty(0, np.float32))
+        return PredictionBatch(np.concatenate(labels), np.concatenate(probs))
+
+    def predict_one(self, text: str) -> Tuple[int, float]:
+        """Single-dialogue convenience (the reference's per-click path)."""
+        batch = self.predict([text])
+        return int(batch.labels[0]), float(batch.probabilities[0])

@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
+(pjit/shard_map over a Mesh) are exercised without TPU hardware. These env
+vars must be set before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+REFERENCE_ARTIFACT = "/root/reference/dialogue_classification_model"
+
+
+@pytest.fixture(scope="session")
+def reference_artifact_path():
+    if not os.path.isdir(REFERENCE_ARTIFACT):
+        pytest.skip("reference Spark artifact not available")
+    return REFERENCE_ARTIFACT

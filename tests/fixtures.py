@@ -1,0 +1,30 @@
+"""Shared dialogue fixtures for tests.
+
+The shipped reference model was trained on full multi-turn agent/customer
+transcripts with a strongly negative intercept (-7.22), so realistic-length
+dialogues are needed to exercise both sides of the decision boundary.
+"""
+
+SCAM_DIALOGUE = """
+Agent: Congratulations! You are the lucky winner of our grand prize sweepstakes. This is an urgent matter.
+Customer: Really? I never entered any sweepstakes.
+Agent: Yes sir, you are the winner. Congratulations again! But you must act immediately. Your prize of ten thousand dollars is on hold and your claim will be suspended unless you verify your identity urgently.
+Customer: What do you need from me?
+Agent: To process your winner claim we urgently need you to verify your social security number and pay a small processing fee immediately with a gift card. If you do not verify now, a warrant may be issued and your account will be suspended. This is very urgent.
+Customer: That sounds suspicious.
+Agent: No sir, this is completely legal. Congratulations once more, but the offer expires immediately. Verify your number now to claim your prize before it is suspended.
+"""
+
+BENIGN_DIALOGUE = """
+Agent: Good morning, thank you for calling the dental clinic. How can I help you today?
+Customer: Hi, I would like to confirm my appointment for tomorrow.
+Agent: Of course. I see your cleaning appointment at three pm tomorrow. Please bring your insurance card.
+Customer: Great, thank you. Do I need to arrive early?
+Agent: Just ten minutes early for paperwork. We look forward to seeing you tomorrow. Have a wonderful day.
+Customer: Thanks, you too. Goodbye.
+"""
+
+SHORT_SCAM_SNIPPET = (
+    "Your social security number has been suspended due to suspicious activity. "
+    "You must verify your number and pay a fee immediately to avoid arrest."
+)

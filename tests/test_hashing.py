@@ -1,0 +1,97 @@
+"""MurmurHash3 / HashingTF parity tests.
+
+Standard murmur3_x86_32 test vectors are public-domain knowledge (Appleby's
+reference implementation); the Spark-parity statistical test checks that
+common dialogue words hash into buckets the shipped artifact's IDF table says
+were occupied during training (docFreq > 0) — a wrong hash variant scores at
+the ~41% occupancy base rate, the right one near 100%.
+"""
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.featurize.hashing import (
+    HashingTF,
+    murmur3_x86_32,
+    murmur3_x86_32_legacy_tail,
+    non_negative_mod,
+    spark_hash_bucket,
+)
+
+
+def test_murmur3_known_vectors():
+    # Public reference vectors for MurmurHash3_x86_32.
+    assert murmur3_x86_32(b"", 0) == 0
+    assert murmur3_x86_32(b"", 1) == 0x514E28B7
+    assert murmur3_x86_32(b"", 0xFFFFFFFF) == 0x81F16F39
+    assert murmur3_x86_32(b"\xff\xff\xff\xff", 0) == 0x76293B50
+    assert murmur3_x86_32(b"!Ce\x87", 0) == 0xF55B516B  # 0x87654321 LE
+    assert murmur3_x86_32(b"!Ce\x87", 0x5082EDEE) == 0x2362F9DE
+    assert murmur3_x86_32(b"Hello, world!", 0x9747B28C) == 0x24884CBA
+    assert murmur3_x86_32(b"aaaa", 0x9747B28C) == 0x5A97808A
+    assert murmur3_x86_32(b"abc", 0) == 0xB3DD93FA
+
+
+def test_variants_agree_on_aligned_lengths():
+    for s in [b"", b"fourfour", b"abcd", b"12345678"]:
+        assert murmur3_x86_32(s, 42) == murmur3_x86_32_legacy_tail(s, 42)
+
+
+def test_variants_differ_on_tail():
+    assert murmur3_x86_32(b"abc", 42) != murmur3_x86_32_legacy_tail(b"abc", 42)
+
+
+def test_non_negative_mod_matches_java_semantics():
+    assert non_negative_mod(7, 5) == 2
+    assert non_negative_mod(-7, 5) == 3
+    assert non_negative_mod(-10000, 10000) == 0
+    assert non_negative_mod(-(2**31), 10000) == (-(2**31)) % 10000
+
+
+def test_bucket_range_and_determinism():
+    words = ["hello", "account", "process", "x" * 100, ""]
+    for w in words:
+        b = spark_hash_bucket(w, 10000)
+        assert 0 <= b < 10000
+        assert b == spark_hash_bucket(w, 10000)
+
+
+def test_hashing_tf_counts():
+    tf = HashingTF(num_features=1000)
+    counts = tf.transform_counts(["a", "b", "a", "c", "a"])
+    assert sum(counts.values()) == 5.0
+    assert counts[tf.bucket("a")] >= 3.0  # >= in case of collision with b/c
+    binary = HashingTF(num_features=1000, binary=True)
+    bcounts = binary.transform_counts(["a", "b", "a"])
+    assert all(v == 1.0 for v in bcounts.values())
+
+
+def test_transform_arrays_sorted():
+    tf = HashingTF(num_features=10000)
+    idx, val = tf.transform_arrays(["hello", "world", "hello"])
+    assert list(idx) == sorted(idx)
+    assert val.sum() == 3.0
+
+
+COMMON_DIALOGUE_WORDS = [
+    "hello", "account", "bank", "card", "number", "call", "process", "security",
+    "please", "thank", "need", "information", "payment", "verify", "social",
+    "money", "credit", "help", "speaking", "calling", "today", "phone", "name",
+    "yes", "okay", "right", "service", "customer", "agent", "scam", "fraud",
+    "pay", "gift", "urgent", "offer", "address", "email", "confirm", "check", "sir",
+]
+
+
+def test_spark_hash_variant_matches_shipped_artifact(reference_artifact_path):
+    from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
+
+    art = load_spark_pipeline(reference_artifact_path)
+    doc_freq = art.idf.doc_freq
+    hits = sum(1 for w in COMMON_DIALOGUE_WORDS if doc_freq[spark_hash_bucket(w, 10000)] > 0)
+    assert hits == len(COMMON_DIALOGUE_WORDS), (
+        f"only {hits}/{len(COMMON_DIALOGUE_WORDS)} common words land in occupied "
+        "buckets — hash variant drifted from Spark ml.HashingTF")
+    legacy_hits = sum(
+        1 for w in COMMON_DIALOGUE_WORDS
+        if doc_freq[spark_hash_bucket(w, 10000, legacy=True)] > 0)
+    assert legacy_hits < len(COMMON_DIALOGUE_WORDS)

@@ -1,0 +1,81 @@
+"""Logistic scorer tests: dense vs fused-sparse equivalence + artifact serving."""
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, tfidf_dense
+from fraud_detection_tpu.models.linear import (
+    LogisticRegression,
+    predict_dense,
+    predict_encoded,
+)
+
+from tests.fixtures import BENIGN_DIALOGUE as BENIGN_TEXT
+from tests.fixtures import SCAM_DIALOGUE as SCAM_TEXT
+
+
+def test_dense_and_encoded_paths_agree():
+    rng = np.random.default_rng(0)
+    feat = HashingTfIdfFeaturizer(num_features=512, idf=rng.uniform(0.5, 2.0, 512))
+    model = LogisticRegression.from_arrays(rng.normal(0, 1, 512), 0.3)
+
+    texts = [SCAM_TEXT, BENIGN_TEXT, "hello hello hello", ""]
+    dense = feat.featurize_dense(texts)
+    lab_d, p_d = predict_dense(model, dense)
+
+    enc = feat.encode(texts)
+    lab_e, p_e = predict_encoded(model.fold_idf(feat.idf_array()), enc)
+
+    np.testing.assert_allclose(np.asarray(p_d), np.asarray(p_e), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(lab_d), np.asarray(lab_e))
+
+
+def test_empty_text_hashes_empty_token():
+    # Spark parity: "" tokenizes to [""] (Java split), which IS hashed — the
+    # margin picks up the empty-token bucket's weight, not just the intercept.
+    from fraud_detection_tpu.featurize.hashing import spark_hash_bucket
+
+    feat = HashingTfIdfFeaturizer(num_features=64)
+    model = LogisticRegression.from_arrays(np.arange(64, dtype=np.float64), -1.0)
+    enc = feat.encode([""])
+    _, p = predict_encoded(model, enc)
+    expected_margin = spark_hash_bucket("", 64) * 1.0 - 1.0
+    assert np.asarray(p)[0] == pytest.approx(1 / (1 + np.exp(-expected_margin)), rel=1e-5)
+
+
+def test_whitespace_only_text_scores_intercept_only():
+    # " " cleans to " ", splits to all-trailing empties -> zero tokens.
+    feat = HashingTfIdfFeaturizer(num_features=64)
+    model = LogisticRegression.from_arrays(np.ones(64), -1.0)
+    enc = feat.encode([" "])
+    _, p = predict_encoded(model, enc)
+    assert np.asarray(p)[0] == pytest.approx(1 / (1 + np.exp(1.0)), rel=1e-5)
+
+
+def test_tfidf_dense_scatter():
+    import jax.numpy as jnp
+
+    ids = jnp.array([[1, 1, 3, 0]], jnp.int32)
+    counts = jnp.array([[2.0, 1.0, 4.0, 0.0]], jnp.float32)
+    idf = jnp.array([10.0, 1.0, 1.0, 0.5], jnp.float32)
+    out = np.asarray(tfidf_dense(ids, counts, idf))
+    # bucket 1 accumulates 3 counts; padding (count 0) adds nothing to bucket 0.
+    np.testing.assert_allclose(out[0], [0.0, 3.0, 0.0, 2.0])
+
+
+def test_serving_pipeline_from_shipped_artifact(reference_artifact_path):
+    from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+    art = load_spark_pipeline(reference_artifact_path)
+    pipe = ServingPipeline.from_spark_artifact(art, batch_size=8)
+
+    label, prob = pipe.predict_one(SCAM_TEXT)
+    assert label == 1 and prob > 0.5, f"shipped model should flag an SSA scam (p={prob})"
+    label_b, prob_b = pipe.predict_one(BENIGN_TEXT)
+    assert label_b == 0 and prob_b < 0.5, f"benign appointment call flagged (p={prob_b})"
+
+    # Batch path identical to one-by-one.
+    batch = pipe.predict([SCAM_TEXT, BENIGN_TEXT] * 5)
+    assert batch.labels.tolist() == [1, 0] * 5
+    np.testing.assert_allclose(batch.probabilities[0], prob, rtol=1e-5)

@@ -1,0 +1,51 @@
+"""Spark-parity tests for host text preparation (tokenizer / stopwords / cleaning)."""
+
+from fraud_detection_tpu.featurize.text import (
+    StopWordFilter,
+    clean_text,
+    load_default_stopwords,
+    tokenize,
+)
+
+
+def test_clean_text_strips_non_alpha():
+    assert clean_text("Hello, World! 123") == "hello world "
+    assert clean_text("IRS-Agent #42: pay $500 NOW!!") == "irsagent  pay  now"
+
+
+def test_clean_text_strips_all_whitespace_but_space():
+    # Both reference paths use [^a-zA-Z ]: tabs/newlines are removed, not kept.
+    assert clean_text("a\tb\nc d") == "abc d"
+
+
+def test_tokenize_java_split_semantics():
+    # Interior and leading empties kept, trailing empties dropped (Java split).
+    assert tokenize("a  b") == ["a", "", "b"]
+    assert tokenize(" a b") == ["", "a", "b"]
+    assert tokenize("a b  ") == ["a", "b"]
+    assert tokenize("Hello World") == ["hello", "world"]
+    # Java "".split(regex) returns [""] — the empty token is then hashed,
+    # which matters for all-non-alphabetic inputs like "12345!!!".
+    assert tokenize("") == [""]
+    assert tokenize(" ") == []
+
+
+def test_default_stopwords_list():
+    sw = load_default_stopwords()
+    assert len(sw) == 181  # Spark's default English list, as serialized in the artifact
+    assert "i" in sw and "would" in sw and "the" in sw
+
+
+def test_stopword_filter_case_insensitive():
+    f = StopWordFilter(["the", "a"])
+    assert f(["The", "cat", "a", "hat"]) == ["cat", "hat"]
+    fc = StopWordFilter(["the"], case_sensitive=True)
+    assert fc(["The", "the"]) == ["The"]
+
+
+def test_stopword_filter_matches_artifact_list(reference_artifact_path):
+    from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
+
+    art = load_spark_pipeline(reference_artifact_path)
+    assert art.stopwords.stopwords == load_default_stopwords()
+    assert art.stopwords.case_sensitive is False
