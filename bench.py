@@ -1,0 +1,84 @@
+"""End-to-end classification throughput benchmark (the headline metric).
+
+Measures dialogues/sec through the full serve path — host text prep
+(tokenize -> stopwords -> murmur3 hashing) + jitted TPU scoring — using the
+shipped reference model when available (F1-parity weights), over a synthetic
+corpus with the reference dataset's shape (multi-turn agent/customer
+dialogues).
+
+The reference never publishes a throughput number (its serve path runs a full
+Spark job per message — SURVEY.md Q7 — and is qualitatively "sub-second" per
+dialogue); the north-star target from BASELINE.json is 10,000 dialogues/sec.
+``vs_baseline`` reports value / 10_000, i.e. progress against that target.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "dialogues/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR = 10_000.0  # dialogues/sec, BASELINE.json
+
+
+def build_pipeline(batch_size: int):
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+    artifact = "/root/reference/dialogue_classification_model"
+    if os.path.isdir(artifact):
+        from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
+
+        return ServingPipeline.from_spark_artifact(
+            load_spark_pipeline(artifact), batch_size=batch_size)
+    # Fallback: train on synthetic data so the bench runs anywhere.
+    from fraud_detection_tpu.data import generate_corpus
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+
+    corpus = generate_corpus(n=800, seed=7)
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    feat.fit_idf([d.text for d in corpus])
+    X = np.asarray(feat.featurize_dense([d.text for d in corpus]))
+    y = np.asarray([d.label for d in corpus], np.float32)
+    model = fit_logistic_regression(X, y, max_iter=50)
+    return ServingPipeline(feat, model, batch_size=batch_size)
+
+
+def main() -> None:
+    from fraud_detection_tpu.data import generate_corpus
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "1024"))
+    n_msgs = int(os.environ.get("BENCH_MSGS", "20000"))
+
+    corpus = generate_corpus(n=2000, seed=123)
+    texts = [d.text for d in corpus]
+    messages = [texts[i % len(texts)] for i in range(n_msgs)]
+
+    pipe = build_pipeline(batch_size)
+    # Warm-up: trigger compilation for the steady-state shapes.
+    pipe.predict(messages[: batch_size * 2])
+
+    best = 0.0
+    for _ in range(3):
+        start = time.perf_counter()
+        result = pipe.predict(messages)
+        np.asarray(result.probabilities)  # block on device work
+        elapsed = time.perf_counter() - start
+        best = max(best, n_msgs / elapsed)
+
+    print(json.dumps({
+        "metric": "end_to_end_classification_throughput",
+        "value": round(best, 1),
+        "unit": "dialogues/sec",
+        "vs_baseline": round(best / NORTH_STAR, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

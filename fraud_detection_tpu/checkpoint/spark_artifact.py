@@ -184,7 +184,11 @@ class LogisticRegressionStage:
 
 @dataclass
 class TreeNode:
-    """Flat Spark tree node row (see models/trees.py for the TPU encoding)."""
+    """Flat Spark tree node row (see models/trees.py for the TPU encoding).
+
+    Only continuous splits are supported (num_categories < 0); the loader
+    rejects categorical splits rather than silently mis-decoding them.
+    """
     id: int
     prediction: float
     impurity: float
@@ -194,6 +198,7 @@ class TreeNode:
     right: int
     split_feature: int
     split_threshold: float
+    num_categories: int = -1
 
 
 @dataclass
@@ -241,6 +246,13 @@ def _parse_tree_stage(stage_dir: str, meta: Dict[str, Any], kind: str) -> TreeEn
         node = row.get("nodeData", row)
         split = node.get("split", {}) or {}
         thresh_list = split.get("leftCategoriesOrThreshold") or []
+        num_categories = int(split.get("numCategories", -1))
+        if num_categories >= 0 and int(split.get("featureIndex", -1)) >= 0:
+            raise NotImplementedError(
+                f"categorical split on feature {split['featureIndex']} "
+                f"({num_categories} categories): only continuous splits are "
+                "supported — decoding the category list as a threshold would "
+                "silently corrupt predictions")
         node_obj = TreeNode(
             id=int(node["id"]),
             prediction=float(node["prediction"]),
@@ -251,6 +263,7 @@ def _parse_tree_stage(stage_dir: str, meta: Dict[str, Any], kind: str) -> TreeEn
             right=int(node.get("rightChild", -1)),
             split_feature=int(split.get("featureIndex", -1)),
             split_threshold=float(thresh_list[0]) if thresh_list else 0.0,
+            num_categories=num_categories,
         )
         trees_nodes.setdefault(tree_id, []).append(node_obj)
     trees = [sorted(trees_nodes[k], key=lambda n: n.id) for k in sorted(trees_nodes)]

@@ -1,0 +1,3 @@
+from fraud_detection_tpu.data.synthetic import Dialogue, generate_corpus, train_val_test_split
+
+__all__ = ["Dialogue", "generate_corpus", "train_val_test_split"]

@@ -1,0 +1,161 @@
+"""Synthetic scam/legit phone-dialogue corpus generator.
+
+The reference trains on the BothBosu ``agent_conversation_all.csv`` (1,600
+synthetic agent/customer dialogues, balanced 800/800 — SURVEY.md §6), streamed
+from HuggingFace at train time (fraud_detection_spark.py:331). That network
+fetch is unavailable here, so this module generates a corpus with the same
+shape and statistical character: multi-turn Agent/Customer transcripts,
+balanced labels, scam dialogues drawn from the classic phone-scam families
+(SSA/IRS impersonation, prize/sweepstakes, tech support, bank fraud, gift
+cards) and legitimate dialogues from routine call types (appointments,
+deliveries, support, surveys). Fully seeded — the same seed always yields the
+same corpus, which keeps trainer tests and benchmarks deterministic.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+SCAM_OPENERS = [
+    "Hello, this is {name} calling from the {org}. This is an urgent matter regarding your {subject}.",
+    "Good afternoon, my name is {name} with the {org}. We have detected suspicious activity on your {subject}.",
+    "This is {name} from the {org}. I am calling about a serious problem with your {subject}.",
+    "Congratulations! This is {name} from the {org}. You have been selected as a winner in our {subject} promotion.",
+]
+SCAM_ORGS = [
+    "Social Security Administration", "Internal Revenue Service", "Federal Reserve",
+    "Microsoft Technical Support", "National Prize Center", "Bank Security Department",
+    "Amazon Fraud Prevention", "Medicare Services",
+]
+SCAM_SUBJECTS = [
+    "social security number", "tax account", "bank account", "computer",
+    "sweepstakes entry", "credit card", "benefits account", "online account",
+]
+SCAM_DEMANDS = [
+    "You must verify your {subject} immediately or it will be suspended.",
+    "A warrant will be issued for your arrest unless you act right now.",
+    "You need to pay a processing fee of {amount} dollars with gift cards today.",
+    "Please purchase {amount} dollars in gift cards and read me the codes to secure your funds.",
+    "We need you to confirm your full account number and password to stop the fraudulent charges.",
+    "Your funds must be transferred to a safe government account immediately.",
+    "If you hang up, legal action will begin against you within the hour.",
+    "To claim your prize you must send the registration fee by wire transfer urgently.",
+]
+SCAM_PRESSURE = [
+    "This is extremely urgent and confidential. Do not tell anyone at your bank.",
+    "Officers are on their way unless we resolve this immediately.",
+    "This offer expires in thirty minutes, you must decide now.",
+    "Your account will be frozen permanently if you do not cooperate.",
+    "Stay on the line, do not hang up under any circumstances.",
+]
+CUSTOMER_WARY = [
+    "This sounds suspicious to me. How do I know you are real?",
+    "I was not expecting any call like this. Are you sure?",
+    "I do not feel comfortable giving that information over the phone.",
+    "Why would the government ask for gift cards?",
+    "Let me call the official number and check first.",
+]
+CUSTOMER_COMPLIANT = [
+    "Oh no, that sounds serious. What do I need to do?",
+    "I understand. Which card numbers do you need?",
+    "Please help me fix this, I do not want any trouble.",
+    "Okay, I am writing down the instructions now.",
+]
+
+LEGIT_OPENERS = [
+    "Good morning, this is {name} from {org}. I am calling to {purpose}.",
+    "Hi, you have reached {org}, {name} speaking. How can I help you today?",
+    "Hello, this is {name} at {org}, following up to {purpose}.",
+]
+LEGIT_ORGS = [
+    "the dental clinic", "city library", "the auto repair shop", "your internet provider",
+    "the veterinary office", "the pharmacy", "the school office", "the electric company",
+    "the hotel front desk", "the airline reservations desk",
+]
+LEGIT_PURPOSES = [
+    "confirm your appointment for tomorrow afternoon",
+    "let you know your order is ready for pickup",
+    "remind you about your scheduled service visit",
+    "follow up on the request you submitted last week",
+    "check whether the technician visit resolved your issue",
+    "confirm the reservation details for your stay",
+]
+LEGIT_BODY = [
+    "Agent: We have you down for {time}. Does that still work for you?\nCustomer: Yes, that works fine for me.\nAgent: Wonderful. Please remember to bring your {item}.",
+    "Customer: Thanks for letting me know. Can I come by around {time}?\nAgent: Of course, we are open until six. See you then.",
+    "Agent: Is there anything else I can help you with today?\nCustomer: No, that covers everything. Thank you so much for the call.",
+    "Customer: Actually, could we reschedule to {time}?\nAgent: No problem at all, I have moved it. You will get a confirmation message shortly.",
+    "Agent: The total came to {amount} dollars and your warranty covers most of it.\nCustomer: That is great news, thank you for the update.",
+]
+LEGIT_CLOSERS = [
+    "Agent: Thank you for your time. Have a wonderful day.\nCustomer: You too, goodbye.",
+    "Agent: We appreciate your business. Take care.\nCustomer: Thanks, bye.",
+    "Customer: Thanks again for the reminder. Goodbye.\nAgent: Goodbye.",
+]
+NAMES = ["Daniels", "Morgan", "Chen", "Patel", "Garcia", "Smith", "Johnson", "Lee", "Brown", "Walker"]
+TIMES = ["nine in the morning", "noon", "two thirty", "three pm", "four o'clock", "five fifteen"]
+ITEMS = ["insurance card", "photo id", "order confirmation", "parking pass", "paperwork"]
+
+
+@dataclass
+class Dialogue:
+    text: str
+    label: int  # 1 = scam
+    kind: str
+
+
+def _gen_scam(rng: random.Random) -> Dialogue:
+    org = rng.choice(SCAM_ORGS)
+    subject = rng.choice(SCAM_SUBJECTS)
+    fmt = dict(name=rng.choice(NAMES), org=org, subject=subject,
+               amount=str(rng.choice([200, 500, 900, 1500, 2000])))
+    lines = ["Agent: " + rng.choice(SCAM_OPENERS).format(**fmt)]
+    lines.append("Customer: " + rng.choice(["Who is this? What is this about?",
+                                            "Oh? I was not expecting a call.",
+                                            "Yes, this is me speaking."]))
+    for _ in range(rng.randint(2, 4)):
+        lines.append("Agent: " + rng.choice(SCAM_DEMANDS).format(**fmt))
+        lines.append("Customer: " + rng.choice(CUSTOMER_WARY + CUSTOMER_COMPLIANT))
+    lines.append("Agent: " + rng.choice(SCAM_PRESSURE))
+    lines.append("Customer: " + rng.choice(CUSTOMER_WARY + CUSTOMER_COMPLIANT))
+    return Dialogue(text="\n".join(lines), label=1, kind=f"scam:{org}")
+
+
+def _gen_legit(rng: random.Random) -> Dialogue:
+    fmt = dict(name=rng.choice(NAMES), org=rng.choice(LEGIT_ORGS),
+               purpose=rng.choice(LEGIT_PURPOSES), time=rng.choice(TIMES),
+               item=rng.choice(ITEMS), amount=str(rng.choice([20, 45, 80, 120])))
+    lines = ["Agent: " + rng.choice(LEGIT_OPENERS).format(**fmt)]
+    lines.append("Customer: " + rng.choice(["Hi, thanks for calling.",
+                                            "Oh good, I was hoping to hear from you.",
+                                            "Hello, yes this is a good time."]))
+    for _ in range(rng.randint(1, 3)):
+        lines.append(rng.choice(LEGIT_BODY).format(**fmt))
+    lines.append(rng.choice(LEGIT_CLOSERS))
+    return Dialogue(text="\n".join(lines), label=0, kind="legit")
+
+
+def generate_corpus(n: int = 1600, seed: int = 42, scam_fraction: float = 0.5) -> List[Dialogue]:
+    """Balanced synthetic corpus; same (n, seed) always yields the same data."""
+    rng = random.Random(seed)
+    n_scam = int(round(n * scam_fraction))
+    out = [_gen_scam(rng) for _ in range(n_scam)]
+    out += [_gen_legit(rng) for _ in range(n - n_scam)]
+    rng.shuffle(out)
+    return out
+
+
+def train_val_test_split(items: Sequence, seed: int = 42,
+                         fractions: Tuple[float, float, float] = (0.7, 0.1, 0.2)):
+    """Seeded 70/10/20 split (reference: two chained randomSplits, seed 42 —
+    fraud_detection_spark.py:338-339; exact Spark row assignment is
+    sampler-internal, so this replicates the protocol, not the membership)."""
+    idx = list(range(len(items)))
+    random.Random(seed).shuffle(idx)
+    n = len(items)
+    n_train = int(round(fractions[0] * n))
+    n_val = int(round(fractions[1] * n))
+    pick = lambda ids: [items[i] for i in ids]
+    return pick(idx[:n_train]), pick(idx[n_train:n_train + n_val]), pick(idx[n_train + n_val:])

@@ -1,0 +1,8 @@
+from fraud_detection_tpu.eval.metrics import (
+    ClassificationReport,
+    confusion_matrix,
+    evaluate_classification,
+    roc_auc,
+)
+
+__all__ = ["ClassificationReport", "confusion_matrix", "evaluate_classification", "roc_auc"]

@@ -29,7 +29,10 @@ from typing import FrozenSet, List, Sequence
 # serve remove [^a-zA-Z ] (tabs/newlines included — space is the only
 # whitespace that survives).
 _NON_ALPHA_SPACE = re.compile(r"[^a-z ]")
-_WS_SPLIT = re.compile(r"\s")
+# Java's regex \s is ASCII-only: [ \t\n\x0B\f\r]. Python's \s also matches
+# Unicode whitespace (\xa0,  , ...), which would split tokens Spark keeps
+# intact — so the Java set is spelled out explicitly.
+_WS_SPLIT = re.compile(r"[ \t\n\x0b\f\r]")
 
 
 def clean_text(text: str) -> str:

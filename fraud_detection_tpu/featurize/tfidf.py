@@ -128,6 +128,27 @@ class HashingTfIdfFeaturizer:
             counts[r, : len(val)] = val
         return EncodedBatch(ids=ids, counts=counts)
 
+    def fit_idf(self, texts: Sequence[str], min_doc_freq: int = 0) -> "HashingTfIdfFeaturizer":
+        """Fit the IDF vector from a corpus (Spark ``IDF.fit`` semantics).
+
+        doc_freq[b] = number of docs with a nonzero count in bucket b;
+        idf = ln((numDocs + 1) / (docFreq + 1)), zeroed below min_doc_freq
+        (reference trains with minDocFreq=0 — fraud_detection_spark.py:53).
+        Returns self for chaining; also records doc_freq/num_docs for
+        checkpointing and interpretability.
+        """
+        doc_freq = np.zeros(self.num_features, np.int64)
+        for t in texts:
+            idx, _ = self.sparse_row(t)
+            doc_freq[idx] += 1
+        idf = np.log((len(texts) + 1.0) / (doc_freq + 1.0))
+        if min_doc_freq > 0:
+            idf = np.where(doc_freq >= min_doc_freq, idf, 0.0)
+        self.idf = idf.astype(np.float32)
+        self.doc_freq = doc_freq
+        self.num_docs = len(texts)
+        return self
+
     # ---------------- device side ----------------
 
     def idf_array(self) -> jnp.ndarray:

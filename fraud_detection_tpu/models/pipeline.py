@@ -47,6 +47,11 @@ class ServingPipeline:
         self._fused_model = model.fold_idf(featurizer.idf_array()) if fold_idf else model
         self.model = model
 
+    @property
+    def fused_model(self) -> LogisticRegression:
+        """The serving model with IDF folded into the weights (raw-count input)."""
+        return self._fused_model
+
     @classmethod
     def from_spark_artifact(cls, artifact: SparkPipelineArtifact, batch_size: int = 256) -> "ServingPipeline":
         from fraud_detection_tpu.checkpoint.spark_artifact import RegexTokenizerStage

@@ -1,0 +1,15 @@
+from fraud_detection_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FEATURE_AXIS,
+    batch_sharding,
+    feature_sharding,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+    shard_rows,
+)
+
+__all__ = [
+    "DATA_AXIS", "FEATURE_AXIS", "batch_sharding", "feature_sharding",
+    "make_mesh", "pad_to_multiple", "replicated", "shard_rows",
+]

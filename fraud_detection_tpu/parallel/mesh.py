@@ -1,0 +1,86 @@
+"""Device-mesh construction and sharding helpers.
+
+The framework's distributed layer: everything the reference delegated to Spark
+executors / XGBoost Rabit allreduce (SURVEY.md §2.4) maps here onto a
+``jax.sharding.Mesh`` with named axes and XLA collectives over ICI:
+
+  axis "data"    — rows (dialogues): data parallelism for training batches and
+                   streaming micro-batches. Gradient/histogram reductions
+                   become psums over this axis (the Rabit-allreduce analogue).
+  axis "feature" — TF-IDF feature dimension: used by histogram tree building
+                   to split the 10k-feature scan across chips.
+
+On a single host this works against real TPU chips or the CPU
+``--xla_force_host_platform_device_count`` virtual mesh; on multi-host pods the
+same named-axis code spans DCN via jax.distributed without change — that is the
+point of expressing communication as named-axis collectives instead of
+explicit endpoints.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              data_parallel: Optional[int] = None,
+              feature_parallel: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, feature) mesh over the available devices.
+
+    Defaults to all devices on the data axis — the right layout for this
+    workload, where models are tiny and rows are plentiful.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if data_parallel is None:
+        if n % feature_parallel:
+            raise ValueError(f"{n} devices not divisible by feature_parallel={feature_parallel}")
+        data_parallel = n // feature_parallel
+    if data_parallel * feature_parallel != n:
+        raise ValueError(
+            f"data_parallel({data_parallel}) * feature_parallel({feature_parallel}) != {n}")
+    grid = np.asarray(devs).reshape(data_parallel, feature_parallel)
+    return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over the data axis, features replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def feature_sharding(mesh: Mesh) -> NamedSharding:
+    """Feature-dimension sharding for (F,)-shaped or (B, F) arrays' last axis."""
+    return NamedSharding(mesh, P(None, FEATURE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def shard_rows(x: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Pad rows to a data-axis multiple and device_put with row sharding.
+
+    Padding rows are zeros; callers carry an explicit validity mask when the
+    padded rows must not contribute (losses, metrics).
+    """
+    dp = mesh.shape[DATA_AXIS]
+    padded = pad_to_multiple(x.shape[0], dp)
+    if padded != x.shape[0]:
+        pad_width = [(0, padded - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        x = np.pad(x, pad_width)
+    return jax.device_put(x, batch_sharding(mesh) if x.ndim > 1
+                          else NamedSharding(mesh, P(DATA_AXIS)))

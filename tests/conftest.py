@@ -7,10 +7,18 @@ vars must be set before jax is imported anywhere in the test process.
 
 import os
 
+# Env-var route (respected in plain installs; the axon TPU tunnel ignores it).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Config route — must run before any backend initialization; this is what
+# actually wins when a TPU platform plugin is present.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,47 @@
+"""Metric-definition tests: Spark evaluator semantics vs sklearn cross-checks."""
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.eval import confusion_matrix, evaluate_classification, roc_auc
+
+
+def test_confusion_matrix_layout():
+    cm = confusion_matrix([0, 0, 1, 1, 1], [0, 1, 1, 1, 0])
+    # rows = true, cols = predicted
+    assert cm.tolist() == [[1, 1], [1, 2]]
+
+
+def test_weighted_metrics_match_sklearn():
+    from sklearn.metrics import f1_score, precision_score, recall_score
+
+    rng = np.random.default_rng(3)
+    y = rng.integers(0, 2, 200)
+    pred = np.where(rng.uniform(size=200) < 0.8, y, 1 - y)
+    rep = evaluate_classification(y, pred)
+    assert rep.weighted_precision == pytest.approx(
+        precision_score(y, pred, average="weighted"), abs=1e-9)
+    assert rep.weighted_recall == pytest.approx(
+        recall_score(y, pred, average="weighted"), abs=1e-9)
+    assert rep.f1 == pytest.approx(f1_score(y, pred, average="weighted"), abs=1e-9)
+
+
+def test_auc_matches_sklearn_with_ties():
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(4)
+    y = rng.integers(0, 2, 500)
+    # Coarsely quantized scores force many ties — the case where naive
+    # implementations diverge from the trapezoidal/grouped definition.
+    scores = np.round(rng.uniform(size=500) * 0.6 + y * 0.3, 1)
+    assert roc_auc(y, scores) == pytest.approx(roc_auc_score(y, scores), abs=1e-12)
+
+
+def test_auc_degenerate_single_class():
+    assert np.isnan(roc_auc([1, 1], [0.2, 0.7]))
+
+
+def test_perfect_classifier_report():
+    rep = evaluate_classification([0, 1, 0, 1], [0, 1, 0, 1], [0.1, 0.9, 0.2, 0.8])
+    assert rep.accuracy == 1.0 and rep.f1 == 1.0 and rep.auc == 1.0
+    assert rep.confusion.tolist() == [[2, 0], [0, 2]]

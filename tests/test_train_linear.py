@@ -1,0 +1,71 @@
+"""LR trainer tests: convergence, Spark-protocol hyperparams, mesh sharding."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fraud_detection_tpu.data import generate_corpus, train_val_test_split
+from fraud_detection_tpu.eval import evaluate_classification
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.models.linear import predict_dense
+from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+from fraud_detection_tpu.parallel import make_mesh
+
+
+def _toy_problem(n=400, f=32, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(0, 2.0, f)
+    X = rng.normal(0, 1.0, (n, f)).astype(np.float32)
+    logits = X @ w_true - 0.5
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    return X, y, w_true
+
+
+def test_lbfgs_separates_toy_data():
+    X, y, _ = _toy_problem()
+    model = fit_logistic_regression(X, y, max_iter=100)
+    pred, p = predict_dense(model, X)
+    acc = np.mean(np.asarray(pred) == y)
+    assert acc > 0.9, f"train accuracy {acc}"
+
+
+def test_lbfgs_matches_sklearn_optimum():
+    # regParam=0 unregularized optimum should agree with sklearn's lbfgs.
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y, _ = _toy_problem(n=300, f=8, seed=1)
+    ours = fit_logistic_regression(X, y, max_iter=200, tol=1e-9)
+    sk = SkLR(penalty=None, max_iter=2000, tol=1e-10).fit(X, y)
+    np.testing.assert_allclose(np.asarray(ours.weights), sk.coef_[0], rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(float(ours.intercept), sk.intercept_[0], rtol=0.05, atol=0.05)
+
+
+def test_mesh_training_matches_single_device():
+    X, y, _ = _toy_problem(n=333, f=16, seed=2)  # odd n exercises padding
+    single = fit_logistic_regression(X, y, max_iter=50)
+    mesh = make_mesh()  # 8 virtual CPU devices (conftest)
+    assert mesh.devices.size == 8
+    sharded = fit_logistic_regression(X, y, mesh=mesh, max_iter=50)
+    np.testing.assert_allclose(
+        np.asarray(single.weights), np.asarray(sharded.weights), rtol=1e-3, atol=1e-3)
+
+
+def test_end_to_end_train_on_synthetic_corpus():
+    corpus = generate_corpus(n=800, seed=7)
+    train, val, test = train_val_test_split(corpus, seed=42)
+    assert len(train) == 560 and len(val) == 80 and len(test) == 160
+
+    feat = HashingTfIdfFeaturizer(num_features=4096)
+    feat.fit_idf([d.text for d in train])
+    Xtr = np.asarray(feat.featurize_dense([d.text for d in train]))
+    ytr = np.asarray([d.label for d in train], np.float32)
+    model = fit_logistic_regression(Xtr, ytr, max_iter=100)
+
+    Xte = np.asarray(feat.featurize_dense([d.text for d in test]))
+    yte = np.asarray([d.label for d in test])
+    pred, p = predict_dense(model, Xte)
+    report = evaluate_classification(yte, np.asarray(pred), np.asarray(p))
+    assert report.accuracy > 0.97, report.as_dict()
+    assert report.auc > 0.99, report.as_dict()
+    assert report.f1 > 0.97, report.as_dict()
