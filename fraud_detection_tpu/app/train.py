@@ -1,0 +1,166 @@
+"""Training driver CLI — the reference's ``main()`` rebuilt for TPU.
+
+Mirrors /root/reference/fraud_detection_spark.py:326-405: load + clean the
+dialogue corpus, 70/10/20 seeded split, train the classifier zoo (decision
+tree, random forest, gradient boosting — plus logistic regression, the model
+family the shipped serving artifact actually uses), evaluate every model on
+validation and test with the same metric set (accuracy / weighted P / R / F1 /
+AUC / confusion), print a report, and save the selected model as a native
+checkpoint servable by ``ServingPipeline.from_checkpoint``.
+
+Unlike the reference (no CLI flags anywhere — SURVEY.md §5), everything is
+flag-driven:
+
+    python -m fraud_detection_tpu.app.train --data synthetic --n 1600 \
+        --models dt,rf,xgb,lr --save dt=fraud_model_dt --num-features 10000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def load_corpus(args) -> List[Tuple[str, int]]:
+    """Returns [(dialogue, label)]. CSV schema matches the reference dataset:
+    columns ``dialogue`` and ``labels`` in {0, 1} (fraud_detection_spark.py:32-41)."""
+    if args.data == "synthetic":
+        from fraud_detection_tpu.data import generate_corpus
+
+        return [(d.text, d.label) for d in generate_corpus(n=args.n, seed=args.seed)]
+    import pandas as pd
+
+    df = pd.read_csv(args.data)
+    if "dialogue" not in df.columns:
+        raise SystemExit(f"CSV {args.data} missing 'dialogue' column (has {list(df.columns)})")
+    label_col = "labels" if "labels" in df.columns else "label"
+    out = []
+    for text, raw in zip(df["dialogue"], df[label_col]):
+        try:
+            val = float(raw)  # accepts "0", "1", "0.0", "1.0", 0, 1.0, ...
+        except (TypeError, ValueError):
+            continue
+        if val in (0.0, 1.0):
+            out.append((str(text), int(val)))
+    if not out:
+        raise SystemExit(
+            f"CSV {args.data}: no rows with {label_col} in {{0, 1}} "
+            f"(sample values: {df[label_col].head(5).tolist()})")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--data", default="synthetic",
+                    help="'synthetic' or a CSV path with dialogue/labels columns")
+    ap.add_argument("--n", type=int, default=1600, help="synthetic corpus size")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--models", default="dt,rf,xgb,lr",
+                    help="comma list from {dt,rf,xgb,lr}")
+    ap.add_argument("--num-features", type=int, default=10000)
+    ap.add_argument("--max-depth", type=int, default=5)
+    ap.add_argument("--n-trees", type=int, default=100)
+    ap.add_argument("--n-rounds", type=int, default=100)
+    ap.add_argument("--save", action="append", default=[],
+                    help="model=dir pairs, e.g. dt=./fraud_model_dt (repeatable)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="train data-parallel over all available devices")
+    ap.add_argument("--json", action="store_true", help="emit metrics as JSON")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.data import train_val_test_split
+    from fraud_detection_tpu.eval import evaluate_classification
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.linear import predict_dense
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+    from fraud_detection_tpu.models.train_trees import (
+        TreeTrainConfig, fit_decision_tree, fit_gradient_boosting, fit_random_forest)
+
+    chosen = [m.strip() for m in args.models.split(",") if m.strip()]
+    save_pairs = []
+    for pair in args.save:  # validate before any training time is spent
+        name, _, out_dir = pair.partition("=")
+        if not out_dir or name not in chosen:
+            raise SystemExit(
+                f"--save expects model=dir with the model in --models (got {pair!r}, "
+                f"models: {chosen})")
+        save_pairs.append((name, out_dir))
+
+    corpus = load_corpus(args)
+    train, val, test = train_val_test_split(corpus, seed=args.seed)
+    print(f"Training samples: {len(train)}\nValidation samples: {len(val)}"
+          f"\nTest samples: {len(test)}")
+
+    feat = HashingTfIdfFeaturizer(num_features=args.num_features)
+    feat.fit_idf([t for t, _ in train])
+    to_xy = lambda split: (
+        np.asarray(feat.featurize_dense([t for t, _ in split])),
+        np.asarray([l for _, l in split]))
+    Xtr, ytr = to_xy(train)
+    sets = {"Validation": to_xy(val), "Test": to_xy(test)}
+
+    mesh = None
+    if args.mesh:
+        from fraud_detection_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        print(f"mesh: {dict(mesh.shape)}")
+
+    cfg = TreeTrainConfig(max_depth=args.max_depth)
+    trained = {}
+    for name in chosen:
+        t0 = time.perf_counter()
+        if name == "dt":
+            trained[name] = fit_decision_tree(Xtr, ytr, config=cfg, mesh=mesh)
+        elif name == "rf":
+            trained[name] = fit_random_forest(
+                Xtr, ytr, n_trees=args.n_trees, seed=args.seed, config=cfg, mesh=mesh)
+        elif name == "xgb":
+            trained[name] = fit_gradient_boosting(
+                Xtr, ytr, n_rounds=args.n_rounds, mesh=mesh,
+                config=TreeTrainConfig(max_depth=args.max_depth, criterion="xgb"))
+        elif name == "lr":
+            trained[name] = fit_logistic_regression(
+                Xtr, ytr.astype(np.float32), mesh=mesh)
+        else:
+            raise SystemExit(f"unknown model {name!r} (choose from dt,rf,xgb,lr)")
+        print(f"trained {name} in {time.perf_counter() - t0:.2f}s")
+
+    def scores(model, X):
+        if hasattr(model, "tree_weights"):
+            return trees_mod.predict(model, jnp.asarray(X))
+        return predict_dense(model, X)
+
+    all_metrics: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for name, model in trained.items():
+        all_metrics[name] = {}
+        for split_name, (X, y) in sets.items():
+            pred, p1 = scores(model, X)
+            rep = evaluate_classification(y, np.asarray(pred), np.asarray(p1))
+            all_metrics[name][split_name] = rep.as_dict()
+            if not args.json:
+                print(f"\n=== {name} / {split_name} ===")
+                for k, v in rep.as_dict().items():
+                    print(f"  {k}: {v:.4f}")
+                print(f"  confusion: {rep.confusion.tolist()}")
+    if args.json:
+        print(json.dumps(all_metrics, indent=2))
+
+    from fraud_detection_tpu.checkpoint.native import save_checkpoint
+
+    for name, out_dir in save_pairs:
+        save_checkpoint(out_dir, feat, trained[name])
+        print(f"saved {name} -> {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Native checkpoint format for the framework's own artifacts.
+
+The reference's only persistence is Spark's save/load directory layout
+(SURVEY.md §5 — JSON metadata + snappy parquet per stage). The native format
+keeps the same spirit (one directory, human-readable metadata + array blobs)
+with plain npz for the arrays — no JVM, no parquet dependency at serve time:
+
+    <dir>/manifest.json      {"format": "fraud_detection_tpu", "version": 1,
+                              "model_kind": ..., "featurizer": {...}}
+    <dir>/arrays.npz         all numpy arrays, flat key namespace
+
+Round-trips the serving stack: featurizer (hashing config + idf/doc_freq +
+stop list) and any model (LogisticRegression or TreeEnsemble). The Spark
+artifact reader (spark_artifact.py) remains the importer for reference
+artifacts; this module is the framework's own save path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Tuple, Union
+
+import numpy as np
+
+from fraud_detection_tpu.featurize.text import StopWordFilter
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.models.linear import LogisticRegression
+from fraud_detection_tpu.models.trees import TreeEnsemble
+
+FORMAT_NAME = "fraud_detection_tpu"
+FORMAT_VERSION = 1
+
+Model = Union[LogisticRegression, TreeEnsemble]
+
+
+def save_checkpoint(path: str, featurizer: HashingTfIdfFeaturizer, model: Model) -> None:
+    os.makedirs(path, exist_ok=True)
+    arrays = {}
+    meta = {
+        "format": FORMAT_NAME,
+        "version": FORMAT_VERSION,
+        "featurizer": {
+            "num_features": featurizer.num_features,
+            "binary_tf": featurizer.binary_tf,
+            "remove_stopwords": featurizer.remove_stopwords,
+            "num_docs": getattr(featurizer, "num_docs", None),
+            "stopwords": featurizer.stop_filter.words,
+            "case_sensitive": featurizer.stop_filter.case_sensitive,
+        },
+    }
+    if featurizer.idf is not None:
+        arrays["featurizer.idf"] = np.asarray(featurizer.idf, np.float32)
+    if getattr(featurizer, "doc_freq", None) is not None:
+        arrays["featurizer.doc_freq"] = np.asarray(featurizer.doc_freq, np.int64)
+
+    if isinstance(model, LogisticRegression):
+        meta["model_kind"] = "logistic_regression"
+        meta["model"] = {"threshold": model.threshold}
+        arrays["model.weights"] = np.asarray(model.weights, np.float32)
+        arrays["model.intercept"] = np.asarray(model.intercept, np.float32)
+    elif isinstance(model, TreeEnsemble):
+        meta["model_kind"] = "tree_ensemble"
+        meta["model"] = {"kind": model.kind, "max_depth": model.max_depth,
+                         "bias": model.bias}
+        for name in ("feature", "threshold", "left", "right", "leaf", "tree_weights"):
+            arrays[f"model.{name}"] = np.asarray(getattr(model, name))
+    else:
+        raise TypeError(f"unsupported model type {type(model).__name__}")
+
+    np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump(meta, fh, indent=2)
+
+
+def load_checkpoint(path: str) -> Tuple[HashingTfIdfFeaturizer, Model]:
+    with open(os.path.join(path, "manifest.json")) as fh:
+        meta = json.load(fh)
+    if meta.get("format") != FORMAT_NAME:
+        raise ValueError(f"{path} is not a {FORMAT_NAME} checkpoint")
+    arrays = np.load(os.path.join(path, "arrays.npz"))
+
+    fz = meta["featurizer"]
+    featurizer = HashingTfIdfFeaturizer(
+        num_features=int(fz["num_features"]),
+        idf=arrays["featurizer.idf"] if "featurizer.idf" in arrays else None,
+        binary_tf=bool(fz["binary_tf"]),
+        stop_filter=StopWordFilter(fz["stopwords"], fz["case_sensitive"]),
+        remove_stopwords=bool(fz["remove_stopwords"]),
+    )
+    if "featurizer.doc_freq" in arrays:
+        featurizer.doc_freq = arrays["featurizer.doc_freq"]
+    if fz.get("num_docs") is not None:
+        featurizer.num_docs = int(fz["num_docs"])
+
+    import jax.numpy as jnp
+
+    if meta["model_kind"] == "logistic_regression":
+        model: Model = LogisticRegression(
+            weights=jnp.asarray(arrays["model.weights"]),
+            intercept=jnp.asarray(arrays["model.intercept"]),
+            threshold=float(meta["model"]["threshold"]),
+        )
+    elif meta["model_kind"] == "tree_ensemble":
+        model = TreeEnsemble(
+            feature=jnp.asarray(arrays["model.feature"]),
+            threshold=jnp.asarray(arrays["model.threshold"]),
+            left=jnp.asarray(arrays["model.left"]),
+            right=jnp.asarray(arrays["model.right"]),
+            leaf=jnp.asarray(arrays["model.leaf"]),
+            tree_weights=jnp.asarray(arrays["model.tree_weights"]),
+            kind=meta["model"]["kind"],
+            max_depth=int(meta["model"]["max_depth"]),
+            bias=float(meta["model"].get("bias", 0.0)),
+        )
+    else:
+        raise ValueError(f"unknown model_kind {meta['model_kind']!r}")
+    return featurizer, model

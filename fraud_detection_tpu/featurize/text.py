@@ -77,6 +77,11 @@ class StopWordFilter:
         self.case_sensitive = case_sensitive
         self._set: FrozenSet[str] = frozenset(words if case_sensitive else [w.lower() for w in words])
 
+    @property
+    def words(self) -> List[str]:
+        """The effective stop list (lowercased unless case_sensitive)."""
+        return sorted(self._set)
+
     def __call__(self, tokens: Sequence[str]) -> List[str]:
         if self.case_sensitive:
             return [t for t in tokens if t not in self._set]

@@ -19,7 +19,9 @@ from fraud_detection_tpu.checkpoint.spark_artifact import SparkPipelineArtifact
 from fraud_detection_tpu.featurize.text import StopWordFilter
 from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
 from fraud_detection_tpu.models import linear as linear_mod
+from fraud_detection_tpu.models import trees as trees_mod
 from fraud_detection_tpu.models.linear import LogisticRegression
+from fraud_detection_tpu.models.trees import TreeEnsemble
 
 
 @dataclass
@@ -39,18 +41,35 @@ class ServingPipeline:
     model pair trained by this framework.
     """
 
-    def __init__(self, featurizer: HashingTfIdfFeaturizer, model: LogisticRegression,
+    def __init__(self, featurizer: HashingTfIdfFeaturizer,
+                 model: "LogisticRegression | TreeEnsemble",
                  fold_idf: bool = True, batch_size: int = 256):
         self.featurizer = featurizer
         self.batch_size = batch_size
-        # Fold IDF into the weights so the sparse fast path sees raw counts.
-        self._fused_model = model.fold_idf(featurizer.idf_array()) if fold_idf else model
         self.model = model
+        if isinstance(model, LogisticRegression):
+            # Fold IDF into the weights so the sparse fast path sees raw counts.
+            self._fused_model: Optional[LogisticRegression] = (
+                model.fold_idf(featurizer.idf_array()) if fold_idf else model)
+        else:
+            # Trees branch on absolute feature values: needs the dense TF-IDF
+            # matrix (one scatter + traversal, still one device program).
+            self._fused_model = None
 
     @property
     def fused_model(self) -> LogisticRegression:
         """The serving model with IDF folded into the weights (raw-count input)."""
+        if self._fused_model is None:
+            raise TypeError("fused sparse scoring only applies to LogisticRegression")
         return self._fused_model
+
+    @classmethod
+    def from_checkpoint(cls, path: str, batch_size: int = 256) -> "ServingPipeline":
+        """Load a native checkpoint directory (checkpoint/native.py layout)."""
+        from fraud_detection_tpu.checkpoint.native import load_checkpoint
+
+        featurizer, model = load_checkpoint(path)
+        return cls(featurizer, model, batch_size=batch_size)
 
     @classmethod
     def from_spark_artifact(cls, artifact: SparkPipelineArtifact, batch_size: int = 256) -> "ServingPipeline":
@@ -87,8 +106,12 @@ class ServingPipeline:
         for start in range(0, len(texts), self.batch_size):
             chunk = list(texts[start : start + self.batch_size])
             n = len(chunk)
-            enc = self.featurizer.encode(chunk, batch_size=self.batch_size)
-            lab, p = linear_mod.predict_encoded(self._fused_model, enc)
+            if self._fused_model is not None:
+                enc = self.featurizer.encode(chunk, batch_size=self.batch_size)
+                lab, p = linear_mod.predict_encoded(self._fused_model, enc)
+            else:
+                dense = self.featurizer.featurize_dense(chunk, batch_size=self.batch_size)
+                lab, p = trees_mod.predict(self.model, dense)
             labels.append(np.asarray(lab)[:n])
             probs.append(np.asarray(p)[:n])
         if not labels:

@@ -1,0 +1,72 @@
+"""Native checkpoint round-trip tests + the training CLI end-to-end."""
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.checkpoint.native import load_checkpoint, save_checkpoint
+from fraud_detection_tpu.data import generate_corpus
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.models.pipeline import ServingPipeline
+from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_random_forest
+
+
+@pytest.fixture(scope="module")
+def small_setup():
+    corpus = generate_corpus(n=300, seed=5)
+    texts = [d.text for d in corpus]
+    y = np.asarray([d.label for d in corpus])
+    feat = HashingTfIdfFeaturizer(num_features=1024)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    return corpus, texts, y, feat, X
+
+
+def test_roundtrip_logistic(tmp_path, small_setup):
+    corpus, texts, y, feat, X = small_setup
+    model = fit_logistic_regression(X, y.astype(np.float32), max_iter=30)
+    save_checkpoint(str(tmp_path / "lr"), feat, model)
+    pipe = ServingPipeline.from_checkpoint(str(tmp_path / "lr"), batch_size=64)
+    orig = ServingPipeline(feat, model, batch_size=64)
+    a = orig.predict(texts[:50])
+    b = pipe.predict(texts[:50])
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_allclose(a.probabilities, b.probabilities, rtol=1e-6)
+
+
+def test_roundtrip_tree(tmp_path, small_setup):
+    corpus, texts, y, feat, X = small_setup
+    model = fit_random_forest(X, y, n_trees=8, tree_chunk=4,
+                              config=TreeTrainConfig(max_depth=4))
+    save_checkpoint(str(tmp_path / "rf"), feat, model)
+    pipe = ServingPipeline.from_checkpoint(str(tmp_path / "rf"), batch_size=64)
+    orig = ServingPipeline(feat, model, batch_size=64)
+    a = orig.predict(texts[:50])
+    b = pipe.predict(texts[:50])
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_allclose(a.probabilities, b.probabilities, rtol=1e-6)
+
+
+def test_load_rejects_foreign_dir(tmp_path):
+    (tmp_path / "manifest.json").write_text('{"format": "something_else"}')
+    with pytest.raises(ValueError, match="not a fraud_detection_tpu checkpoint"):
+        load_checkpoint(str(tmp_path))
+
+
+def test_train_cli_end_to_end(tmp_path, capsys):
+    from fraud_detection_tpu.app.train import main
+
+    out = tmp_path / "dt_model"
+    rc = main([
+        "--data", "synthetic", "--n", "240", "--models", "dt,lr",
+        "--num-features", "1024", "--n-trees", "4", "--n-rounds", "4",
+        "--save", f"dt={out}", "--json",
+    ])
+    assert rc == 0
+    captured = capsys.readouterr().out
+    assert '"Test"' in captured and '"accuracy"' in captured
+    pipe = ServingPipeline.from_checkpoint(str(out))
+    label, p = pipe.predict_one(
+        "Agent: Congratulations, you are the urgent winner! Verify your social "
+        "security number and pay the fee with gift cards immediately or be arrested.")
+    assert label in (0, 1) and 0.0 <= p <= 1.0

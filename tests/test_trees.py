@@ -1,0 +1,241 @@
+"""Tree ensemble tests: traversal semantics, Spark-stage decoding, trainers."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fraud_detection_tpu.checkpoint.spark_artifact import TreeEnsembleStage, TreeNode
+from fraud_detection_tpu.models.trees import (
+    TreeEnsemble,
+    feature_importances,
+    from_spark_stage,
+    predict,
+    predict_proba,
+)
+from fraud_detection_tpu.models.train_trees import (
+    TreeTrainConfig,
+    apply_bins,
+    fit_decision_tree,
+    fit_gradient_boosting,
+    fit_random_forest,
+    quantile_bin_edges,
+)
+
+
+def _manual_stump() -> TreeEnsemble:
+    # Single tree: root splits on feature 1 at 0.5; left leaf class 0 (3:1),
+    # right leaf class 1 (1:9).
+    return TreeEnsemble(
+        feature=jnp.array([[1, -1, -1]], jnp.int32),
+        threshold=jnp.array([[0.5, 0.0, 0.0]], jnp.float32),
+        left=jnp.array([[1, -1, -1]], jnp.int32),
+        right=jnp.array([[2, -1, -1]], jnp.int32),
+        leaf=jnp.array([[[0, 0], [3, 1], [1, 9]]], jnp.float32),
+        tree_weights=jnp.ones((1,)),
+        kind="decision_tree",
+        max_depth=1,
+    )
+
+
+def test_stump_traversal_boundary():
+    ens = _manual_stump()
+    x = jnp.array([[9.0, 0.5], [9.0, 0.50001], [9.0, -1.0]], jnp.float32)
+    pred, p1 = predict(ens, x)
+    # Spark semantics: go left iff value <= threshold (0.5 goes left).
+    assert np.asarray(pred).tolist() == [0, 1, 0]
+    np.testing.assert_allclose(np.asarray(p1), [0.25, 0.9, 0.25], rtol=1e-6)
+
+
+def test_random_forest_averaging_semantics():
+    # Two stumps voting differently: Spark averages per-tree probabilities.
+    base = _manual_stump()
+    ens = TreeEnsemble(
+        feature=jnp.concatenate([base.feature, base.feature]),
+        threshold=jnp.asarray([[0.5, 0, 0], [2.0, 0, 0]], jnp.float32),
+        left=jnp.concatenate([base.left, base.left]),
+        right=jnp.concatenate([base.right, base.right]),
+        leaf=jnp.asarray([[[0, 0], [3, 1], [1, 9]],
+                          [[0, 0], [1, 1], [0, 1]]], jnp.float32),
+        tree_weights=jnp.ones((2,)),
+        kind="random_forest",
+        max_depth=1,
+    )
+    x = jnp.array([[0.0, 1.0]], jnp.float32)  # tree1: right leaf; tree2: left leaf
+    proba = predict_proba(ens, x)
+    expected_p1 = (0.9 + 0.5) / 2
+    np.testing.assert_allclose(np.asarray(proba)[0, 1], expected_p1, rtol=1e-6)
+
+
+def test_gbt_margin_semantics():
+    ens = TreeEnsemble(
+        feature=jnp.array([[0, -1, -1]], jnp.int32),
+        threshold=jnp.array([[0.0, 0, 0]], jnp.float32),
+        left=jnp.array([[1, -1, -1]], jnp.int32),
+        right=jnp.array([[2, -1, -1]], jnp.int32),
+        leaf=jnp.array([[[0.0], [-0.7], [0.7]]], jnp.float32),
+        tree_weights=jnp.asarray([0.5]),
+        kind="gbt",
+        max_depth=1,
+    )
+    x = jnp.array([[1.0], [-1.0]], jnp.float32)
+    proba = predict_proba(ens, x)
+    # Spark GBT: p1 = sigmoid(2 * margin), margin = 0.5 * (+-0.7)
+    expected = 1 / (1 + np.exp(-2 * 0.5 * 0.7))
+    np.testing.assert_allclose(np.asarray(proba)[:, 1], [expected, 1 - expected], rtol=1e-5)
+
+
+def _spark_like_stage() -> TreeEnsembleStage:
+    # Spark preorder ids: root 0, children 1,2; node 1 splits into 3,4.
+    nodes = [
+        TreeNode(id=0, prediction=1, impurity=0.5, impurity_stats=np.array([10.0, 10.0]),
+                 gain=0.3, left=1, right=2, split_feature=2, split_threshold=1.5),
+        TreeNode(id=1, prediction=0, impurity=0.4, impurity_stats=np.array([8.0, 4.0]),
+                 gain=0.2, left=3, right=4, split_feature=0, split_threshold=-0.5),
+        TreeNode(id=2, prediction=1, impurity=0.1, impurity_stats=np.array([2.0, 6.0]),
+                 gain=-1.0, left=-1, right=-1, split_feature=-1, split_threshold=0.0),
+        TreeNode(id=3, prediction=0, impurity=0.0, impurity_stats=np.array([8.0, 0.0]),
+                 gain=-1.0, left=-1, right=-1, split_feature=-1, split_threshold=0.0),
+        TreeNode(id=4, prediction=1, impurity=0.0, impurity_stats=np.array([0.0, 4.0]),
+                 gain=-1.0, left=-1, right=-1, split_feature=-1, split_threshold=0.0),
+    ]
+    return TreeEnsembleStage(
+        kind="decision_tree", trees=[nodes], tree_weights=np.ones(1),
+        num_features=3, num_classes=2, features_col="features", label_col="label")
+
+
+def test_from_spark_stage_roundtrip():
+    ens = from_spark_stage(_spark_like_stage())
+    assert ens.max_depth == 2
+    x = jnp.array([
+        [-1.0, 0.0, 1.0],   # f2<=1.5 -> node1; f0<=-0.5 -> node3: class 0 (8:0)
+        [0.0, 0.0, 1.0],    # node1; f0>-0.5 -> node4: class 1 (0:4)
+        [0.0, 0.0, 2.0],    # f2>1.5 -> node2: class 1 (2:6)
+    ], jnp.float32)
+    pred, p1 = predict(ens, x)
+    assert np.asarray(pred).tolist() == [0, 1, 1]
+    np.testing.assert_allclose(np.asarray(p1), [0.0, 1.0, 0.75], atol=1e-6)
+
+
+def test_feature_importances_gain_weighted():
+    imp = feature_importances(_spark_like_stage(), 3)
+    assert imp.shape == (3,)
+    assert imp.sum() == pytest.approx(1.0)
+    assert imp[2] > imp[0] > 0 and imp[1] == 0.0  # f2: gain .3 x 20; f0: .2 x 12
+
+
+def test_binning_roundtrip_consistency():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 3)).astype(np.float32)
+    edges = quantile_bin_edges(X, 32)
+    assert edges.shape == (3, 31)
+    bins = np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges)))
+    # Contract: x <= edges[b] <=> bin(x) <= b (traversal/binning consistency).
+    for f in range(3):
+        for b in [0, 10, 30]:
+            if b < 31:
+                lhs = X[:, f] <= edges[f, b] if b < edges.shape[1] else np.ones(500, bool)
+                rhs = bins[:, f] <= b
+                np.testing.assert_array_equal(lhs, rhs)
+
+
+def test_decision_tree_learns_separable():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(600, 5)).astype(np.float32)
+    y = (X[:, 3] > 0.2).astype(np.int64)
+    ens = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=3))
+    pred, _ = predict(ens, jnp.asarray(X))
+    acc = np.mean(np.asarray(pred) == y)
+    assert acc > 0.97, acc
+    # The root must split on the informative feature.
+    assert int(np.asarray(ens.feature)[0, 0]) == 3
+
+
+def test_decision_tree_close_to_sklearn():
+    from sklearn.tree import DecisionTreeClassifier
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(800, 8)).astype(np.float32)
+    logits = 1.5 * X[:, 0] - 2.0 * X[:, 5] + X[:, 2] * X[:, 0]
+    y = (logits + rng.normal(0, 0.5, 800) > 0).astype(np.int64)
+    ours = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=5))
+    pred, _ = predict(ours, jnp.asarray(X))
+    acc_ours = np.mean(np.asarray(pred) == y)
+    sk = DecisionTreeClassifier(max_depth=5, random_state=0).fit(X, y)
+    acc_sk = sk.score(X, y)
+    assert acc_ours > acc_sk - 0.05, (acc_ours, acc_sk)
+
+
+def test_random_forest_beats_single_tree():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(500, 12)).astype(np.float32)
+    logits = X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logits + rng.normal(0, 0.8, 500) > 0).astype(np.int64)
+    Xte = rng.normal(size=(500, 12)).astype(np.float32)
+    yte = (Xte[:, 0] - Xte[:, 1] + 0.5 * Xte[:, 2] * Xte[:, 3] > 0).astype(np.int64)
+
+    dt = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=4))
+    rf = fit_random_forest(X, y, n_trees=24, seed=0,
+                           config=TreeTrainConfig(max_depth=4), tree_chunk=8)
+    acc = lambda m: np.mean(np.asarray(predict(m, jnp.asarray(Xte))[0]) == yte)
+    assert rf.num_trees == 24
+    assert acc(rf) >= acc(dt) - 0.02, (acc(rf), acc(dt))
+    assert acc(rf) > 0.75
+
+
+def test_gradient_boosting_converges():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = ((X[:, 1] > 0) ^ (X[:, 4] > 0)).astype(np.int64)  # XOR: needs depth
+    ens = fit_gradient_boosting(
+        X, y, n_rounds=30,
+        config=TreeTrainConfig(max_depth=3, criterion="xgb", learning_rate=0.3))
+    pred, p1 = predict(ens, jnp.asarray(X))
+    acc = np.mean(np.asarray(pred) == y)
+    assert acc > 0.95, acc
+
+
+def test_mesh_tree_training_matches_single_device():
+    from fraud_detection_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(301, 6)).astype(np.float32)  # odd n exercises padding
+    y = (X[:, 1] - X[:, 4] > 0).astype(np.int64)
+    cfg = TreeTrainConfig(max_depth=4)
+    single = fit_decision_tree(X, y, config=cfg)
+    sharded = fit_decision_tree(X, y, config=cfg, mesh=make_mesh())
+    # Identical data + deterministic splits => identical trees.
+    np.testing.assert_array_equal(np.asarray(single.feature), np.asarray(sharded.feature))
+    np.testing.assert_allclose(np.asarray(single.threshold), np.asarray(sharded.threshold))
+    np.testing.assert_allclose(np.asarray(single.leaf), np.asarray(sharded.leaf), rtol=1e-5)
+
+    gbt_single = fit_gradient_boosting(X, y, n_rounds=5, config=cfg)
+    gbt_sharded = fit_gradient_boosting(X, y, n_rounds=5, config=cfg, mesh=make_mesh())
+    xs = jnp.asarray(X)
+    np.testing.assert_allclose(
+        np.asarray(predict_proba(gbt_single, xs)),
+        np.asarray(predict_proba(gbt_sharded, xs)), atol=1e-4)
+
+
+def test_all_tree_models_on_synthetic_corpus():
+    from fraud_detection_tpu.data import generate_corpus, train_val_test_split
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+
+    corpus = generate_corpus(n=600, seed=11)
+    train, _, test = train_val_test_split(corpus, seed=42)
+    feat = HashingTfIdfFeaturizer(num_features=2048)
+    feat.fit_idf([d.text for d in train])
+    Xtr = np.asarray(feat.featurize_dense([d.text for d in train]))
+    ytr = np.asarray([d.label for d in train])
+    Xte = np.asarray(feat.featurize_dense([d.text for d in test]))
+    yte = np.asarray([d.label for d in test])
+
+    cfg = TreeTrainConfig(max_depth=5)
+    dt = fit_decision_tree(Xtr, ytr, config=cfg)
+    rf = fit_random_forest(Xtr, ytr, n_trees=16, tree_chunk=4, config=cfg)
+    xgb = fit_gradient_boosting(Xtr, ytr, n_rounds=20,
+                                config=TreeTrainConfig(max_depth=5, criterion="xgb"))
+    for name, m in [("dt", dt), ("rf", rf), ("xgb", xgb)]:
+        pred, _ = predict(m, jnp.asarray(Xte))
+        acc = np.mean(np.asarray(pred) == yte)
+        assert acc > 0.9, (name, acc)
