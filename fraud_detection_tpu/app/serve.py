@@ -1,0 +1,110 @@
+"""Streaming serve CLI — run the micro-batching classifier against a broker.
+
+The production counterpart of the reference's Streamlit tab-3 monitor loop
+(app_ui.py:168-248), runnable headless:
+
+    # real Kafka (reference-compatible env vars: KAFKA_BOOTSTRAP_SERVERS,
+    # KAFKA_INPUT_TOPIC, KAFKA_OUTPUT_TOPIC, KAFKA_CONSUMER_GROUP, SASL vars)
+    python -m fraud_detection_tpu.app.serve --model ./fraud_model --kafka
+
+    # self-contained demo/smoke: in-process broker fed with synthetic traffic
+    python -m fraud_detection_tpu.app.serve --model spark:/path/to/artifact \
+        --demo 5000 --batch-size 1024
+
+``--model`` accepts a native checkpoint dir, ``spark:<dir>`` for a Spark
+PipelineModel artifact, or ``synthetic`` to train a quick LR on the synthetic
+corpus at startup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_pipeline(spec: str, batch_size: int):
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+    if spec.startswith("spark:"):
+        from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
+
+        return ServingPipeline.from_spark_artifact(
+            load_spark_pipeline(spec[len("spark:"):]), batch_size=batch_size)
+    if spec == "synthetic":
+        from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+        return synthetic_demo_pipeline(batch_size)
+    return ServingPipeline.from_checkpoint(spec, batch_size=batch_size)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", required=True,
+                    help="native checkpoint dir | spark:<artifact dir> | synthetic")
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--max-wait", type=float, default=0.05,
+                    help="micro-batch assembly deadline (seconds)")
+    ap.add_argument("--kafka", action="store_true",
+                    help="use real Kafka via confluent_kafka + KAFKA_* env vars")
+    ap.add_argument("--demo", type=int, metavar="N", default=0,
+                    help="feed N synthetic messages through an in-process broker and exit")
+    ap.add_argument("--input-topic", default=os.getenv("KAFKA_INPUT_TOPIC", "customer-dialogues-raw"))
+    ap.add_argument("--output-topic", default=os.getenv("KAFKA_OUTPUT_TOPIC", "dialogues-classified"))
+    ap.add_argument("--max-messages", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    if args.kafka and args.demo:
+        raise SystemExit("--kafka and --demo are mutually exclusive")
+
+    from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+    from fraud_detection_tpu.stream.kafka import kafka_available
+
+    pipe = build_pipeline(args.model, args.batch_size)
+
+    if args.kafka:
+        if not kafka_available():
+            raise SystemExit("confluent_kafka is not installed; cannot use --kafka")
+        from fraud_detection_tpu.stream.kafka import KafkaConsumer, KafkaProducer
+
+        consumer, producer = KafkaConsumer([args.input_topic]), KafkaProducer()
+        max_messages, idle = args.max_messages, None
+    elif args.demo > 0:
+        from fraud_detection_tpu.data import generate_corpus
+
+        broker = InProcessBroker(num_partitions=3)
+        feeder = broker.producer()
+        corpus = generate_corpus(n=min(args.demo, 2000), seed=123)
+        for i in range(args.demo):
+            d = corpus[i % len(corpus)]
+            feeder.produce(args.input_topic,
+                           json.dumps({"text": d.text, "id": i}).encode(),
+                           key=str(i).encode())
+        consumer = broker.consumer([args.input_topic], "serve-demo")
+        producer = broker.producer()
+        max_messages, idle = args.demo, 1.0
+    else:
+        raise SystemExit("choose --kafka or --demo N (no broker specified)")
+
+    engine = StreamingClassifier(
+        pipe, consumer, producer, args.output_topic,
+        batch_size=args.batch_size, max_wait=args.max_wait)
+    print(f"serving: model={args.model} in={args.input_topic} out={args.output_topic} "
+          f"batch={args.batch_size}", flush=True)
+    try:
+        stats = engine.run(max_messages=max_messages, idle_timeout=idle)
+    except KeyboardInterrupt:
+        engine.stop()
+        stats = engine.stats
+    print(json.dumps(stats.as_dict()))
+    if args.demo:
+        n_out = broker.topic_size(args.output_topic)
+        print(f"classified messages on {args.output_topic}: {n_out}")
+    consumer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,3 +122,19 @@ class ServingPipeline:
         """Single-dialogue convenience (the reference's per-click path)."""
         batch = self.predict([text])
         return int(batch.labels[0]), float(batch.probabilities[0])
+
+
+def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 7,
+                            num_features: int = 10000) -> ServingPipeline:
+    """Train a quick LR on the synthetic corpus — the shared demo/bench
+    fallback pipeline (one recipe, used by bench.py and app/serve.py)."""
+    from fraud_detection_tpu.data import generate_corpus
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+
+    corpus = generate_corpus(n=n, seed=seed)
+    feat = HashingTfIdfFeaturizer(num_features=num_features)
+    feat.fit_idf([d.text for d in corpus])
+    X = np.asarray(feat.featurize_dense([d.text for d in corpus]))
+    y = np.asarray([d.label for d in corpus], np.float32)
+    model = fit_logistic_regression(X, y, max_iter=50)
+    return ServingPipeline(feat, model, batch_size=batch_size)

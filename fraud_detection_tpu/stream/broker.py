@@ -1,0 +1,174 @@
+"""Message transport interfaces + an in-process broker.
+
+The reference's streaming transport is Kafka via confluent_kafka
+(utils/kafka_utils.py: consumer with group id / earliest offsets / optional
+SASL_SSL, producer, topics customer-dialogues-raw -> dialogues-classified).
+This module defines the minimal consumer/producer protocol the serving engine
+needs, with two implementations:
+
+  * InProcessBroker — a partitioned, offset-tracked queue broker usable in
+    tests and benchmarks with byte-identical message semantics (this is the
+    injection seam the reference implicitly exposes at
+    utils/kafka_utils.py:11,33 — SURVEY.md §4 point 3).
+  * kafka.py — the real confluent_kafka client factories (same env vars as
+    the reference), import-gated so the framework works without the wheel.
+
+Semantics follow Kafka where it matters for the engine: per-partition FIFO,
+consumer offsets advance only on commit (the reference never commits — Q2 —
+and reprocesses from earliest on every restart; this engine commits after
+produce, deliberately fixing that and documenting the difference).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Protocol, Sequence
+
+
+@dataclass
+class Message:
+    topic: str
+    value: bytes
+    key: Optional[bytes] = None
+    partition: int = 0
+    offset: int = -1
+    timestamp: float = 0.0
+
+
+class Consumer(Protocol):
+    def poll(self, timeout: float = 1.0) -> Optional[Message]: ...
+    def poll_batch(self, max_messages: int, timeout: float) -> List[Message]: ...
+    def commit(self) -> None: ...
+    def close(self) -> None: ...
+
+
+class Producer(Protocol):
+    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None) -> None: ...
+
+    def flush(self, timeout: float = 10.0) -> int:
+        """Block until queued messages are delivered; returns how many are
+        STILL undelivered (0 = fully drained, matching confluent_kafka)."""
+        ...
+
+
+class InProcessBroker:
+    """Thread-safe partitioned topic store with Kafka-ish offset semantics."""
+
+    def __init__(self, num_partitions: int = 3):
+        self.num_partitions = num_partitions
+        self._topics: Dict[str, List[List[Message]]] = {}
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+
+    def _partitions(self, topic: str) -> List[List[Message]]:
+        with self._lock:
+            if topic not in self._topics:
+                self._topics[topic] = [[] for _ in range(self.num_partitions)]
+            return self._topics[topic]
+
+    def append(self, topic: str, value: bytes, key: Optional[bytes] = None) -> None:
+        parts = self._partitions(topic)
+        if key is not None:
+            idx = hash(key) % len(parts)
+        else:
+            idx = next(self._rr) % len(parts)
+        with self._lock:
+            part = parts[idx]
+            part.append(Message(topic=topic, value=value, key=key, partition=idx,
+                                offset=len(part), timestamp=time.time()))
+
+    def topic_size(self, topic: str) -> int:
+        parts = self._partitions(topic)
+        with self._lock:
+            return sum(len(p) for p in parts)
+
+    def messages(self, topic: str) -> List[Message]:
+        parts = self._partitions(topic)
+        with self._lock:
+            out = [m for p in parts for m in p]
+        return sorted(out, key=lambda m: (m.timestamp, m.partition, m.offset))
+
+    def consumer(self, topics: Sequence[str], group_id: str = "default") -> "InProcessConsumer":
+        return InProcessConsumer(self, list(topics), group_id)
+
+    def producer(self) -> "InProcessProducer":
+        return InProcessProducer(self)
+
+
+class InProcessConsumer:
+    """Earliest-offset consumer with manual commit (auto-commit off, like the
+    reference's config — utils/kafka_utils.py:16-17)."""
+
+    def __init__(self, broker: InProcessBroker, topics: List[str], group_id: str):
+        self.broker = broker
+        self.topics = topics
+        self.group_id = group_id
+        # committed/position per (topic, partition)
+        self._position: Dict[tuple, int] = {}
+        self._committed: Dict[tuple, int] = {}
+        self._closed = False
+
+    def _next_from(self, topic: str, part_idx: int) -> Optional[Message]:
+        parts = self.broker._partitions(topic)
+        key = (topic, part_idx)
+        pos = self._position.get(key, 0)
+        with self.broker._lock:
+            part = parts[part_idx]
+            if pos < len(part):
+                self._position[key] = pos + 1
+                return part[pos]
+        return None
+
+    def poll(self, timeout: float = 1.0) -> Optional[Message]:
+        deadline = time.time() + timeout
+        while True:
+            for topic in self.topics:
+                for p in range(self.broker.num_partitions):
+                    msg = self._next_from(topic, p)
+                    if msg is not None:
+                        return msg
+            if time.time() >= deadline:
+                return None
+            time.sleep(0.001)
+
+    def poll_batch(self, max_messages: int, timeout: float) -> List[Message]:
+        """Drain up to max_messages; waits at most ``timeout`` for the first."""
+        out: List[Message] = []
+        first = self.poll(timeout)
+        if first is None:
+            return out
+        out.append(first)
+        while len(out) < max_messages:
+            msg = self.poll(0.0)
+            if msg is None:
+                break
+            out.append(msg)
+        return out
+
+    def commit(self) -> None:
+        self._committed.update(self._position)
+
+    def committed_offsets(self) -> Dict[tuple, int]:
+        return dict(self._committed)
+
+    def seek_to_committed(self) -> None:
+        """Simulate a restart: resume from the last committed offsets."""
+        self._position = dict(self._committed)
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class InProcessProducer:
+    def __init__(self, broker: InProcessBroker):
+        self.broker = broker
+        self._pending = 0
+
+    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None) -> None:
+        self.broker.append(topic, value, key)
+
+    def flush(self, timeout: float = 10.0) -> int:
+        return 0  # in-process appends are synchronous; nothing can be pending

@@ -1,0 +1,179 @@
+"""Micro-batching streaming classification engine — the headline serving path.
+
+Replaces the reference's tab-3 loop (app_ui.py:195-248), which per message ran
+a full Spark job plus a synchronous LLM round-trip and a producer flush
+(SURVEY.md §3.3 — the throughput ceiling this framework exists to remove).
+
+Engine shape: drain the consumer into a micro-batch (up to ``batch_size``
+messages, waiting at most ``max_wait`` for the first), JSON-decode on the
+host, featurize + score the whole batch in one jitted device program, produce
+classified results, THEN flush and commit offsets — at-least-once semantics
+with committed progress (deliberately fixing the reference's never-committed
+offsets, Q2: its restart semantics reprocessed the topic from earliest).
+
+Malformed messages (bad JSON / missing text field) are counted and routed to
+the output with an error marker instead of killing the loop (the reference
+raised and died — app_ui.py:200-201).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from fraud_detection_tpu.models.pipeline import ServingPipeline
+from fraud_detection_tpu.stream.broker import Consumer, Message, Producer
+
+
+@dataclass
+class StreamStats:
+    processed: int = 0
+    malformed: int = 0
+    batches: int = 0
+    commits_skipped: int = 0  # producer didn't drain; offsets left uncommitted
+    elapsed: float = 0.0
+    batch_latency_sum: float = 0.0
+    batch_latency_max: float = 0.0
+
+    @property
+    def msgs_per_sec(self) -> float:
+        return self.processed / self.elapsed if self.elapsed > 0 else 0.0
+
+    @property
+    def mean_batch_latency(self) -> float:
+        return self.batch_latency_sum / self.batches if self.batches else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "processed": self.processed,
+            "malformed": self.malformed,
+            "batches": self.batches,
+            "commits_skipped": self.commits_skipped,
+            "elapsed_sec": round(self.elapsed, 4),
+            "msgs_per_sec": round(self.msgs_per_sec, 1),
+            "mean_batch_latency_sec": round(self.mean_batch_latency, 5),
+            "max_batch_latency_sec": round(self.batch_latency_max, 5),
+        }
+
+
+class StreamingClassifier:
+    """Consumer -> micro-batch -> TPU scoring -> producer, with offset commits.
+
+    ``explain_fn`` (optional) is called per classified message with
+    (text, label, confidence) and its return value attached as "analysis" —
+    the hook where the LLM explanation layer (explain/) plugs in; keep it
+    sampled/async for throughput, unlike the reference's blocking per-message
+    DeepSeek call.
+    """
+
+    def __init__(
+        self,
+        pipeline: ServingPipeline,
+        consumer: Consumer,
+        producer: Producer,
+        output_topic: str,
+        *,
+        batch_size: int = 1024,
+        max_wait: float = 0.05,
+        text_field: str = "text",
+        explain_fn: Optional[Callable[[str, int, float], Optional[str]]] = None,
+    ):
+        self.pipeline = pipeline
+        self.consumer = consumer
+        self.producer = producer
+        self.output_topic = output_topic
+        self.batch_size = batch_size
+        self.max_wait = max_wait
+        self.text_field = text_field
+        self.explain_fn = explain_fn
+        self.stats = StreamStats()
+        self._running = False
+
+    def stop(self) -> None:
+        self._running = False
+
+    def _decode(self, msg: Message) -> Optional[str]:
+        try:
+            payload = json.loads(msg.value.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return None
+        text = payload.get(self.text_field) if isinstance(payload, dict) else None
+        return text if isinstance(text, str) else None
+
+    def process_batch(self, msgs: List[Message]) -> int:
+        """Score one micro-batch and emit results. Returns messages handled."""
+        t0 = time.perf_counter()
+        texts: List[Optional[str]] = [self._decode(m) for m in msgs]
+        valid_idx = [i for i, t in enumerate(texts) if t is not None]
+        preds = self.pipeline.predict([texts[i] for i in valid_idx]) if valid_idx else None
+
+        results: List[Optional[tuple]] = [None] * len(msgs)
+        for j, i in enumerate(valid_idx):
+            results[i] = (int(preds.labels[j]), float(preds.probabilities[j]))
+
+        for msg, text, res in zip(msgs, texts, results):
+            if res is None:
+                self.stats.malformed += 1
+                out = {"error": "malformed message", "prediction": None,
+                       "original": msg.value.decode("utf-8", "replace")[:500]}
+            else:
+                label, p1 = res
+                confidence = p1 if label == 1 else 1.0 - p1
+                out = {
+                    "prediction": "scam" if label == 1 else "non-scam",
+                    "label": label,
+                    "confidence": round(confidence, 6),
+                    "original_text": text,
+                }
+                if self.explain_fn is not None:
+                    analysis = self.explain_fn(text, label, confidence)
+                    if analysis is not None:
+                        out["analysis"] = analysis
+            self.producer.produce(self.output_topic, json.dumps(out).encode(), key=msg.key)
+
+        # Produce-then-commit: at-least-once with durable progress (fixes Q2).
+        # Commit ONLY if the producer fully drained — committing past
+        # undelivered outputs would silently drop messages; leaving the offset
+        # uncommitted means they reprocess after restart (at-least-once kept).
+        undelivered = self.producer.flush()
+        if undelivered:
+            self.stats.commits_skipped += 1
+        else:
+            self.consumer.commit()
+
+        dt = time.perf_counter() - t0
+        self.stats.processed += len(msgs)
+        self.stats.batches += 1
+        self.stats.batch_latency_sum += dt
+        self.stats.batch_latency_max = max(self.stats.batch_latency_max, dt)
+        return len(msgs)
+
+    def run(self, max_messages: Optional[int] = None,
+            idle_timeout: Optional[float] = None) -> StreamStats:
+        """Run the loop until stopped, ``max_messages`` handled, or the input
+        stays empty for ``idle_timeout`` seconds."""
+        self._running = True
+        started = time.perf_counter()
+        idle_since: Optional[float] = None
+        try:
+            while self._running:
+                budget = self.batch_size
+                if max_messages is not None:
+                    budget = min(budget, max_messages - self.stats.processed)
+                    if budget <= 0:
+                        break
+                msgs = self.consumer.poll_batch(budget, self.max_wait)
+                if not msgs:
+                    now = time.perf_counter()
+                    idle_since = idle_since or now
+                    if idle_timeout is not None and now - idle_since >= idle_timeout:
+                        break
+                    continue
+                idle_since = None
+                self.process_batch(msgs)
+        finally:
+            # Interrupt-safe: Ctrl-C lands here with correct elapsed stats.
+            self.stats.elapsed = time.perf_counter() - started
+        return self.stats

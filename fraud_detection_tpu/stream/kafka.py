@@ -1,0 +1,101 @@
+"""Real Kafka client factories (confluent_kafka), env-compatible with the reference.
+
+Reads the same environment variables as the reference's utils/kafka_utils.py:
+KAFKA_BOOTSTRAP_SERVERS, KAFKA_INPUT_TOPIC, KAFKA_OUTPUT_TOPIC,
+KAFKA_CONSUMER_GROUP, KAFKA_SECURITY_PROTOCOL, KAFKA_USERNAME, KAFKA_PASSWORD
+(names documented in SURVEY.md Q8). Configuration mirrors the reference —
+earliest offsets, auto-commit off, optional SASL_SSL — but the serving engine
+actually commits offsets after producing results, deliberately fixing the
+reference's never-committed-offsets behavior (Q2).
+
+confluent_kafka (librdkafka) is import-gated: ``kafka_available()`` reports
+whether the wheel is present, and the engine falls back to InProcessBroker in
+environments without it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from fraud_detection_tpu.stream.broker import Message
+
+try:  # pragma: no cover - exercised only where the wheel exists
+    import confluent_kafka as _ck
+except ImportError:  # pragma: no cover
+    _ck = None
+
+
+def kafka_available() -> bool:
+    return _ck is not None
+
+
+def _require():
+    if _ck is None:
+        raise RuntimeError(
+            "confluent_kafka is not installed; use stream.broker.InProcessBroker "
+            "or install librdkafka's python client")
+
+
+def _security_config() -> dict:
+    cfg = {}
+    if os.getenv("KAFKA_SECURITY_PROTOCOL", "").upper() == "SASL_SSL":
+        cfg.update({
+            "security.protocol": "SASL_SSL",
+            "sasl.mechanisms": "PLAIN",
+            "sasl.username": os.getenv("KAFKA_USERNAME", ""),
+            "sasl.password": os.getenv("KAFKA_PASSWORD", ""),
+        })
+    return cfg
+
+
+class KafkaConsumer:
+    """confluent_kafka consumer adapted to the engine's poll_batch protocol."""
+
+    def __init__(self, topics: Optional[List[str]] = None,
+                 bootstrap: Optional[str] = None, group_id: Optional[str] = None):
+        _require()
+        conf = {
+            "bootstrap.servers": bootstrap or os.getenv("KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
+            "group.id": group_id or os.getenv("KAFKA_CONSUMER_GROUP", "dialogue-classifier-group"),
+            "auto.offset.reset": "earliest",
+            "enable.auto.commit": False,
+            **_security_config(),
+        }
+        self._consumer = _ck.Consumer(conf)
+        self._consumer.subscribe(topics or [os.getenv("KAFKA_INPUT_TOPIC", "customer-dialogues-raw")])
+
+    def poll(self, timeout: float = 1.0) -> Optional[Message]:
+        msg = self._consumer.poll(timeout)
+        if msg is None or msg.error():
+            return None
+        return Message(topic=msg.topic(), value=msg.value(), key=msg.key(),
+                       partition=msg.partition(), offset=msg.offset())
+
+    def poll_batch(self, max_messages: int, timeout: float) -> List[Message]:
+        msgs = self._consumer.consume(num_messages=max_messages, timeout=timeout)
+        return [Message(topic=m.topic(), value=m.value(), key=m.key(),
+                        partition=m.partition(), offset=m.offset())
+                for m in msgs if m is not None and not m.error()]
+
+    def commit(self) -> None:
+        self._consumer.commit(asynchronous=False)
+
+    def close(self) -> None:
+        self._consumer.close()
+
+
+class KafkaProducer:
+    def __init__(self, bootstrap: Optional[str] = None):
+        _require()
+        self._producer = _ck.Producer({
+            "bootstrap.servers": bootstrap or os.getenv("KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
+            **_security_config(),
+        })
+
+    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None) -> None:
+        self._producer.produce(topic, value=value, key=key)
+
+    def flush(self, timeout: float = 10.0) -> int:
+        # confluent_kafka returns the number of messages still in the queue.
+        return int(self._producer.flush(timeout))
