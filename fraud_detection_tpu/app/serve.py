@@ -84,7 +84,8 @@ def main(argv=None) -> int:
                            key=str(i).encode())
         consumer = broker.consumer([args.input_topic], "serve-demo")
         producer = broker.producer()
-        max_messages, idle = args.demo, 1.0
+        max_messages = args.max_messages if args.max_messages is not None else args.demo
+        idle = 1.0
     else:
         raise SystemExit("choose --kafka or --demo N (no broker specified)")
 

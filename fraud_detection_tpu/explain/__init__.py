@@ -1,0 +1,37 @@
+"""Explanation layer: pluggable LLM backends + classification agent.
+
+Replaces /root/reference/utils/agent_api.py (DeepSeekAPI / DeepSeekAnalyzer /
+DeepSeekClassificationAgent) with an interface-first design: one
+OpenAI-compatible HTTP backend covering both hosted DeepSeek and local
+servers, a canned backend for tests/offline, an on-pod JAX backend
+(explain/onpod.py), and an agent that classifies on-device and explains
+through whichever backend is plugged in.
+"""
+
+from fraud_detection_tpu.explain.agent import FraudAnalysisAgent
+from fraud_detection_tpu.explain.backends import (
+    BackendError,
+    CannedBackend,
+    LLMBackend,
+    OpenAIChatBackend,
+)
+from fraud_detection_tpu.explain.history import HistoricalCaseStore
+from fraud_detection_tpu.explain.onpod import OnPodBackend
+from fraud_detection_tpu.explain.prompts import (
+    analysis_prompt,
+    historical_insight_prompt,
+    label_name,
+)
+
+__all__ = [
+    "FraudAnalysisAgent",
+    "BackendError",
+    "CannedBackend",
+    "LLMBackend",
+    "OpenAIChatBackend",
+    "OnPodBackend",
+    "HistoricalCaseStore",
+    "analysis_prompt",
+    "historical_insight_prompt",
+    "label_name",
+]

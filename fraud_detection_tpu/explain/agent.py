@@ -1,0 +1,86 @@
+"""Classification + explanation agent — the app-facing orchestration layer.
+
+Capability parity with ``DeepSeekClassificationAgent``
+(/root/reference/utils/agent_api.py:124-208) minus its pathologies:
+
+* scoring is one batched device program via ``ServingPipeline`` instead of a
+  per-call 3-job Spark run (SURVEY.md Q7);
+* ``classify_and_explain`` scores ONCE — the reference re-ran the full Spark
+  scoring inside it after the caller had already scored (agent_api.py:179,
+  app_ui.py:93+116);
+* the analyzer/backend is owned by the agent and reused — the reference
+  rebuilt a fresh ``DeepSeekAnalyzer`` on every UI click (Q5);
+* historical insight uses a real cosine top-k store (explain/history.py), not
+  the ``limit(n)`` placeholder (agent_api.py:147-153).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+from fraud_detection_tpu.explain.backends import BackendError, CannedBackend, LLMBackend
+from fraud_detection_tpu.explain.history import HistoricalCaseStore
+from fraud_detection_tpu.explain.prompts import (
+    analysis_prompt,
+    historical_insight_prompt,
+    label_name,
+)
+from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+
+@dataclass
+class FraudAnalysisAgent:
+    """Serving pipeline + LLM backend + optional historical store."""
+
+    pipeline: ServingPipeline
+    backend: LLMBackend = field(default_factory=CannedBackend)
+    history: Optional[HistoricalCaseStore] = None
+    temperature: float = 1.0
+
+    def load_history(self, texts: Sequence[str], labels: Sequence[int]) -> None:
+        """Install a historical corpus (the UI's CSV-upload path,
+        app_ui.py:56-64) indexed with the pipeline's own featurizer."""
+        self.history = HistoricalCaseStore(self.pipeline.featurizer, texts, labels)
+
+    def predict_and_get_label(self, text: str) -> Dict:
+        """Classifier-only result: {prediction, label, confidence}."""
+        pred, prob = self.pipeline.predict_one(text)
+        return {
+            "prediction": pred,
+            "label": label_name(pred),
+            # p of the predicted class, matching the UI's confidence metric
+            "confidence": prob if pred == 1 else 1.0 - prob,
+            "probability_scam": prob,
+        }
+
+    def classify_and_explain(self, text: str, *,
+                             temperature: Optional[float] = None,
+                             with_history: bool = True,
+                             history_k: int = 3) -> Dict:
+        """Classify once, then explain; LLM failures degrade, not crash.
+
+        Returns {prediction, label, confidence, probability_scam, analysis,
+        historical_insight?, error?}.
+        """
+        result = self.predict_and_get_label(text)
+        temp = self.temperature if temperature is None else temperature
+        try:
+            result["analysis"] = self.backend.generate(
+                analysis_prompt(text, result["prediction"], result["confidence"]),
+                temperature=temp)
+        except BackendError as exc:
+            result["analysis"] = None
+            result["error"] = str(exc)
+            return result
+
+        if with_history and self.history is not None and len(self.history):
+            cases = self.history.find_similar(text, k=history_k)
+            if cases:
+                try:
+                    result["historical_insight"] = self.backend.generate(
+                        historical_insight_prompt(text, cases), temperature=temp)
+                    result["similar_cases"] = cases
+                except BackendError as exc:
+                    result["error"] = str(exc)
+        return result

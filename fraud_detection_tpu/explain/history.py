@@ -1,0 +1,68 @@
+"""Historical-case similarity search — a real one.
+
+The reference's ``find_similar_historical_cases`` is an explicit placeholder
+that ignores the query and returns ``historical_data.limit(n)``
+(/root/reference/utils/agent_api.py:147-153).  This store implements the
+capability it stood in for: L2-normalized TF-IDF rows held as one device
+matrix, cosine top-k as a single jitted matvec + ``lax.top_k`` — the same
+hashing featurizer as the classifier, so the store costs no extra vocab
+state and any transcript length collapses to the fixed feature width.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _top_k_cosine(matrix: jax.Array, query: jax.Array, k: int):
+    sims = matrix @ query  # rows pre-normalized, query normalized below
+    return jax.lax.top_k(sims, k)
+
+
+class HistoricalCaseStore:
+    """In-memory corpus of labeled past dialogues with cosine top-k lookup."""
+
+    def __init__(self, featurizer: HashingTfIdfFeaturizer,
+                 texts: Sequence[str], labels: Sequence[int],
+                 batch_size: int = 256):
+        if len(texts) != len(labels):
+            raise ValueError(f"{len(texts)} texts vs {len(labels)} labels")
+        self.featurizer = featurizer
+        self.texts: List[str] = list(texts)
+        self.labels = np.asarray(labels, np.int32)
+        chunks = []
+        for start in range(0, len(self.texts), batch_size):
+            chunk = self.texts[start : start + batch_size]
+            chunks.append(np.asarray(
+                featurizer.featurize_dense(chunk, batch_size=batch_size),
+                np.float32)[: len(chunk)])
+        dense = (np.concatenate(chunks) if chunks
+                 else np.empty((0, featurizer.num_features), np.float32))
+        norms = np.linalg.norm(dense, axis=1, keepdims=True)
+        self._matrix = jnp.asarray(dense / np.maximum(norms, 1e-12))
+
+    def __len__(self) -> int:
+        return len(self.texts)
+
+    def find_similar(self, text: str, k: int = 3) -> List[Tuple[str, int, float]]:
+        """Top-k most similar cases as (text, label, cosine similarity)."""
+        k = min(k, len(self.texts))
+        if k == 0:
+            return []
+        row = np.asarray(
+            self.featurizer.featurize_dense([text], batch_size=1), np.float32)[0]
+        norm = float(np.linalg.norm(row))
+        if norm == 0.0:  # no in-vocabulary tokens: nothing meaningful to rank
+            return []
+        sims, idx = _top_k_cosine(self._matrix, jnp.asarray(row / norm), k)
+        sims, idx = np.asarray(sims), np.asarray(idx)
+        return [(self.texts[i], int(self.labels[i]), float(s))
+                for i, s in zip(idx, sims)]

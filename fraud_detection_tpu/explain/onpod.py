@@ -1,0 +1,51 @@
+"""On-pod LLM backend: explanations served from the TPU itself.
+
+The third transport option BASELINE.json asks for (config 5): instead of an
+HTTPS round-trip to DeepSeek (/root/reference/utils/agent_api.py:36) or a
+local OpenAI-compatible server (/root/reference/deepseek_chat_ui.py:9), the
+explanation model runs as a JAX program on the same pod as the classifier —
+zero external API, zero egress.
+
+``OnPodBackend`` adapts any ``generate_fn(prompt, temperature, max_tokens) ->
+str`` to the ``LLMBackend`` interface, flattening chat history into a single
+prompt the way small instruction-tuned models expect.  ``from_model`` binds it
+to this framework's JAX decoder (models/llm.py) with tensor-parallel sharding
+and ring attention for long transcripts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from fraud_detection_tpu.explain.backends import ChatMessage, _GenerateMixin
+
+
+def flatten_chat(messages: Sequence[ChatMessage]) -> str:
+    """Render a chat transcript as a single plain-text prompt."""
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        parts.append(f"<|{role}|>\n{m.get('content', '')}")
+    parts.append("<|assistant|>\n")
+    return "\n".join(parts)
+
+
+@dataclass
+class OnPodBackend(_GenerateMixin):
+    """LLMBackend over an in-process generation function."""
+
+    generate_fn: Callable[[str, float, int], str]
+
+    def chat(self, messages: Sequence[ChatMessage], *, temperature: float = 1.0,
+             max_tokens: int = 1000) -> str:
+        return self.generate_fn(flatten_chat(messages), temperature, max_tokens)
+
+    @classmethod
+    def from_model(cls, lm, *, mesh=None) -> "OnPodBackend":
+        """Bind to a models/llm.py ``LanguageModel`` (optionally sharded)."""
+        def generate_fn(prompt: str, temperature: float, max_tokens: int) -> str:
+            return lm.generate_text(prompt, temperature=temperature,
+                                    max_new_tokens=max_tokens, mesh=mesh)
+
+        return cls(generate_fn)

@@ -1,0 +1,61 @@
+"""Prompt templates for the explanation layer, kept as data.
+
+The reference embeds its prompts inline in code: a structured analysis prompt
+(content examination / classification assessment / recommended actions —
+/root/reference/utils/agent_api.py:83-118) and a historical-comparison prompt
+(/root/reference/utils/agent_api.py:196-201).  Here they are standalone
+template functions with the same information content (dialogue, predicted
+label, confidence, similar past cases) so any backend — hosted, local server,
+or on-pod — renders identical requests and tests can assert on them.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+LABEL_NAMES = {0: "Normal Conversation", 1: "Potential Scam"}
+
+
+def label_name(prediction: int) -> str:
+    return LABEL_NAMES.get(int(prediction), str(prediction))
+
+
+def analysis_prompt(dialogue: str, prediction: int, confidence: float) -> str:
+    """Structured explanation request for one classified dialogue."""
+    return (
+        "A phone-call transcript was classified by a fraud-detection model.\n"
+        f"Predicted class: {label_name(prediction)} "
+        f"(confidence {confidence:.1%}).\n\n"
+        "Transcript:\n"
+        f"---\n{dialogue}\n---\n\n"
+        "Provide a structured analysis with exactly these sections:\n"
+        "1. Content examination — quote the specific phrases or patterns in "
+        "the transcript that support or contradict the predicted class "
+        "(urgency tactics, requests for payment or personal data, "
+        "impersonation of institutions, pressure to stay on the line).\n"
+        "2. Classification assessment — state whether you agree with the "
+        "model's call and how the stated confidence squares with the "
+        "evidence.\n"
+        "3. Recommended actions — concrete next steps for the recipient "
+        "and, if this is a scam, how to report it.\n"
+    )
+
+
+def historical_insight_prompt(dialogue: str,
+                              cases: Sequence[Tuple[str, int, float]]) -> str:
+    """Comparison against similar past cases.
+
+    ``cases`` rows are (text, label, similarity in [0,1]).
+    """
+    lines = []
+    for i, (text, label, sim) in enumerate(cases, 1):
+        snippet = text if len(text) <= 400 else text[:400] + "…"
+        lines.append(f"Case {i} [{label_name(label)}, similarity {sim:.2f}]: {snippet}")
+    joined = "\n".join(lines) if lines else "(no similar cases on record)"
+    return (
+        "Compare the new transcript below against these similar historical "
+        "cases and say what the pattern suggests — recurring script, shared "
+        "tactics, or notable differences.\n\n"
+        f"Historical cases:\n{joined}\n\n"
+        f"New transcript:\n---\n{dialogue}\n---\n"
+    )

@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from fraud_detection_tpu.explain.prompts import label_name
 from fraud_detection_tpu.models.pipeline import ServingPipeline
 from fraud_detection_tpu.stream.broker import Consumer, Message, Producer
 
@@ -121,9 +122,11 @@ class StreamingClassifier:
             else:
                 label, p1 = res
                 confidence = p1 if label == 1 else 1.0 - p1
+                # Same field semantics as FraudAnalysisAgent.predict_and_get_label:
+                # prediction = int class, label = display name.
                 out = {
-                    "prediction": "scam" if label == 1 else "non-scam",
-                    "label": label,
+                    "prediction": label,
+                    "label": label_name(label),
                     "confidence": round(confidence, 6),
                     "original_text": text,
                 }
@@ -135,11 +138,15 @@ class StreamingClassifier:
 
         # Produce-then-commit: at-least-once with durable progress (fixes Q2).
         # Commit ONLY if the producer fully drained — committing past
-        # undelivered outputs would silently drop messages; leaving the offset
-        # uncommitted means they reprocess after restart (at-least-once kept).
+        # undelivered outputs would silently drop messages. Skipping the
+        # commit only preserves at-least-once if we also STOP: continuing
+        # would let the next batch's commit advance the position past this
+        # batch's offsets and orphan the lost outputs. Restart re-consumes
+        # from the last committed offset and re-drives this batch.
         undelivered = self.producer.flush()
         if undelivered:
             self.stats.commits_skipped += 1
+            self._running = False
         else:
             self.consumer.commit()
 

@@ -92,10 +92,21 @@ class KafkaProducer:
             "bootstrap.servers": bootstrap or os.getenv("KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
             **_security_config(),
         })
+        self._delivery_failures = 0
+
+    def _on_delivery(self, err, msg) -> None:
+        if err is not None:
+            self._delivery_failures += 1
 
     def produce(self, topic: str, value: bytes, key: Optional[bytes] = None) -> None:
-        self._producer.produce(topic, value=value, key=key)
+        self._producer.produce(topic, value=value, key=key,
+                               on_delivery=self._on_delivery)
 
     def flush(self, timeout: float = 10.0) -> int:
-        # confluent_kafka returns the number of messages still in the queue.
-        return int(self._producer.flush(timeout))
+        """Returns the number of messages NOT durably delivered: still queued
+        plus terminally failed. Terminal failures (e.g. message too large)
+        leave librdkafka's queue but must still block the engine's offset
+        commit, or the lost outputs would never be reprocessed."""
+        remaining = int(self._producer.flush(timeout))
+        failed, self._delivery_failures = self._delivery_failures, 0
+        return remaining + failed

@@ -1,0 +1,247 @@
+"""Tests for the explanation layer (backends, prompts, history, agent).
+
+The reference has no tests for its LLM layer (SURVEY.md §4); the strategy here
+is the one its seams suggest: canned backend for agent logic, an injected
+fake transport for the HTTP client (retry/timeout semantics of
+utils/agent_api.py:33-77), and the similarity store validated against an
+obvious nearest neighbour.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.explain import (
+    BackendError,
+    CannedBackend,
+    FraudAnalysisAgent,
+    HistoricalCaseStore,
+    OpenAIChatBackend,
+    analysis_prompt,
+    historical_insight_prompt,
+)
+from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+
+# ---------------------------------------------------------------------------
+# fakes
+# ---------------------------------------------------------------------------
+
+class FakeResponse:
+    def __init__(self, payload, status=200):
+        self.payload = payload
+        self.status = status
+
+    def raise_for_status(self):
+        if self.status >= 400:
+            raise RuntimeError(f"HTTP {self.status}")
+
+    def json(self):
+        return self.payload
+
+
+def chat_payload(text):
+    return {"choices": [{"message": {"role": "assistant", "content": text}}]}
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    return synthetic_demo_pipeline(batch_size=32, n=200, seed=11)
+
+
+# ---------------------------------------------------------------------------
+# OpenAIChatBackend transport semantics
+# ---------------------------------------------------------------------------
+
+def test_backend_posts_openai_payload():
+    seen = {}
+
+    def transport(url, headers=None, json=None, timeout=None):
+        seen.update(url=url, headers=headers, payload=json, timeout=timeout)
+        return FakeResponse(chat_payload("ok"))
+
+    be = OpenAIChatBackend(base_url="http://localhost:1234/v1", model="m",
+                           api_key="sk-test", transport=transport)
+    out = be.generate("hello", temperature=0.3, max_tokens=77)
+    assert out == "ok"
+    assert seen["url"] == "http://localhost:1234/v1/chat/completions"
+    assert seen["headers"]["Authorization"] == "Bearer sk-test"
+    assert seen["timeout"] == 90.0
+    assert seen["payload"]["temperature"] == 0.3
+    assert seen["payload"]["max_tokens"] == 77
+    assert seen["payload"]["messages"][0]["role"] == "system"
+    assert seen["payload"]["messages"][1] == {"role": "user", "content": "hello"}
+
+
+def test_backend_retries_connection_errors_then_succeeds():
+    calls, naps = [], []
+
+    def transport(url, **kw):
+        calls.append(url)
+        if len(calls) < 3:
+            raise ConnectionError("refused")
+        return FakeResponse(chat_payload("recovered"))
+
+    be = OpenAIChatBackend(base_url="http://x/v1", model="m",
+                           transport=transport, sleep=naps.append)
+    assert be.generate("p") == "recovered"
+    assert len(calls) == 3
+    assert naps == [2.0, 4.0]  # exponential, capped at 10 like the reference
+
+
+def test_backend_exhausts_retries():
+    def transport(url, **kw):
+        raise ConnectionError("down")
+
+    be = OpenAIChatBackend(base_url="http://x/v1", model="m",
+                           transport=transport, sleep=lambda s: None)
+    with pytest.raises(BackendError):
+        be.generate("p")
+
+
+def test_backend_does_not_retry_malformed_response():
+    calls = []
+
+    def transport(url, **kw):
+        calls.append(1)
+        return FakeResponse({"unexpected": True})
+
+    be = OpenAIChatBackend(base_url="http://x/v1", model="m", transport=transport)
+    with pytest.raises(BackendError):
+        be.generate("p")
+    assert len(calls) == 1
+
+
+def test_deepseek_preset():
+    be = OpenAIChatBackend.deepseek("key", transport=lambda *a, **k: FakeResponse(chat_payload("x")))
+    assert be.base_url == "https://api.deepseek.com/v1"
+    assert be.model == "deepseek-chat"
+
+
+# ---------------------------------------------------------------------------
+# prompts
+# ---------------------------------------------------------------------------
+
+def test_analysis_prompt_embeds_facts():
+    p = analysis_prompt("Hello, this is the IRS.", 1, 0.97)
+    assert "Hello, this is the IRS." in p
+    assert "Potential Scam" in p
+    assert "97.0%" in p
+    for section in ("Content examination", "Classification assessment",
+                    "Recommended actions"):
+        assert section in p
+
+
+def test_historical_prompt_lists_cases():
+    p = historical_insight_prompt("new one", [("old scam", 1, 0.91), ("fine", 0, 0.5)])
+    assert "old scam" in p and "fine" in p
+    assert "similarity 0.91" in p
+    assert "new one" in p
+    assert "no similar cases" in historical_insight_prompt("t", [])
+
+
+# ---------------------------------------------------------------------------
+# history store
+# ---------------------------------------------------------------------------
+
+def test_history_finds_near_duplicate(pipeline):
+    texts = [
+        "agent: you have won a cash prize call now to claim your reward",
+        "customer: can we reschedule my dentist appointment to friday",
+        "agent: your social security number has been suspended pay immediately",
+    ]
+    store = HistoricalCaseStore(pipeline.featurizer, texts, [1, 0, 1])
+    hits = store.find_similar(
+        "agent: congratulations you won a big cash prize claim your reward now", k=2)
+    assert hits[0][0] == texts[0]
+    assert hits[0][1] == 1
+    assert hits[0][2] > 0.3
+    assert hits[0][2] > hits[1][2]
+
+
+def test_history_empty_and_oov(pipeline):
+    store = HistoricalCaseStore(pipeline.featurizer, [], [])
+    assert store.find_similar("anything") == []
+    store2 = HistoricalCaseStore(pipeline.featurizer, ["hello world"], [0])
+    assert store2.find_similar("12345 67890 !!!") == []  # strips to nothing
+
+
+# ---------------------------------------------------------------------------
+# agent
+# ---------------------------------------------------------------------------
+
+def test_agent_predict_matches_pipeline(pipeline):
+    agent = FraudAnalysisAgent(pipeline)
+    text = "agent: this is the prize department your urgent payment is required"
+    res = agent.predict_and_get_label(text)
+    pred, prob = pipeline.predict_one(text)
+    assert res["prediction"] == pred
+    assert res["probability_scam"] == pytest.approx(prob)
+    assert res["confidence"] == pytest.approx(prob if pred == 1 else 1 - prob)
+    assert res["label"] in ("Potential Scam", "Normal Conversation")
+
+
+def test_agent_scores_once_and_explains(pipeline):
+    backend = CannedBackend(responses=["the analysis", "the insight"])
+    agent = FraudAnalysisAgent(pipeline, backend=backend)
+    agent.load_history(
+        ["agent: claim your prize reward now urgent", "customer: normal chat about weather"],
+        [1, 0])
+    res = agent.classify_and_explain(
+        "agent: urgent claim your prize reward", temperature=0.2)
+    assert res["analysis"] == "the analysis"
+    assert res["historical_insight"] == "the insight"
+    assert len(res["similar_cases"]) > 0
+    assert len(backend.calls) == 2
+    assert backend.calls[0]["temperature"] == 0.2
+    # the dialogue and verdict flow into the first prompt
+    user_msg = backend.calls[0]["messages"][1]["content"]
+    assert "urgent claim your prize reward" in user_msg
+
+
+def test_agent_degrades_on_backend_failure(pipeline):
+    class Boom:
+        def generate(self, *a, **k):
+            raise BackendError("api down")
+
+    agent = FraudAnalysisAgent(pipeline, backend=Boom())
+    res = agent.classify_and_explain("agent: hello there")
+    assert res["analysis"] is None
+    assert "api down" in res["error"]
+    assert "prediction" in res  # classification still delivered
+
+
+def test_agent_without_history_skips_insight(pipeline):
+    backend = CannedBackend(responses=["only analysis"])
+    agent = FraudAnalysisAgent(pipeline, backend=backend)
+    res = agent.classify_and_explain("agent: hello there")
+    assert "historical_insight" not in res
+    assert len(backend.calls) == 1
+
+
+def test_onpod_backend_flattens_chat():
+    from fraud_detection_tpu.explain import OnPodBackend
+
+    seen = {}
+
+    def gen(prompt, temperature, max_tokens):
+        seen.update(prompt=prompt, temperature=temperature, max_tokens=max_tokens)
+        return "onpod says hi"
+
+    be = OnPodBackend(gen)
+    out = be.generate("explain this", temperature=0.5, max_tokens=64)
+    assert out == "onpod says hi"
+    assert seen["temperature"] == 0.5 and seen["max_tokens"] == 64
+    assert "<|system|>" in seen["prompt"]
+    assert "<|user|>\nexplain this" in seen["prompt"]
+    assert seen["prompt"].rstrip().endswith("<|assistant|>")
+
+
+def test_history_larger_than_batch_size(pipeline):
+    texts = [f"agent: case number {i} about prize reward claims" for i in range(70)]
+    store = HistoricalCaseStore(pipeline.featurizer, texts, [i % 2 for i in range(70)],
+                                batch_size=32)
+    assert len(store) == 70
+    hits = store.find_similar("agent: prize reward claims", k=5)
+    assert len(hits) == 5

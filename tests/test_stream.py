@@ -10,18 +10,9 @@ from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
 
 @pytest.fixture(scope="module")
 def pipeline():
-    from fraud_detection_tpu.data import generate_corpus
-    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
-    from fraud_detection_tpu.models.pipeline import ServingPipeline
-    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
 
-    corpus = generate_corpus(n=400, seed=3)
-    feat = HashingTfIdfFeaturizer(num_features=2048)
-    feat.fit_idf([d.text for d in corpus])
-    X = np.asarray(feat.featurize_dense([d.text for d in corpus]))
-    y = np.asarray([d.label for d in corpus], np.float32)
-    model = fit_logistic_regression(X, y, max_iter=50)
-    return ServingPipeline(feat, model, batch_size=64)
+    return synthetic_demo_pipeline(batch_size=64, n=400, seed=3, num_features=2048)
 
 
 def _feed(broker, dialogues, topic="customer-dialogues-raw"):
@@ -51,9 +42,10 @@ def test_end_to_end_stream_classification(pipeline):
     by_id = {}
     for m in out:
         payload = json.loads(m.value)
-        assert payload["prediction"] in ("scam", "non-scam")
+        assert payload["prediction"] in (0, 1)
+        assert payload["label"] in ("Potential Scam", "Normal Conversation")
         assert 0.0 <= payload["confidence"] <= 1.0
-        by_id[int(m.key)] = payload["label"]
+        by_id[int(m.key)] = payload["prediction"]
     truth = {i: d.label for i, d in enumerate(corpus)}
     acc = np.mean([by_id[i] == truth[i] for i in truth])
     assert acc > 0.97, acc
@@ -118,3 +110,32 @@ def test_throughput_counter_sane(pipeline):
     d = stats.as_dict()
     assert d["msgs_per_sec"] > 0 and d["batches"] >= 2
     assert d["mean_batch_latency_sec"] <= d["max_batch_latency_sec"]
+
+
+def test_engine_stops_when_producer_cannot_deliver(pipeline):
+    """A failed flush must halt the engine with offsets uncommitted — continuing
+    would commit past the lost batch on the next clean flush."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    corpus = generate_corpus(n=40, seed=5)
+    broker = InProcessBroker()
+    _feed(broker, [(d.text, d.label) for d in corpus])
+
+    class FailingProducer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def produce(self, *a, **k):
+            self.inner.produce(*a, **k)
+
+        def flush(self, timeout=10.0):
+            return 3  # pretend 3 messages failed delivery
+
+    consumer = broker.consumer(["customer-dialogues-raw"], "failflush")
+    engine = StreamingClassifier(
+        pipeline, consumer, FailingProducer(broker.producer()), "out",
+        batch_size=8, max_wait=0.01)
+    stats = engine.run(max_messages=40, idle_timeout=0.5)
+    assert stats.batches == 1          # stopped after the first failed batch
+    assert stats.commits_skipped == 1
+    assert consumer.committed_offsets() == {}  # no offsets durably committed
