@@ -1,6 +1,7 @@
 """Real Kafka client factories (confluent_kafka), env-compatible with the reference.
 
-Reads the same environment variables as the reference's utils/kafka_utils.py:
+Takes a typed ``KafkaConfig`` (utils/config.py) whose ``from_env`` reads the
+same environment variables as the reference's utils/kafka_utils.py:
 KAFKA_BOOTSTRAP_SERVERS, KAFKA_INPUT_TOPIC, KAFKA_OUTPUT_TOPIC,
 KAFKA_CONSUMER_GROUP, KAFKA_SECURITY_PROTOCOL, KAFKA_USERNAME, KAFKA_PASSWORD
 (names documented in SURVEY.md Q8). Configuration mirrors the reference —
@@ -15,10 +16,10 @@ environments without it.
 
 from __future__ import annotations
 
-import os
 from typing import List, Optional
 
 from fraud_detection_tpu.stream.broker import Message
+from fraud_detection_tpu.utils.config import KafkaConfig
 
 try:  # pragma: no cover - exercised only where the wheel exists
     import confluent_kafka as _ck
@@ -37,33 +38,32 @@ def _require():
             "or install librdkafka's python client")
 
 
-def _security_config() -> dict:
-    cfg = {}
-    if os.getenv("KAFKA_SECURITY_PROTOCOL", "").upper() == "SASL_SSL":
-        cfg.update({
+def _security_config(cfg: KafkaConfig) -> dict:
+    if (cfg.security_protocol or "").upper() == "SASL_SSL":
+        return {
             "security.protocol": "SASL_SSL",
             "sasl.mechanisms": "PLAIN",
-            "sasl.username": os.getenv("KAFKA_USERNAME", ""),
-            "sasl.password": os.getenv("KAFKA_PASSWORD", ""),
-        })
-    return cfg
+            "sasl.username": cfg.username or "",
+            "sasl.password": cfg.password or "",
+        }
+    return {}
 
 
 class KafkaConsumer:
     """confluent_kafka consumer adapted to the engine's poll_batch protocol."""
 
     def __init__(self, topics: Optional[List[str]] = None,
-                 bootstrap: Optional[str] = None, group_id: Optional[str] = None):
+                 config: Optional[KafkaConfig] = None):
         _require()
-        conf = {
-            "bootstrap.servers": bootstrap or os.getenv("KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
-            "group.id": group_id or os.getenv("KAFKA_CONSUMER_GROUP", "dialogue-classifier-group"),
+        cfg = config or KafkaConfig.from_env()
+        self._consumer = _ck.Consumer({
+            "bootstrap.servers": cfg.bootstrap_servers,
+            "group.id": cfg.consumer_group,
             "auto.offset.reset": "earliest",
             "enable.auto.commit": False,
-            **_security_config(),
-        }
-        self._consumer = _ck.Consumer(conf)
-        self._consumer.subscribe(topics or [os.getenv("KAFKA_INPUT_TOPIC", "customer-dialogues-raw")])
+            **_security_config(cfg),
+        })
+        self._consumer.subscribe(topics or [cfg.input_topic])
 
     def poll(self, timeout: float = 1.0) -> Optional[Message]:
         msg = self._consumer.poll(timeout)
@@ -86,11 +86,12 @@ class KafkaConsumer:
 
 
 class KafkaProducer:
-    def __init__(self, bootstrap: Optional[str] = None):
+    def __init__(self, config: Optional[KafkaConfig] = None):
         _require()
+        cfg = config or KafkaConfig.from_env()
         self._producer = _ck.Producer({
-            "bootstrap.servers": bootstrap or os.getenv("KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
-            **_security_config(),
+            "bootstrap.servers": cfg.bootstrap_servers,
+            **_security_config(cfg),
         })
         self._delivery_failures = 0
 

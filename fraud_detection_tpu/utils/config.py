@@ -1,0 +1,161 @@
+"""Configuration: typed dataclasses layered over env vars + .env files.
+
+The reference scatters configuration across two .env locations read at import
+time by python-dotenv (root .env for the API key — /root/reference/app_ui.py:21-25;
+utils/.env for Kafka + agent — /root/reference/utils/kafka_utils.py:8-9,
+utils/agent_api.py:15-19) plus hard-coded constants (model path, URLs,
+hyperparameters — SURVEY.md §5 config). Here: the same variable NAMES (Q8 —
+DEEPSEEK_API_KEY, KAFKA_BOOTSTRAP_SERVERS, KAFKA_INPUT_TOPIC,
+KAFKA_OUTPUT_TOPIC, KAFKA_CONSUMER_GROUP, KAFKA_SECURITY_PROTOCOL,
+KAFKA_USERNAME, KAFKA_PASSWORD) so a reference deployment's env carries over
+unchanged, but parsed once into frozen dataclasses that every layer takes as
+an argument — no import-time global reads.  python-dotenv is not a
+dependency; the parser here covers its used subset (KEY=VALUE, comments,
+quoting, export prefix).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Mapping, Optional, Sequence
+
+
+def parse_env_file(path: "str | Path") -> Dict[str, str]:
+    """Parse a .env file: KEY=VALUE lines, '#' comments, optional quotes,
+    optional 'export ' prefix. Returns {} for a missing file."""
+    out: Dict[str, str] = {}
+    p = Path(path)
+    if not p.is_file():
+        return out
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        if line.startswith("export "):
+            line = line[len("export "):]
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if len(value) >= 2 and value[0] == value[-1] and value[0] in "\"'":
+            value = value[1:-1]
+        else:
+            # strip trailing inline comment on unquoted values
+            if " #" in value:
+                value = value.split(" #", 1)[0].rstrip()
+        if key:
+            out[key] = value
+    return out
+
+
+def load_dotenv(paths: Sequence["str | Path"] = (".env", "utils/.env"),
+                *, override: bool = False,
+                environ: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Load .env files into the process env (reference checks both its repo
+    root and utils/ — Q8). Existing env vars win unless ``override``.
+    Returns the merged mapping that was applied."""
+    env = os.environ if environ is None else environ
+    applied: Dict[str, str] = {}
+    for path in paths:
+        for k, v in parse_env_file(path).items():
+            if override or k not in env:
+                env[k] = v
+                applied[k] = v
+    return applied
+
+
+def _get(env: Mapping[str, str], key: str, default: str = "") -> str:
+    return env.get(key, default)
+
+
+@dataclass(frozen=True)
+class KafkaConfig:
+    """Reference-compatible Kafka settings (utils/kafka_utils.py:11-49)."""
+
+    bootstrap_servers: str = "localhost:9092"
+    input_topic: str = "customer-dialogues-raw"
+    output_topic: str = "dialogues-classified"
+    consumer_group: str = "dialogue-classifier-group"
+    security_protocol: Optional[str] = None  # e.g. SASL_SSL
+    username: Optional[str] = None
+    password: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "KafkaConfig":
+        e = os.environ if env is None else env
+        return cls(
+            bootstrap_servers=_get(e, "KAFKA_BOOTSTRAP_SERVERS", "localhost:9092"),
+            input_topic=_get(e, "KAFKA_INPUT_TOPIC", "customer-dialogues-raw"),
+            output_topic=_get(e, "KAFKA_OUTPUT_TOPIC", "dialogues-classified"),
+            consumer_group=_get(e, "KAFKA_CONSUMER_GROUP", "dialogue-classifier-group"),
+            security_protocol=e.get("KAFKA_SECURITY_PROTOCOL") or None,
+            username=e.get("KAFKA_USERNAME") or None,
+            password=e.get("KAFKA_PASSWORD") or None,
+        )
+
+
+@dataclass(frozen=True)
+class LLMConfig:
+    """Explanation-backend settings (utils/agent_api.py:15-42 semantics)."""
+
+    api_key: Optional[str] = None
+    base_url: str = "https://api.deepseek.com/v1"
+    model: str = "deepseek-chat"
+    temperature: float = 1.0
+    timeout: float = 90.0
+    max_attempts: int = 3
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "LLMConfig":
+        e = os.environ if env is None else env
+        return cls(
+            api_key=e.get("DEEPSEEK_API_KEY") or None,
+            base_url=_get(e, "LLM_BASE_URL", "https://api.deepseek.com/v1"),
+            model=_get(e, "LLM_MODEL", "deepseek-chat"),
+            temperature=float(_get(e, "LLM_TEMPERATURE", "1.0")),
+            timeout=float(_get(e, "LLM_TIMEOUT", "90")),
+            max_attempts=int(_get(e, "LLM_MAX_ATTEMPTS", "3")),
+        )
+
+    def make_backend(self, **kw):
+        from fraud_detection_tpu.explain.backends import OpenAIChatBackend
+
+        return OpenAIChatBackend(base_url=self.base_url, model=self.model,
+                                 api_key=self.api_key, timeout=self.timeout,
+                                 max_attempts=self.max_attempts, **kw)
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Micro-batching serve-path settings (no reference equivalent — the
+    reference hard-codes per-row scoring, Q7)."""
+
+    model_path: str = ""
+    batch_size: int = 1024
+    max_wait: float = 0.05
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "ServingConfig":
+        e = os.environ if env is None else env
+        return cls(
+            model_path=_get(e, "FRAUD_MODEL_PATH", ""),
+            batch_size=int(_get(e, "FRAUD_BATCH_SIZE", "1024")),
+            max_wait=float(_get(e, "FRAUD_MAX_WAIT", "0.05")),
+        )
+
+
+@dataclass(frozen=True)
+class AppConfig:
+    kafka: KafkaConfig = field(default_factory=KafkaConfig)
+    llm: LLMConfig = field(default_factory=LLMConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None,
+                 dotenv_paths: Optional[Sequence[str]] = None) -> "AppConfig":
+        if dotenv_paths is not None:
+            load_dotenv(dotenv_paths)
+        return cls(kafka=KafkaConfig.from_env(env),
+                   llm=LLMConfig.from_env(env),
+                   serving=ServingConfig.from_env(env))

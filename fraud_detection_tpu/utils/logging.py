@@ -1,0 +1,67 @@
+"""Structured logging — the observability the reference lacks.
+
+The reference's only observability is ``print()`` statements and Streamlit
+status widgets (SURVEY.md §5: no logging module, no structured logs). Here:
+stdlib logging with a logfmt-style formatter (``ts level logger msg k=v ...``),
+configured once per process, level from FRAUD_TPU_LOG_LEVEL.  ``kv`` attaches
+structured fields to a record so downstream collectors can parse them without
+regexes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Any
+
+_CONFIGURED = False
+
+
+class LogfmtFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
+        base = (f"ts={ts}.{int(record.msecs):03d}Z level={record.levelname.lower()} "
+                f"logger={record.name} msg={_quote(record.getMessage())}")
+        extra = getattr(record, "kv", None)
+        if extra:
+            base += "".join(f" {k}={_quote(v)}" for k, v in extra.items())
+        if record.exc_info:
+            base += f" exc={_quote(self.formatException(record.exc_info))}"
+        return base
+
+
+def _quote(value: Any) -> str:
+    s = str(value)
+    if any(c in s for c in ' "=\n'):
+        return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n") + '"'
+    return s
+
+
+def configure(level: "str | int | None" = None, stream=None) -> None:
+    """Install the logfmt handler on the package root logger (idempotent)."""
+    global _CONFIGURED
+    root = logging.getLogger("fraud_detection_tpu")
+    if _CONFIGURED and level is None and stream is None:
+        return
+    root.handlers.clear()
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(LogfmtFormatter())
+    root.addHandler(handler)
+    root.setLevel(level if level is not None
+                  else os.getenv("FRAUD_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str = "fraud_detection_tpu") -> logging.Logger:
+    configure()
+    if not name.startswith("fraud_detection_tpu"):
+        name = f"fraud_detection_tpu.{name}"
+    return logging.getLogger(name)
+
+
+def kv(**fields) -> dict:
+    """Structured-fields adapter: ``log.info("scored", extra=kv(batch=32))``."""
+    return {"kv": fields}
