@@ -71,6 +71,13 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", action="store_true",
                     help="train data-parallel over all available devices")
     ap.add_argument("--json", action="store_true", help="emit metrics as JSON")
+    ap.add_argument("--plots", metavar="DIR", default=None,
+                    help="write metric-comparison + confusion-matrix PNGs here "
+                         "(fraud_detection_spark.py:125-222 equivalents)")
+    ap.add_argument("--associations", type=int, metavar="N", default=0,
+                    help="word-association analysis over the top N features "
+                         "per model (side-vocabulary inversion of hashed "
+                         "features — SURVEY.md Q11)")
     args = ap.parse_args(argv)
 
     import jax.numpy as jnp
@@ -140,12 +147,15 @@ def main(argv=None) -> int:
         return predict_dense(model, X)
 
     all_metrics: Dict[str, Dict[str, Dict[str, float]]] = {}
+    all_reports: Dict[str, Dict[str, object]] = {}
     for name, model in trained.items():
         all_metrics[name] = {}
+        all_reports[name] = {}
         for split_name, (X, y) in sets.items():
             pred, p1 = scores(model, X)
             rep = evaluate_classification(y, np.asarray(pred), np.asarray(p1))
             all_metrics[name][split_name] = rep.as_dict()
+            all_reports[name][split_name] = rep
             if not args.json:
                 print(f"\n=== {name} / {split_name} ===")
                 for k, v in rep.as_dict().items():
@@ -153,6 +163,43 @@ def main(argv=None) -> int:
                 print(f"  confusion: {rep.confusion.tolist()}")
     if args.json:
         print(json.dumps(all_metrics, indent=2))
+
+    if args.plots:
+        import os
+
+        from fraud_detection_tpu.eval.report import (
+            plot_confusion_matrices, plot_metrics_comparison)
+
+        os.makedirs(args.plots, exist_ok=True)
+        p = plot_metrics_comparison(
+            all_reports, os.path.join(args.plots, "metrics_comparison.png"))
+        cms = plot_confusion_matrices(
+            all_reports, os.path.join(args.plots, "confusion_matrices"))
+        print(f"plots: {p} + {len(cms)} confusion-matrix figures")
+
+    if args.associations:
+        from fraud_detection_tpu.eval import SideVocabulary, analyze_word_associations
+        from fraud_detection_tpu.eval.word_associations import model_feature_importances
+
+        train_texts = [t for t, _ in train]
+        train_labels = [l for _, l in train]
+        vocab = SideVocabulary(feat).add_corpus(train_texts)
+        for name, model in trained.items():
+            imps = model_feature_importances(model, Xtr, ytr)
+            assocs = analyze_word_associations(
+                model, feat, train_texts, train_labels,
+                top_n=args.associations, vocab=vocab, importances=imps)
+            print(f"\n=== word associations: {name} ===")
+            for a in assocs:
+                print(f"  {a.word:<20} importance={a.importance:.4f} "
+                      f"scam_ratio={a.scam_ratio:.3f} "
+                      f"({a.scam_docs} scam / {a.non_scam_docs} non-scam)")
+            if args.plots:
+                from fraud_detection_tpu.eval.report import plot_word_associations
+
+                plot_word_associations(
+                    assocs, os.path.join(args.plots, f"word_associations_{name}.png"),
+                    model_name=name)
 
     from fraud_detection_tpu.checkpoint.native import save_checkpoint
 

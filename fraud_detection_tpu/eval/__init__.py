@@ -4,5 +4,22 @@ from fraud_detection_tpu.eval.metrics import (
     evaluate_classification,
     roc_auc,
 )
+from fraud_detection_tpu.eval.word_associations import (
+    SideVocabulary,
+    WordAssociation,
+    analyze_word_associations,
+    model_feature_importances,
+    tree_feature_importances,
+)
 
-__all__ = ["ClassificationReport", "confusion_matrix", "evaluate_classification", "roc_auc"]
+__all__ = [
+    "ClassificationReport",
+    "confusion_matrix",
+    "evaluate_classification",
+    "roc_auc",
+    "SideVocabulary",
+    "WordAssociation",
+    "analyze_word_associations",
+    "model_feature_importances",
+    "tree_feature_importances",
+]

@@ -94,6 +94,11 @@ class HashingTfIdfFeaturizer:
 
     # ---------------- host side ----------------
 
+    @property
+    def hashing_tf(self) -> HashingTF:
+        """The term->bucket hasher (public for the side-vocabulary builder)."""
+        return self._hashing
+
     def tokens(self, text: str) -> List[str]:
         toks = tokenize(clean_text(text))
         if self.remove_stopwords:

@@ -1,0 +1,127 @@
+"""Tests for hashed-feature interpretability (side vocabulary, importances,
+association analysis, plots) — the Q11 capability the reference cannot do for
+its shipped HashingTF artifact."""
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.data import generate_corpus
+from fraud_detection_tpu.eval import (
+    SideVocabulary,
+    analyze_word_associations,
+    model_feature_importances,
+    tree_feature_importances,
+)
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_decision_tree
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    docs = generate_corpus(n=300, seed=21)
+    return [d.text for d in docs], [d.label for d in docs]
+
+
+@pytest.fixture(scope="module")
+def featurizer(corpus):
+    texts, _ = corpus
+    feat = HashingTfIdfFeaturizer(num_features=2048)
+    feat.fit_idf(texts)
+    return feat
+
+
+def _dense(feat, texts):
+    out = []
+    for s in range(0, len(texts), 256):
+        chunk = texts[s : s + 256]
+        out.append(np.asarray(feat.featurize_dense(chunk, batch_size=256))[: len(chunk)])
+    return np.concatenate(out)
+
+
+def test_side_vocabulary_inverts_hashing(featurizer):
+    vocab = SideVocabulary(featurizer).add_corpus(
+        ["the prize winner must claim the prize now", "prize prize prize"])
+    bucket = featurizer.hashing_tf.bucket("prize")
+    assert "prize" in vocab.terms(bucket)
+    assert vocab.label(bucket) == "prize"
+    assert vocab.label(999999 % featurizer.num_features).startswith(
+        ("bucket#", "prize", "winner", "claim", "now")) is True
+
+
+def test_tree_importances_find_informative_feature():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    y = (X[:, 3] > 0).astype(np.float32)  # only feature 3 matters
+    ens = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=3))
+    imp = tree_feature_importances(ens, X, y)
+    assert imp.shape == (8,)
+    assert abs(imp.sum() - 1.0) < 1e-5
+    assert imp.argmax() == 3
+    assert imp[3] > 0.9
+
+
+def test_lr_importances_are_weight_magnitudes():
+    from fraud_detection_tpu.models.linear import LogisticRegression
+
+    lr = LogisticRegression.from_arrays(np.array([0.5, -2.0, 0.0]), 0.1)
+    imp = model_feature_importances(lr)
+    assert np.allclose(imp, [0.5, 2.0, 0.0])
+
+
+def test_analyze_word_associations_lr(featurizer, corpus):
+    texts, labels = corpus
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+
+    X = _dense(featurizer, texts)
+    model = fit_logistic_regression(X, np.asarray(labels, np.float32), max_iter=50)
+    assocs = analyze_word_associations(model, featurizer, texts, labels, top_n=15)
+    assert 0 < len(assocs) <= 15
+    # importances sorted descending
+    imps = [a.importance for a in assocs]
+    assert imps == sorted(imps, reverse=True)
+    for a in assocs:
+        assert a.word and not a.word.startswith("bucket#")  # side vocab resolves
+        assert 0.0 <= a.scam_ratio <= 1.0
+        assert a.scam_docs + a.non_scam_docs > 0
+    # scam-indicative words should skew to scam docs for at least one top assoc
+    assert any(a.scam_ratio > 0.7 for a in assocs)
+
+
+def test_analyze_word_associations_tree(featurizer, corpus):
+    texts, labels = corpus
+    X = _dense(featurizer, texts)
+    ens = fit_decision_tree(X, np.asarray(labels, np.float32),
+                            config=TreeTrainConfig(max_depth=4))
+    assocs = analyze_word_associations(ens, featurizer, texts, labels, top_n=10)
+    assert len(assocs) > 0
+    assert all(a.importance > 0 for a in assocs)
+
+
+def test_plots_render(tmp_path, featurizer, corpus):
+    texts, labels = corpus
+    from fraud_detection_tpu.eval.metrics import evaluate_classification
+    from fraud_detection_tpu.eval.report import (
+        plot_confusion_matrices,
+        plot_metrics_comparison,
+        plot_word_associations,
+    )
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+    from fraud_detection_tpu.models.linear import predict_dense
+
+    X = _dense(featurizer, texts)
+    y = np.asarray(labels, np.float32)
+    model = fit_logistic_regression(X, y, max_iter=30)
+    pred, prob = predict_dense(model, X)
+    rep = evaluate_classification(np.asarray(y), np.asarray(pred), np.asarray(prob))
+    results = {"LogisticRegression": {"Train": rep, "Test": rep}}
+
+    p1 = plot_metrics_comparison(results, str(tmp_path / "metrics.png"))
+    p2 = plot_confusion_matrices(results, str(tmp_path / "cm"))
+    assocs = analyze_word_associations(model, featurizer, texts, labels, top_n=8)
+    p3 = plot_word_associations(assocs, str(tmp_path / "wa.png"))
+    import os
+
+    assert os.path.getsize(p1) > 1000
+    assert all(os.path.getsize(p) > 1000 for p in p2)
+    assert os.path.getsize(p3) > 1000
+    assert plot_word_associations([], str(tmp_path / "empty.png")) is None
