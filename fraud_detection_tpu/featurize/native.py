@@ -1,0 +1,129 @@
+"""ctypes loader for the native featurizer (native/fast_featurize.cpp).
+
+Builds the shared library on demand with g++ (no pip/pybind dependency —
+plain C ABI + ctypes), caches it next to the source, and degrades to None
+when no toolchain is available so the pure-Python path keeps working.
+The Python featurizer (featurize/tfidf.py) auto-uses this when loadable;
+parity is enforced by tests/test_native_featurize.py comparing both paths
+byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "fast_featurize.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libfastfeat.so")
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None" = None
+_lib_failed = False
+
+
+def _build() -> Optional[str]:
+    if os.path.isfile(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    try:
+        # build to a temp name then atomic-rename: concurrent processes race safely
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_LIB))
+        os.close(fd)
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The process-wide native library, built+loaded lazily; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if os.environ.get("FRAUD_TPU_NO_NATIVE"):
+            _lib_failed = True
+            return None
+        path = _build()
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.ftok_create.restype = ctypes.c_void_p
+        lib.ftok_create.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.ftok_destroy.argtypes = [ctypes.c_void_p]
+        lib.ftok_hash_bucket.restype = ctypes.c_int
+        lib.ftok_hash_bucket.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ftok_encode_begin.restype = ctypes.c_int
+        lib.ftok_encode_begin.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+        lib.ftok_encode_fill.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ctypes.c_int, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+class NativeFeaturizer:
+    """One native handle: stopword set + hashing config bound at creation."""
+
+    def __init__(self, stopwords: Sequence[str], num_features: int,
+                 binary: bool, remove_stopwords: bool):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native featurizer library unavailable")
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(stopwords))(
+            *[s.encode("utf-8") for s in stopwords])
+        self._handle = lib.ftok_create(arr, len(stopwords), num_features,
+                                       int(binary), int(remove_stopwords))
+        self._call_lock = threading.Lock()  # begin/fill state is per-handle
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.ftok_destroy(handle)
+            self._handle = None
+
+    def hash_bucket(self, term: str) -> int:
+        return self._lib.ftok_hash_bucket(self._handle, term.encode("utf-8"))
+
+    def encode(self, texts: Sequence[str], rows: int,
+               max_tokens: Optional[int], pad_len) -> Tuple[np.ndarray, np.ndarray]:
+        """Padded (rows, L) ids/counts — same contract as the Python encode."""
+        # NULs would truncate the C string; clean() strips them anyway, and
+        # they are not token separators, so removal preserves parity.
+        buf: List[bytes] = [t.encode("utf-8").replace(b"\x00", b"") for t in texts]
+        arr = (ctypes.c_char_p * len(buf))(*buf)
+        with self._call_lock:
+            width = self._lib.ftok_encode_begin(self._handle, arr, len(buf))
+            length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
+            ids = np.zeros((rows, length), np.int32)
+            counts = np.zeros((rows, length), np.float32)
+            self._lib.ftok_encode_fill(self._handle, ids, counts, rows, length)
+        return ids, counts
+
+
+def available() -> bool:
+    return load_library() is not None

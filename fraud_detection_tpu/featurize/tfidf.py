@@ -86,11 +86,28 @@ class HashingTfIdfFeaturizer:
 
     def __post_init__(self):
         self._hashing = HashingTF(self.num_features, binary=self.binary_tf)
+        self._native = None        # lazy NativeFeaturizer (featurize/native.py)
+        self._native_tried = False
         if self.idf is not None:
             self.idf = np.asarray(self.idf, np.float32)
             if self.idf.shape != (self.num_features,):
                 raise ValueError(
                     f"idf shape {self.idf.shape} != ({self.num_features},)")
+
+    def _native_featurizer(self):
+        """The C++ clean/tokenize/hash fast path, or None. Bit-parity with the
+        Python path is the native module's contract (tests enforce it)."""
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from fraud_detection_tpu.featurize.native import NativeFeaturizer
+
+                self._native = NativeFeaturizer(
+                    self.stop_filter.words if self.remove_stopwords else [],
+                    self.num_features, self.binary_tf, self.remove_stopwords)
+            except (RuntimeError, OSError):
+                self._native = None
+        return self._native
 
     # ---------------- host side ----------------
 
@@ -116,10 +133,14 @@ class HashingTfIdfFeaturizer:
         to the padded max unique-bucket count in this batch). Rows beyond
         len(texts) are all-padding.
         """
+        b = batch_size if batch_size is not None else len(texts)
+        if len(texts) > b:
+            raise ValueError(f"{len(texts)} texts > batch_size {b}")
+        native = self._native_featurizer()
+        if native is not None:
+            ids, counts = native.encode(texts, b, max_tokens, _pad_len)
+            return EncodedBatch(ids=ids, counts=counts)
         rows = [self.sparse_row(t) for t in texts]
-        b = batch_size if batch_size is not None else len(rows)
-        if len(rows) > b:
-            raise ValueError(f"{len(rows)} texts > batch_size {b}")
         width = max((len(i) for i, _ in rows), default=1)
         length = max_tokens if max_tokens is not None else _pad_len(width)
         ids = np.zeros((b, length), np.int32)

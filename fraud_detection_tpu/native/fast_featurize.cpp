@@ -1,0 +1,228 @@
+// Native host-side featurizer: clean -> tokenize -> stopword filter ->
+// MurmurHash3_x86_32 bucket -> per-doc counts, batch-assembled into the
+// padded (B, L) arrays the device program consumes.
+//
+// This is the one justified native component (SURVEY.md §7 hard part 3):
+// at the 10k+ msgs/sec target the Python per-token loop starves the TPU; the
+// math here is trivial but must be BIT-EXACT with the Python reference
+// implementation in featurize/{text,hashing}.py, which itself carries Spark
+// parity (Tokenizer / StopWordsRemover / ml.feature.HashingTF semantics of
+// the shipped artifact — /root/reference/dialogue_classification_model).
+//
+// Parity contract replicated here:
+//  * clean: Unicode-lowercase then keep only [a-z ]. For non-ASCII input the
+//    only codepoints whose Python str.lower() yields an ASCII letter are
+//    U+0130 (-> "i" + combining dot, dot stripped) and U+212A (Kelvin -> k);
+//    both are special-cased, every other non-ASCII byte sequence strips.
+//  * tokenize: Java String.split("\\s") semantics on the cleaned text —
+//    leading/interior empty strings kept, trailing dropped, and splitting ""
+//    returns [""] (the empty token is real: it flows through the stopword
+//    filter and hashes into bucket murmur3("", 42) % F).
+//  * stopwords: exact-match set (the Python side lowercases the list for the
+//    case-insensitive default before handing it over).
+//  * hash: standard MurmurHash3_x86_32 over UTF-8 bytes, seed 42, then
+//    Spark's nonNegativeMod on the SIGNED hash.
+//  * row assembly: unique buckets sorted ascending; if a row has more unique
+//    buckets than L, keep the L highest counts (ties: lowest bucket id
+//    first — numpy argsort(-val) stable-order semantics), then re-sort by id.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC fast_featurize.cpp -o libfastfeat.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t C1 = 0xcc9e2d51u;
+constexpr uint32_t C2 = 0x1b873593u;
+
+inline uint32_t rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= C1;
+  k1 = rotl32(k1, 15);
+  return k1 * C2;
+}
+
+inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5u + 0xe6546b64u;
+}
+
+uint32_t murmur3_x86_32(const unsigned char* data, size_t len, uint32_t seed) {
+  uint32_t h1 = seed;
+  const size_t aligned = len & ~size_t(3);
+  for (size_t i = 0; i < aligned; i += 4) {
+    uint32_t k1 = uint32_t(data[i]) | (uint32_t(data[i + 1]) << 8) |
+                  (uint32_t(data[i + 2]) << 16) | (uint32_t(data[i + 3]) << 24);
+    h1 = mix_h1(h1, mix_k1(k1));
+  }
+  uint32_t k1 = 0;
+  int shift = 0;
+  for (size_t i = aligned; i < len; ++i) {
+    k1 ^= uint32_t(data[i]) << shift;
+    shift += 8;
+  }
+  h1 ^= mix_k1(k1);  // note: applied even when tail is empty (matches Spark)
+  h1 ^= uint32_t(len);
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+inline int non_negative_mod(int32_t x, int32_t mod) {
+  int32_t r = x % mod;
+  return r < 0 ? r + mod : r;
+}
+
+inline int hash_bucket(const std::string& term, int num_features) {
+  uint32_t h = murmur3_x86_32(
+      reinterpret_cast<const unsigned char*>(term.data()), term.size(), 42u);
+  return non_negative_mod(static_cast<int32_t>(h), num_features);
+}
+
+// Unicode-aware clean: lowercase, keep [a-z ] only. Non-ASCII handled per the
+// contract above (U+0130 -> 'i', U+212A -> 'k', everything else stripped).
+void clean_utf8(const char* text, std::string& out) {
+  out.clear();
+  const unsigned char* p = reinterpret_cast<const unsigned char*>(text);
+  while (*p) {
+    unsigned char c = *p;
+    if (c < 0x80) {
+      if (c >= 'A' && c <= 'Z') c = c - 'A' + 'a';
+      if ((c >= 'a' && c <= 'z') || c == ' ') out.push_back(char(c));
+      ++p;
+    } else {
+      // decode one UTF-8 sequence (permissive; invalid bytes skipped)
+      uint32_t cp = 0;
+      int extra = 0;
+      if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; extra = 1; }
+      else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; extra = 2; }
+      else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; extra = 3; }
+      else { ++p; continue; }
+      ++p;
+      bool ok = true;
+      for (int i = 0; i < extra; ++i) {
+        if ((*p & 0xC0) != 0x80) { ok = false; break; }
+        cp = (cp << 6) | (*p & 0x3F);
+        ++p;
+      }
+      if (!ok) continue;
+      if (cp == 0x0130) out.push_back('i');       // İ -> i + U+0307(stripped)
+      else if (cp == 0x212A) out.push_back('k');  // Kelvin sign -> k
+      // all other non-ASCII codepoints lowercase outside [a-z ] and strip
+    }
+  }
+}
+
+// Java String.split("\\s") on cleaned text (only ' ' can remain).
+void java_split(const std::string& s, std::vector<std::string>& out) {
+  out.clear();
+  if (s.empty()) {
+    out.emplace_back();  // Java: "".split -> [""]
+    return;
+  }
+  size_t start = 0;
+  for (size_t i = 0; i <= s.size(); ++i) {
+    if (i == s.size() || s[i] == ' ') {
+      out.emplace_back(s, start, i - start);
+      start = i + 1;
+    }
+  }
+  while (!out.empty() && out.back().empty()) out.pop_back();  // drop trailing
+}
+
+struct Featurizer {
+  int num_features;
+  bool binary;
+  bool remove_stopwords;
+  std::unordered_set<std::string> stopwords;
+  // per-batch scratch (kept between begin/fill calls)
+  std::vector<std::vector<std::pair<int, float>>> rows;  // sorted by bucket id
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ftok_create(const char** stopwords, int n_stop, int num_features,
+                  int binary, int remove_stopwords) {
+  auto* f = new Featurizer;
+  f->num_features = num_features;
+  f->binary = binary != 0;
+  f->remove_stopwords = remove_stopwords != 0;
+  for (int i = 0; i < n_stop; ++i) f->stopwords.insert(stopwords[i]);
+  return f;
+}
+
+void ftok_destroy(void* h) { delete static_cast<Featurizer*>(h); }
+
+int ftok_hash_bucket(void* h, const char* term) {
+  return hash_bucket(term, static_cast<Featurizer*>(h)->num_features);
+}
+
+// Tokenize+hash the batch into handle state; returns max unique-bucket width.
+int ftok_encode_begin(void* h, const char** texts, int n_texts) {
+  auto* f = static_cast<Featurizer*>(h);
+  f->rows.assign(n_texts, {});
+  std::string cleaned;
+  std::vector<std::string> toks;
+  std::unordered_map<int, float> counts;
+  int width = 0;
+  for (int d = 0; d < n_texts; ++d) {
+    clean_utf8(texts[d], cleaned);
+    java_split(cleaned, toks);
+    counts.clear();
+    for (const auto& t : toks) {
+      if (f->remove_stopwords && f->stopwords.count(t)) continue;
+      int b = hash_bucket(t, f->num_features);
+      if (f->binary) counts[b] = 1.0f;
+      else counts[b] += 1.0f;
+    }
+    auto& row = f->rows[d];
+    row.assign(counts.begin(), counts.end());
+    std::sort(row.begin(), row.end());
+    width = std::max(width, int(row.size()));
+  }
+  return width;
+}
+
+// Fill padded (rows, L) arrays from handle state; frees the state.
+void ftok_encode_fill(void* h, int32_t* ids, float* counts, int n_rows, int L) {
+  auto* f = static_cast<Featurizer*>(h);
+  std::memset(ids, 0, sizeof(int32_t) * size_t(n_rows) * L);
+  std::memset(counts, 0, sizeof(float) * size_t(n_rows) * L);
+  const int n = std::min<int>(f->rows.size(), n_rows);
+  std::vector<std::pair<int, float>> kept;
+  for (int d = 0; d < n; ++d) {
+    auto* row = &f->rows[d];
+    if (int(row->size()) > L) {
+      // keep the L highest counts; ties resolved toward the lower bucket id
+      // (numpy stable argsort(-val) over id-sorted input), then re-sort by id
+      kept.assign(row->begin(), row->end());
+      std::stable_sort(kept.begin(), kept.end(),
+                       [](const auto& a, const auto& b) { return a.second > b.second; });
+      kept.resize(L);
+      std::sort(kept.begin(), kept.end());
+      row = &kept;
+    }
+    int32_t* idp = ids + size_t(d) * L;
+    float* ctp = counts + size_t(d) * L;
+    for (size_t j = 0; j < row->size(); ++j) {
+      idp[j] = (*row)[j].first;
+      ctp[j] = (*row)[j].second;
+    }
+  }
+  f->rows.clear();
+}
+
+}  // extern "C"

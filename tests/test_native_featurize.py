@@ -1,0 +1,121 @@
+"""Bit-parity tests: native C++ featurizer vs the pure-Python reference path.
+
+The native module's entire contract is producing byte-identical EncodedBatch
+arrays to featurize/{text,hashing,tfidf}.py (which in turn carry Spark
+artifact parity) — any divergence silently shifts F1, SURVEY.md §7 hard
+part 1. Tests compare the two paths on adversarial inputs.
+"""
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.featurize.hashing import spark_hash_bucket
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.featurize import native as native_mod
+
+pytestmark = pytest.mark.skipif(not native_mod.available(),
+                                reason="native toolchain unavailable")
+
+TRICKY = [
+    "Agent: hello, this is the PRIZE department!!",
+    "",                                  # Java "".split -> [""] -> empty token hashed
+    "    ",                              # all-space: trailing empties dropped -> no tokens? (leading kept)
+    "a  b   c",                          # interior empty tokens are real tokens
+    "  leading and trailing  ",
+    "ALL CAPS SHOUTING 123 $$$",
+    "İstanbul KelvinK sign",        # U+0130 -> i, U+212A -> k
+    "café naïve résumé",                 # accents strip entirely
+    "emoji 🎉 and ümlauts stay out",
+    "tab\tand\nnewline\x0bseparators",   # cleaned before split: only ' ' remains
+    "don't stop-words i'm it's",         # apostrophes strip; stopword forms change
+    "word " * 500 + "tail",              # long doc
+    "the and a of to in is was",         # all stopwords
+]
+
+
+def _python_twin(feat: HashingTfIdfFeaturizer) -> HashingTfIdfFeaturizer:
+    twin = HashingTfIdfFeaturizer(
+        num_features=feat.num_features, idf=feat.idf, binary_tf=feat.binary_tf,
+        stop_filter=feat.stop_filter, remove_stopwords=feat.remove_stopwords)
+    twin._native_tried = True  # force pure-Python encode
+    twin._native = None
+    return twin
+
+
+@pytest.mark.parametrize("binary", [False, True])
+@pytest.mark.parametrize("remove_stopwords", [True, False])
+def test_encode_parity(binary, remove_stopwords):
+    feat = HashingTfIdfFeaturizer(num_features=1000, binary_tf=binary,
+                                  remove_stopwords=remove_stopwords)
+    assert feat._native_featurizer() is not None
+    twin = _python_twin(feat)
+    got = feat.encode(TRICKY, batch_size=16)
+    want = twin.encode(TRICKY, batch_size=16)
+    np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+
+
+def test_encode_parity_with_truncation():
+    # force L smaller than the unique-bucket width to hit the top-count rule
+    feat = HashingTfIdfFeaturizer(num_features=5000)
+    twin = _python_twin(feat)
+    long_doc = " ".join(f"tok{i} tok{i}" if i % 3 == 0 else f"tok{i}" for i in range(200))
+    got = feat.encode([long_doc], batch_size=2, max_tokens=32)
+    want = twin.encode([long_doc], batch_size=2, max_tokens=32)
+    np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+
+
+def test_hash_parity_random_strings():
+    import random
+    import string
+
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    nat = feat._native_featurizer()
+    rng = random.Random(7)
+    terms = ["".join(rng.choices(string.ascii_lowercase, k=rng.randint(0, 12)))
+             for _ in range(500)]
+    for t in terms:
+        assert nat.hash_bucket(t) == spark_hash_bucket(t, 10000)
+
+
+def test_nul_byte_parity():
+    feat = HashingTfIdfFeaturizer(num_features=1000)
+    twin = _python_twin(feat)
+    texts = ["abc\x00def ghi", "\x00", "a\x00 b"]
+    got = feat.encode(texts, batch_size=4)
+    want = twin.encode(texts, batch_size=4)
+    np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+
+
+def test_corpus_scale_parity():
+    from fraud_detection_tpu.data import generate_corpus
+
+    docs = [d.text for d in generate_corpus(n=200, seed=33)]
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    twin = _python_twin(feat)
+    got = feat.encode(docs, batch_size=256)
+    want = twin.encode(docs, batch_size=256)
+    np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+
+
+def test_native_speedup_sanity():
+    """Native path should comfortably beat Python on a big batch (not a strict
+    perf gate — just catches an accidentally-disabled fast path)."""
+    import time
+
+    from fraud_detection_tpu.data import generate_corpus
+
+    docs = [d.text for d in generate_corpus(n=500, seed=5)]
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    twin = _python_twin(feat)
+    feat.encode(docs, batch_size=512)  # warm (library load)
+    t0 = time.perf_counter()
+    feat.encode(docs, batch_size=512)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    twin.encode(docs, batch_size=512)
+    t_python = time.perf_counter() - t0
+    assert t_native < t_python, (t_native, t_python)
