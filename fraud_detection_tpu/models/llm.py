@@ -1,0 +1,378 @@
+"""On-pod explanation LLM: a TPU-native decoder-only transformer.
+
+BASELINE.json config 5 asks for the DeepSeek HTTPS round-trip
+(/root/reference/utils/agent_api.py:36,66) to be replaceable by a model served
+from the same pod as the classifier. This module is that model: a standard
+pre-norm decoder (RMSNorm / RoPE multi-head attention / SwiGLU), written as
+pure-functional JAX over a params pytree so the same forward runs
+
+  * single-chip (tests, small models),
+  * tensor-parallel over a mesh "model" axis — head-sharded attention and
+    hidden-sharded MLP with GSPMD inserting the all-reduces (the Megatron
+    column/row-parallel layout expressed as shardings, not explicit
+    collectives), and
+  * sequence-parallel for long transcripts via **ring attention**
+    (``ring_attention``): each device holds a sequence shard, K/V blocks
+    rotate around the ring with ``ppermute`` while a flash-style online
+    softmax accumulates — exact attention, memory O(T/n) per chip, ICI
+    traffic fully overlapped block math.
+
+The byte-level tokenizer keeps the model self-contained (no vocab downloads,
+zero egress); real pretrained weights can be converted into the same pytree
+layout offline.  ``LanguageModel.generate_text`` plugs into the explanation
+layer through ``explain.onpod.OnPodBackend.from_model``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 258          # 256 bytes + BOS + EOS
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1024
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.float32  # bfloat16 on real TPU runs
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    BOS: int = field(default=256, init=False)
+    EOS: int = field(default=257, init=False)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    """Random-init parameter pytree. Layout (per layer l):
+    wq/wk/wv (D, H, d), wo (H, d, D), w_gate/w_up (D, F), w_down (F, D),
+    ln1/ln2 (D,), plus embed (V, D) and ln_f (D,). Output head ties embed."""
+    keys = jax.random.split(rng, cfg.n_layers * 7 + 1)
+    scale = 1.0 / math.sqrt(cfg.d_model)
+    p: Params = {
+        "embed": (jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model)) * scale
+                  ).astype(cfg.dtype)}
+    h, d = cfg.n_heads, cfg.head_dim
+    for l in range(cfg.n_layers):
+        k = keys[1 + l * 7 : 1 + (l + 1) * 7]
+        p[f"l{l}.wq"] = (jax.random.normal(k[0], (cfg.d_model, h, d)) * scale).astype(cfg.dtype)
+        p[f"l{l}.wk"] = (jax.random.normal(k[1], (cfg.d_model, h, d)) * scale).astype(cfg.dtype)
+        p[f"l{l}.wv"] = (jax.random.normal(k[2], (cfg.d_model, h, d)) * scale).astype(cfg.dtype)
+        p[f"l{l}.wo"] = (jax.random.normal(k[3], (h, d, cfg.d_model)) * scale).astype(cfg.dtype)
+        p[f"l{l}.w_gate"] = (jax.random.normal(k[4], (cfg.d_model, cfg.d_ff)) * scale).astype(cfg.dtype)
+        p[f"l{l}.w_up"] = (jax.random.normal(k[5], (cfg.d_model, cfg.d_ff)) * scale).astype(cfg.dtype)
+        p[f"l{l}.w_down"] = (jax.random.normal(k[6], (cfg.d_ff, cfg.d_model)) * scale).astype(cfg.dtype)
+        p[f"l{l}.ln1"] = jnp.ones(cfg.d_model, cfg.dtype)
+        p[f"l{l}.ln2"] = jnp.ones(cfg.d_model, cfg.dtype)
+    p["ln_f"] = jnp.ones(cfg.d_model, cfg.dtype)
+    return p
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, NamedSharding]:
+    """Megatron TP layout as shardings: attention sharded over heads, MLP over
+    the hidden dim; norms/embeddings replicated. GSPMD derives the matching
+    activation collectives (all-reduce after row-parallel wo / w_down)."""
+    s: Dict[str, NamedSharding] = {}
+    rep = NamedSharding(mesh, P())
+    for name in ("embed", "ln_f"):
+        s[name] = rep
+    for l in range(cfg.n_layers):
+        s[f"l{l}.wq"] = NamedSharding(mesh, P(None, MODEL_AXIS, None))
+        s[f"l{l}.wk"] = NamedSharding(mesh, P(None, MODEL_AXIS, None))
+        s[f"l{l}.wv"] = NamedSharding(mesh, P(None, MODEL_AXIS, None))
+        s[f"l{l}.wo"] = NamedSharding(mesh, P(MODEL_AXIS, None, None))
+        s[f"l{l}.w_gate"] = NamedSharding(mesh, P(None, MODEL_AXIS))
+        s[f"l{l}.w_up"] = NamedSharding(mesh, P(None, MODEL_AXIS))
+        s[f"l{l}.w_down"] = NamedSharding(mesh, P(MODEL_AXIS, None))
+        s[f"l{l}.ln1"] = rep
+        s[f"l{l}.ln2"] = rep
+    return s
+
+
+def shard_params(params: Params, cfg: TransformerConfig, mesh: Mesh) -> Params:
+    sh = param_shardings(cfg, mesh)
+    return {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, gamma: jax.Array, eps: float = 1e-6) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * gamma
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: (..., T, H, d); positions: (..., T)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # (..., T, d/2)
+    cos = jnp.cos(angles)[..., :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[..., :, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+def _attend(q, k, v, mask) -> jax.Array:
+    """Plain masked attention. q: (B,T,H,d), k/v: (B,S,H,d), mask (T,S)."""
+    scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(q.shape[-1])
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# ring attention (sequence parallelism)
+# ---------------------------------------------------------------------------
+
+def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
+                            scale: float):
+    """Per-shard body (runs under shard_map): exact causal attention with K/V
+    blocks rotating around the ring, flash-style online softmax.
+
+    q, k, v: (B, T_loc, H, d) — this device's sequence shard.
+    Device r owns global positions [r*T_loc, (r+1)*T_loc).
+    """
+    idx = jax.lax.axis_index(axis_name)
+    B, T, H, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    def step(s, carry):
+        k_blk, v_blk, m, l, acc = carry
+        # after s rotations device idx holds the block produced by idx - s
+        src = (idx - s) % blocks_per_ring
+        scores = jnp.einsum("bthd,bshd->bhts", qf, k_blk.astype(jnp.float32)) * scale
+        q_pos = idx * T + jnp.arange(T)
+        k_pos = src * T + jnp.arange(T)
+        causal = q_pos[:, None] >= k_pos[None, :]            # (T, S)
+        scores = jnp.where(causal[None, None], scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)                   # (B,H,T)
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked rows (no valid key yet in this block)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = (acc * correction[..., None]
+                   + jnp.einsum("bhts,bshd->bthd", p, v_blk.astype(jnp.float32))
+                     .transpose(0, 2, 1, 3))
+        k_next = jax.lax.ppermute(
+            k_blk, axis_name, [(i, (i + 1) % blocks_per_ring) for i in range(blocks_per_ring)])
+        v_next = jax.lax.ppermute(
+            v_blk, axis_name, [(i, (i + 1) % blocks_per_ring) for i in range(blocks_per_ring)])
+        return k_next, v_next, m_new, l_new, acc_new
+
+    # pvary: the accumulators become device-varying on the first iteration, so
+    # their carry types must be marked varying over the ring axis up front.
+    m0 = jax.lax.pvary(jnp.full((B, H, T), -jnp.inf, jnp.float32), axis_name)
+    l0 = jax.lax.pvary(jnp.zeros((B, H, T), jnp.float32), axis_name)
+    acc0 = jax.lax.pvary(jnp.zeros((B, H, T, d), jnp.float32), axis_name)
+    _, _, m, l, acc = jax.lax.fori_loop(
+        0, blocks_per_ring, step, (k, v, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]             # (B,H,T,d)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)         # (B,T,H,d)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis_name: str = SEQ_AXIS) -> jax.Array:
+    """Exact causal attention with the sequence sharded over ``axis_name``.
+
+    q/k/v: (B, T, H, d) global arrays; T must divide by the axis size.
+    """
+    n = mesh.shape[axis_name]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    body = partial(_ring_attention_sharded, axis_name=axis_name,
+                   blocks_per_ring=n, scale=scale)
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            *, positions: Optional[jax.Array] = None,
+            kv_cache: Optional[Dict[str, jax.Array]] = None,
+            cache_len: Optional[jax.Array] = None,
+            seq_mesh: Optional[Mesh] = None) -> Tuple[jax.Array, Optional[Dict]]:
+    """Logits for a token batch (B, T) -> (B, T, V).
+
+    Three modes:
+      * full-sequence (kv_cache None, seq_mesh None): plain causal attention;
+      * ring (seq_mesh given): sequence-parallel exact attention — T sharded
+        over the mesh "seq" axis (prefill/scoring of long transcripts);
+      * incremental (kv_cache given): T == 1 decode step against the cache;
+        returns the updated cache.
+    """
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    x = params["embed"][tokens].astype(cfg.dtype)
+    new_cache: Optional[Dict[str, jax.Array]] = {} if kv_cache is not None else None
+
+    for l in range(cfg.n_layers):
+        h = rms_norm(x, params[f"l{l}.ln1"])
+        q = jnp.einsum("btD,Dhd->bthd", h, params[f"l{l}.wq"])
+        k = jnp.einsum("btD,Dhd->bthd", h, params[f"l{l}.wk"])
+        v = jnp.einsum("btD,Dhd->bthd", h, params[f"l{l}.wv"])
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        if kv_cache is not None:
+            # decode: append this step's k/v at cache_len, attend over prefix
+            ck = jax.lax.dynamic_update_slice(
+                kv_cache[f"l{l}.k"], k, (0, cache_len, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                kv_cache[f"l{l}.v"], v, (0, cache_len, 0, 0))
+            new_cache[f"l{l}.k"], new_cache[f"l{l}.v"] = ck, cv
+            S = ck.shape[1]
+            # causal within the appended block: row t sees keys <= cache_len+t
+            valid = jnp.arange(S)[None, :] <= (cache_len + jnp.arange(T))[:, None]
+            attn = _attend(q, ck, cv, valid)
+        elif seq_mesh is not None:
+            attn = ring_attention(q, k, v, seq_mesh)
+        else:
+            causal = jnp.tril(jnp.ones((T, T), bool))
+            attn = _attend(q, k, v, causal)
+
+        x = x + jnp.einsum("bthd,hdD->btD", attn, params[f"l{l}.wo"])
+        h2 = rms_norm(x, params[f"l{l}.ln2"])
+        gate = jax.nn.silu(h2 @ params[f"l{l}.w_gate"])
+        x = x + (gate * (h2 @ params[f"l{l}.w_up"])) @ params[f"l{l}.w_down"]
+
+    x = rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("btD,VD->btV", x, params["embed"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict[str, jax.Array]:
+    return {f"l{l}.{t}": jnp.zeros((batch, max_len, cfg.n_heads, cfg.head_dim), cfg.dtype)
+            for l in range(cfg.n_layers) for t in ("k", "v")}
+
+
+# ---------------------------------------------------------------------------
+# generation
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "max_new"))
+def _generate_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array,
+                  cfg: TransformerConfig, max_new: int,
+                  temperature: jax.Array, rng: jax.Array):
+    """Greedy/temperature decode. prompt: (1, Tp) padded; returns (1, max_new)."""
+    B, Tp = prompt.shape
+    max_len = Tp + max_new
+    cache = init_cache(cfg, B, max_len)
+    # prefill: run the padded prompt through decode-mode attention in one shot
+    logits, cache = forward(params, prompt, cfg,
+                            positions=jnp.broadcast_to(jnp.arange(Tp), (B, Tp)),
+                            kv_cache=cache, cache_len=jnp.int32(0))
+    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
+
+    def sample(logits_1, key):
+        greedy = jnp.argmax(logits_1, -1)
+        scaled = logits_1 / jnp.maximum(temperature, 1e-6)
+        drawn = jax.random.categorical(key, scaled, -1)
+        return jnp.where(temperature <= 1e-6, greedy, drawn).astype(jnp.int32)
+
+    def step(carry, _):
+        cache, last_logits, pos, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(last_logits, sub)                        # (B,)
+        logits, cache = forward(params, tok[:, None], cfg,
+                                positions=pos[:, None],
+                                kv_cache=cache, cache_len=pos[0])
+        return (cache, logits[:, 0], pos + 1, key), tok
+
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (cache, last, prompt_len, rng), None, length=max_new)
+    return toks.T  # (B, max_new)
+
+
+class ByteTokenizer:
+    """Self-contained byte-level tokenizer (no external vocab)."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    def encode(self, text: str) -> np.ndarray:
+        data = text.encode("utf-8")[: self.cfg.max_seq - 2]
+        return np.asarray([self.cfg.BOS] + list(data), np.int32)
+
+    def decode(self, tokens) -> str:
+        out = bytearray()
+        for t in np.asarray(tokens).tolist():
+            if t == self.cfg.EOS:
+                break
+            if 0 <= t < 256:
+                out.append(t)
+        return out.decode("utf-8", "replace")
+
+
+@dataclass
+class LanguageModel:
+    """Params + config + tokenizer behind a text-in/text-out API."""
+
+    cfg: TransformerConfig
+    params: Params
+    tokenizer: ByteTokenizer = None
+
+    def __post_init__(self):
+        if self.tokenizer is None:
+            self.tokenizer = ByteTokenizer(self.cfg)
+
+    @classmethod
+    def init_random(cls, cfg: Optional[TransformerConfig] = None, seed: int = 0,
+                    mesh: Optional[Mesh] = None) -> "LanguageModel":
+        cfg = cfg or TransformerConfig()
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        if mesh is not None:
+            params = shard_params(params, cfg, mesh)
+        return cls(cfg, params)
+
+    def generate_tokens(self, prompt_tokens: np.ndarray, *, max_new_tokens: int = 64,
+                        temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        prompt_len = len(prompt_tokens)
+        pad = 8 * ((prompt_len + 7) // 8)  # bucket prompt lengths: fewer recompiles
+        prompt = np.zeros((1, pad), np.int32)
+        prompt[0, :prompt_len] = prompt_tokens
+        toks = _generate_jit(self.params, jnp.asarray(prompt),
+                             jnp.asarray([prompt_len], jnp.int32), self.cfg,
+                             int(max_new_tokens), jnp.float32(temperature),
+                             jax.random.PRNGKey(seed))
+        return np.asarray(toks)[0]
+
+    def generate_text(self, prompt: str, *, temperature: float = 0.0,
+                      max_new_tokens: int = 256, mesh: Optional[Mesh] = None,
+                      seed: int = 0) -> str:
+        del mesh  # params are already placed; kept for OnPodBackend signature
+        toks = self.generate_tokens(self.tokenizer.encode(prompt),
+                                    max_new_tokens=max_new_tokens,
+                                    temperature=temperature, seed=seed)
+        return self.tokenizer.decode(toks)
