@@ -1,0 +1,90 @@
+"""Tests for the streamlit-free UI helper layer and app wiring."""
+
+import numpy as np
+
+from fraud_detection_tpu.app.ui_helpers import (
+    batch_result_rows,
+    confidence_text,
+    load_app_css,
+    message_card,
+    styled_badge,
+)
+
+
+def test_css_packaged():
+    css = load_app_css()
+    assert ".fraud-badge" in css and ".kafka-card" in css
+
+
+def test_styled_badge_escapes_and_colors():
+    scam = styled_badge(1, "Potential Scam")
+    ok = styled_badge(0, "Normal <&> Conversation")
+    assert "#d9534f" in scam and "Potential Scam" in scam
+    assert "#3fb950" in ok
+    assert "<&>" not in ok and "&lt;&amp;&gt;" in ok
+
+
+def test_message_card_renders_result():
+    card = message_card({
+        "prediction": 1, "label": "Potential Scam", "confidence": 0.987,
+        "original_text": "give me your <b>SSN</b> now", "analysis": "clear scam",
+    })
+    assert "98.7%" in card
+    assert "&lt;b&gt;SSN&lt;/b&gt;" in card  # escaped
+    assert "clear scam" in card
+    assert "kafka-card" in card
+
+
+def test_message_card_handles_malformed():
+    card = message_card({"error": "malformed message", "prediction": None,
+                         "original": "junk bytes"})
+    assert "error" in card
+    assert "junk bytes" in card
+
+
+def test_message_card_truncates_long_text():
+    card = message_card({"prediction": 0, "label": "Normal Conversation",
+                         "confidence": 0.5, "original_text": "x" * 1000})
+    assert "…" in card and "x" * 500 not in card
+
+
+def test_batch_result_rows():
+    rows = batch_result_rows(["a", "b"], np.asarray([1, 0]), np.asarray([0.9, 0.2]))
+    assert rows[0]["label"] == "Potential Scam"
+    assert rows[0]["confidence"] == 0.9
+    assert rows[1]["label"] == "Normal Conversation"
+    assert abs(rows[1]["confidence"] - 0.8) < 1e-9
+    assert confidence_text(0.913) == "91.3%"
+
+
+def test_build_agent_offline(monkeypatch):
+    from fraud_detection_tpu.app.ui import build_agent
+    from fraud_detection_tpu.utils import AppConfig
+
+    cfg = AppConfig.from_env({"FRAUD_BATCH_SIZE": "32"})
+    agent = build_agent(cfg, "Offline (no LLM)", "", temperature=0.5)
+    res = agent.classify_and_explain("agent: hello urgent prize winner claim now")
+    assert "prediction" in res
+    assert "offline mode" in res["analysis"]
+
+
+def test_monitor_state_threadsafe_demo_run():
+    """Drive the tab-3 monitor path headless: demo broker + engine thread."""
+    import time
+
+    from fraud_detection_tpu.app.ui import MonitorState, build_agent, start_monitor
+    from fraud_detection_tpu.utils import AppConfig
+
+    cfg = AppConfig.from_env({"FRAUD_BATCH_SIZE": "64", "FRAUD_MAX_WAIT": "0.01"})
+    agent = build_agent(cfg, "Offline (no LLM)", "", temperature=0.0)
+    state = MonitorState()
+    start_monitor(state, agent, cfg, demo=True)
+    deadline = time.time() + 30
+    while time.time() < deadline and state.engine.stats.processed < 500:
+        time.sleep(0.1)
+    state.engine.stop()
+    state.thread.join(timeout=10)
+    assert state.engine.stats.processed == 500
+    snap = state.snapshot(5)
+    assert len(snap) == 5
+    assert all("prediction" in p for p in snap)
