@@ -117,6 +117,8 @@ class TreeTrainConfig:
     reg_lambda: float = 1.0       # xgb: L2 on leaf values and split gain
     min_child_weight: float = 1e-6
     learning_rate: float = 0.3    # xgb: leaf-value shrinkage (eta)
+    use_pallas: bool = False      # Pallas histogram + gain-scan kernels (ops/)
+                                  # for the no-feature-mask path (DT/boosting)
 
 
 def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfig):
@@ -155,37 +157,54 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
         totals = jax.ops.segment_sum(stats, seg_node, num_segments=width + 1)[:-1]
         node_stats = node_stats.at[offset : offset + width].set(totals)
 
-        def hist_one_feature(fbins):
-            seg = jnp.where(seg_valid, local * nb + fbins, width * nb)
-            return jax.ops.segment_sum(stats, seg, num_segments=width * nb + 1)[:-1]
-        hist = jax.vmap(hist_one_feature, in_axes=1)(bins)      # (F, L*NB, K)
-        hist = hist.reshape(f, width, nb, k).transpose(1, 0, 2, 3)  # (L,F,NB,K)
+        use_pallas = cfg.use_pallas and feature_mask_keys is None
+        if use_pallas:
+            from fraud_detection_tpu.ops.histogram import (
+                auto_interpret, best_splits, node_feature_bin_histogram)
+
+            hist = node_feature_bin_histogram(
+                bins, jnp.where(seg_valid, local, width), stats,
+                n_nodes=width, n_bins=nb, interpret=auto_interpret())
+        else:
+            def hist_one_feature(fbins):
+                seg = jnp.where(seg_valid, local * nb + fbins, width * nb)
+                return jax.ops.segment_sum(stats, seg, num_segments=width * nb + 1)[:-1]
+            hist = jax.vmap(hist_one_feature, in_axes=1)(bins)      # (F, L*NB, K)
+            hist = hist.reshape(f, width, nb, k).transpose(1, 0, 2, 3)  # (L,F,NB,K)
 
         if level == depth:
             break  # deepest level: leaves only
 
-        cum = jnp.cumsum(hist, axis=2)                           # left stats per bin
-        total_b = totals[:, None, None, :]
-        if cfg.criterion == "gini":
-            gain = _gini_gain(cum, total_b)                      # (L, F, NB)
+        if use_pallas:
+            from fraud_detection_tpu.ops.histogram import auto_interpret, best_splits
+
+            best_f, best_b, best_gain = best_splits(
+                hist, totals, criterion=cfg.criterion, n_bins=nb,
+                reg_lambda=cfg.reg_lambda, min_child_weight=cfg.min_child_weight,
+                interpret=auto_interpret())
         else:
-            gain = _xgb_gain(cum, total_b, cfg.reg_lambda, cfg.min_child_weight)
-        gain = gain[:, :, : nb - 1]                              # last bin: no right side
+            cum = jnp.cumsum(hist, axis=2)                           # left stats per bin
+            total_b = totals[:, None, None, :]
+            if cfg.criterion == "gini":
+                gain = _gini_gain(cum, total_b)                      # (L, F, NB)
+            else:
+                gain = _xgb_gain(cum, total_b, cfg.reg_lambda, cfg.min_child_weight)
+            gain = gain[:, :, : nb - 1]                              # last bin: no right side
 
-        if feature_mask_keys is not None:
-            p_keep = jnp.sqrt(jnp.float32(f)) / f
-            mask = jax.random.bernoulli(feature_mask_keys[level], p_keep, (width, f))
-            # Bias-free fallback: a node that drew an empty subset (probability
-            # ~(1-p)^F, astronomically rare) considers all features.
-            empty = ~mask.any(axis=1)
-            mask = mask | empty[:, None]
-            gain = jnp.where(mask[:, :, None], gain, -jnp.inf)
+            if feature_mask_keys is not None:
+                p_keep = jnp.sqrt(jnp.float32(f)) / f
+                mask = jax.random.bernoulli(feature_mask_keys[level], p_keep, (width, f))
+                # Bias-free fallback: a node that drew an empty subset (probability
+                # ~(1-p)^F, astronomically rare) considers all features.
+                empty = ~mask.any(axis=1)
+                mask = mask | empty[:, None]
+                gain = jnp.where(mask[:, :, None], gain, -jnp.inf)
 
-        flat = gain.reshape(width, -1)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        best_f = (best // (nb - 1)).astype(jnp.int32)
-        best_b = (best % (nb - 1)).astype(jnp.int32)
+            flat = gain.reshape(width, -1)
+            best = jnp.argmax(flat, axis=1)
+            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+            best_f = (best // (nb - 1)).astype(jnp.int32)
+            best_b = (best % (nb - 1)).astype(jnp.int32)
         do_split = best_gain > cfg.min_info_gain
 
         pos = offset + jnp.arange(width)

@@ -1,0 +1,21 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Histogram tree building reformulated as MXU matmuls (ops/histogram.py) —
+the kernels BASELINE.json calls for. XLA fallback paths live next to every
+kernel; off-TPU the kernels run in interpreter mode so the CPU test mesh
+exercises them.
+"""
+
+from fraud_detection_tpu.ops.histogram import (
+    auto_interpret,
+    best_splits,
+    histogram_reference,
+    node_feature_bin_histogram,
+)
+
+__all__ = [
+    "auto_interpret",
+    "best_splits",
+    "histogram_reference",
+    "node_feature_bin_histogram",
+]

@@ -1,0 +1,212 @@
+"""Pallas TPU kernels for histogram tree building.
+
+The tree trainer's hot op is the per-level (node, feature, bin) statistics
+histogram over the sharded row set (models/train_trees.py:158-162 — the
+XLA path vmaps a segment-sum over all 10k features). On TPU the idiomatic
+formulation is a matmul, not a scatter: for a row tile,
+
+    hist[f*NB+b, l*K+k] = sum_r  onehot(bins[r,f]==b) * onehot(node[r]==l) * stats[r,k]
+                        =        multihot_bins^T  @  (node_onehot (x) stats)
+
+— one (F_t*NB, R) @ (R, L*K) contraction per (feature-tile, row-tile) grid
+cell, accumulated over row tiles in VMEM. The scatter becomes MXU work at
+full systolic utilization; this is the same reformulation the reference's
+XGBoost applies on GPU with atomics, done the TPU way (BASELINE.json:
+"histogram build ... becomes Pallas kernels").
+
+The split-gain scan (cumsum over bins + impurity gain + argmax — the
+per-level decision) ships here too as a fused VPU kernel.
+
+Both kernels run under ``interpret=True`` off-TPU so the CPU test mesh
+exercises them; ``auto_interpret()`` picks per backend.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# histogram kernel
+# ---------------------------------------------------------------------------
+
+def _hist_kernel(bins_ref, local_ref, stats_ref, out_ref, *, n_bins: int,
+                 n_nodes: int, k: int):
+    """One (feature-tile, row-tile) cell: out += multihot^T @ (node (x) stats)."""
+    r_idx = pl.program_id(1)
+
+    @pl.when(r_idx == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[:]                         # (R, Ft) int32
+    local = local_ref[:, 0]                    # (R,) int32; >= n_nodes -> inactive
+    stats = stats_ref[:]                       # (R, K) f32
+
+    R, Ft = bins.shape
+    # multi-hot over the flattened (feature-in-tile, bin) axis
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (R, Ft, n_bins), 2)
+    multihot = (bin_iota == bins[:, :, None]).reshape(R, Ft * n_bins)
+    # node-onehot (x) stats -> (R, L*K); inactive rows are all-zero
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (R, n_nodes), 1)
+    node_onehot = (node_iota == local[:, None]).astype(stats.dtype)
+    ns = (node_onehot[:, :, None] * stats[:, None, :]).reshape(R, n_nodes * k)
+
+    out_ref[:] += jax.lax.dot_general(
+        multihot.astype(stats.dtype), ns,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins", "row_tile",
+                                   "feature_tile", "interpret"))
+def node_feature_bin_histogram(
+    bins: jax.Array,      # (N, F) int32 bin ids
+    local: jax.Array,     # (N,) int32 node position within the level; >= n_nodes = skip
+    stats: jax.Array,     # (N, K) f32 per-row statistics (weights folded in)
+    *,
+    n_nodes: int,
+    n_bins: int,
+    row_tile: int = 512,
+    feature_tile: int = 32,
+    interpret: bool = False,
+) -> jax.Array:
+    """(n_nodes, F, n_bins, K) statistics histogram via the Pallas kernel."""
+    n, f = bins.shape
+    k = stats.shape[-1]
+    n_pad = _round_up(max(n, 1), row_tile)
+    f_pad = _round_up(max(f, 1), feature_tile)
+    bins_p = jnp.zeros((n_pad, f_pad), jnp.int32)
+    bins_p = bins_p.at[:n, :f].set(bins)
+    local_p = jnp.full((n_pad, 1), n_nodes, jnp.int32).at[:n, 0].set(local)
+    stats_p = jnp.zeros((n_pad, k), stats.dtype).at[:n].set(stats)
+
+    grid = (f_pad // feature_tile, n_pad // row_tile)
+    out = pl.pallas_call(
+        partial(_hist_kernel, n_bins=n_bins, n_nodes=n_nodes, k=k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((row_tile, feature_tile), lambda fi, ri: (ri, fi),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, 1), lambda fi, ri: (ri, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, k), lambda fi, ri: (ri, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((feature_tile * n_bins, n_nodes * k),
+                               lambda fi, ri: (fi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * n_bins, n_nodes * k), jnp.float32),
+        interpret=interpret,
+    )(bins_p, local_p, stats_p)
+
+    hist = out.reshape(f_pad, n_bins, n_nodes, k)[:f]
+    return hist.transpose(2, 0, 1, 3)  # (L, F, NB, K)
+
+
+def histogram_reference(bins, local, stats, *, n_nodes: int, n_bins: int) -> jax.Array:
+    """XLA segment-sum formulation (models/train_trees.py:158-162 shape)."""
+    valid = local < n_nodes
+    seg_local = jnp.where(valid, local, n_nodes)
+
+    def one_feature(fbins):
+        seg = jnp.where(valid, seg_local * n_bins + fbins, n_nodes * n_bins)
+        return jax.ops.segment_sum(stats, seg, num_segments=n_nodes * n_bins + 1)[:-1]
+
+    hist = jax.vmap(one_feature, in_axes=1)(bins)       # (F, L*NB, K)
+    f = bins.shape[1]
+    return hist.reshape(f, n_nodes, n_bins, -1).transpose(1, 0, 2, 3)
+
+
+# ---------------------------------------------------------------------------
+# split-gain scan kernel
+# ---------------------------------------------------------------------------
+
+def _gain_kernel(hist_ref, total_ref, best_idx_ref, best_gain_ref, *,
+                 n_bins: int, criterion: str, reg_lambda: float,
+                 min_child_weight: float):
+    """One node: cumsum over bins, impurity gain, argmax over (F, NB-1)."""
+    hist = hist_ref[0].astype(jnp.float32)        # block (1, F, NB*K) -> (F, NB*K)
+    F = hist.shape[0]
+    k = hist.shape[1] // n_bins
+    hist = hist.reshape(F, n_bins, k)
+    total = total_ref[0].astype(jnp.float32)      # (K,)
+
+    left = jnp.cumsum(hist, axis=1)               # (F, NB, K)
+    right = total[None, None, :] - left
+    if criterion == "gini":
+        def gini_sum(s):
+            cnt = jnp.sum(s, axis=-1)
+            sq = jnp.sum(s * s, axis=-1)
+            return cnt - sq / jnp.maximum(cnt, 1e-12), cnt
+        (g_l, n_l) = gini_sum(left)
+        (g_r, n_r) = gini_sum(right)
+        (g_p, n_p) = gini_sum(total[None, None, :])
+        gain = (g_p - g_l - g_r) / jnp.maximum(n_p, 1e-12)
+        valid = (n_l > 0) & (n_r > 0)
+    else:  # xgb second-order gain; stats layout (grad, hess, count)
+        gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
+        gr, hr, cr = right[..., 0], right[..., 1], right[..., 2]
+        gp, hp = total[0], total[1]
+        score = lambda g, h: (g * g) / (h + reg_lambda)
+        gain = 0.5 * (score(gl, hl) + score(gr, hr) - score(gp, hp))
+        valid = (hl >= min_child_weight) & (hr >= min_child_weight) & \
+                (cl > 0) & (cr > 0)
+    gain = jnp.where(valid, gain, -jnp.inf)[:, : n_bins - 1]   # last bin: no right
+    flat = gain.reshape(-1)
+    best = jnp.argmax(flat)
+    best_idx_ref[0, 0] = best.astype(jnp.int32)
+    best_gain_ref[0, 0] = flat[best]
+
+
+@partial(jax.jit, static_argnames=("criterion", "n_bins", "reg_lambda",
+                                   "min_child_weight", "interpret"))
+def best_splits(
+    hist: jax.Array,       # (L, F, NB, K)
+    totals: jax.Array,     # (L, K)
+    *,
+    criterion: str = "gini",
+    n_bins: int = 32,
+    reg_lambda: float = 1.0,
+    min_child_weight: float = 1e-6,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per node: (best_feature, best_bin, best_gain) fused on the VPU."""
+    L, F, NB, K = hist.shape
+    flat_hist = hist.reshape(L, F, NB * K)
+    idx, gain = pl.pallas_call(
+        partial(_gain_kernel, n_bins=NB, criterion=criterion,
+                reg_lambda=reg_lambda, min_child_weight=min_child_weight),
+        grid=(L,),
+        in_specs=[
+            pl.BlockSpec((1, F, NB * K), lambda l: (l, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, K), lambda l: (l, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda l: (l, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda l: (l, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((L, 1), jnp.int32),
+            jax.ShapeDtypeStruct((L, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(flat_hist, totals)
+    idx = idx[:, 0]
+    return (idx // (NB - 1)).astype(jnp.int32), (idx % (NB - 1)).astype(jnp.int32), gain[:, 0]

@@ -1,0 +1,123 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh): histogram and
+gain-scan kernels must agree exactly with the XLA formulations, and trees
+built through the Pallas path must match trees built through the XLA path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.ops import (
+    best_splits,
+    histogram_reference,
+    node_feature_bin_histogram,
+)
+
+
+@pytest.fixture(scope="module")
+def hist_case():
+    rng = np.random.default_rng(0)
+    n, f, nb, L, k = 300, 40, 8, 4, 3
+    bins = jnp.asarray(rng.integers(0, nb, (n, f)), jnp.int32)
+    local = jnp.asarray(rng.integers(0, L + 1, (n,)), jnp.int32)  # L = inactive
+    stats = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+    return bins, local, stats, L, nb
+
+
+def test_histogram_kernel_matches_reference(hist_case):
+    bins, local, stats, L, nb = hist_case
+    got = node_feature_bin_histogram(bins, local, stats, n_nodes=L, n_bins=nb,
+                                     row_tile=64, feature_tile=16, interpret=True)
+    want = histogram_reference(bins, local, stats, n_nodes=L, n_bins=nb)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_histogram_kernel_ragged_sizes():
+    """N and F not multiples of the tiles: padding must not leak into bins."""
+    rng = np.random.default_rng(1)
+    n, f, nb, L = 127, 13, 4, 2
+    bins = jnp.asarray(rng.integers(0, nb, (n, f)), jnp.int32)
+    local = jnp.asarray(rng.integers(0, L, (n,)), jnp.int32)
+    stats = jnp.asarray(np.ones((n, 1), np.float32))
+    got = node_feature_bin_histogram(bins, local, stats, n_nodes=L, n_bins=nb,
+                                     row_tile=32, feature_tile=8, interpret=True)
+    want = histogram_reference(bins, local, stats, n_nodes=L, n_bins=nb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+    # every row lands exactly once per feature
+    assert np.allclose(np.asarray(got).sum(axis=(0, 2, 3)), n)
+
+
+@pytest.mark.parametrize("criterion", ["gini", "xgb"])
+def test_gain_scan_matches_xla(criterion):
+    from fraud_detection_tpu.models.train_trees import _gini_gain, _xgb_gain
+
+    rng = np.random.default_rng(2)
+    L, F, NB, K = 4, 24, 8, 3
+    if criterion == "gini":
+        hist = jnp.asarray(rng.integers(0, 10, (L, F, NB, K)).astype(np.float32))
+    else:
+        g = rng.normal(size=(L, F, NB, 1)).astype(np.float32)
+        h = rng.uniform(0.1, 1.0, (L, F, NB, 1)).astype(np.float32)
+        c = rng.integers(1, 5, (L, F, NB, 1)).astype(np.float32)
+        hist = jnp.asarray(np.concatenate([g, h, c], axis=-1))
+    totals = hist.sum(axis=(1, 2)) / F  # per-node totals (sum over one feature's bins)
+    # recompute the way the builder does: totals from a single feature's bins
+    totals = hist[:, 0].sum(axis=1)
+
+    cum = jnp.cumsum(hist, axis=2)
+    total_b = totals[:, None, None, :]
+    if criterion == "gini":
+        gain = _gini_gain(cum, total_b)
+    else:
+        gain = _xgb_gain(cum, total_b, 1.0, 1e-6)
+    gain = gain[:, :, : NB - 1]
+    flat = np.asarray(gain.reshape(L, -1))
+    want_best = flat.argmax(axis=1)
+    want_gain = flat[np.arange(L), want_best]
+
+    bf, bb, bg = best_splits(hist, totals, criterion=criterion, n_bins=NB,
+                             interpret=True)
+    np.testing.assert_array_equal(np.asarray(bf), want_best // (NB - 1))
+    np.testing.assert_array_equal(np.asarray(bb), want_best % (NB - 1))
+    np.testing.assert_allclose(np.asarray(bg), want_gain, rtol=1e-5, atol=1e-6)
+
+
+def test_tree_built_with_pallas_matches_xla_path():
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_decision_tree
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 24)).astype(np.float32)
+    y = ((X[:, 3] > 0.2) ^ (X[:, 10] < -0.1)).astype(np.float32)
+
+    base = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=4))
+    pall = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=4, use_pallas=True))
+
+    np.testing.assert_array_equal(np.asarray(base.feature), np.asarray(pall.feature))
+    np.testing.assert_array_equal(np.asarray(base.left), np.asarray(pall.left))
+    np.testing.assert_allclose(np.asarray(base.threshold), np.asarray(pall.threshold),
+                               rtol=1e-6, atol=1e-6)
+    p_base = trees_mod.predict(base, jnp.asarray(X))[1]
+    p_pall = trees_mod.predict(pall, jnp.asarray(X))[1]
+    np.testing.assert_allclose(np.asarray(p_base), np.asarray(p_pall), rtol=1e-6)
+
+
+def test_boosting_with_pallas_matches_xla_path():
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.train_trees import (
+        TreeTrainConfig, fit_gradient_boosting)
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(300, 16)).astype(np.float32)
+    y = (X[:, 1] + 0.5 * X[:, 7] > 0).astype(np.float32)
+
+    kw = dict(n_rounds=5)
+    base = fit_gradient_boosting(
+        X, y, config=TreeTrainConfig(max_depth=3, criterion="xgb"), **kw)
+    pall = fit_gradient_boosting(
+        X, y, config=TreeTrainConfig(max_depth=3, criterion="xgb", use_pallas=True), **kw)
+    p_base = trees_mod.predict(base, jnp.asarray(X))[1]
+    p_pall = trees_mod.predict(pall, jnp.asarray(X))[1]
+    np.testing.assert_allclose(np.asarray(p_base), np.asarray(p_pall),
+                               rtol=1e-4, atol=1e-5)
