@@ -48,7 +48,7 @@ def main() -> None:
     from fraud_detection_tpu.data import generate_corpus
     from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "1024"))
+    batch_size = int(os.environ.get("BENCH_BATCH", "4096"))
     n_msgs = int(os.environ.get("BENCH_MSGS", "20000"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
 

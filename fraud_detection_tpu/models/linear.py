@@ -87,6 +87,20 @@ def _predict_encoded(model: LogisticRegression, ids: jax.Array, counts: jax.Arra
     return (p > model.threshold).astype(jnp.int32), p
 
 
+@jax.jit
+def _prob_encoded(model: LogisticRegression, ids: jax.Array, counts: jax.Array):
+    return jax.nn.sigmoid(margin_encoded(model, ids, counts))
+
+
+def prob_encoded(model: LogisticRegression, batch: EncodedBatch) -> jax.Array:
+    """Single-output serving path: (B,) p(class=1) only.
+
+    Fetching one array instead of (labels, probs) halves device->host
+    round-trips; labels are derived on the host with the identical
+    ``p > threshold`` comparison (thresholding commutes with the fetch)."""
+    return _prob_encoded(model, jnp.asarray(batch.ids), jnp.asarray(batch.counts))
+
+
 def predict_dense(model: LogisticRegression, x) -> tuple[jax.Array, jax.Array]:
     """Dense path: returns (predictions int32 (B,), probability of class 1 (B,))."""
     return _predict_dense(model, jnp.asarray(x))

@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from fraud_detection_tpu.checkpoint.spark_artifact import SparkPipelineArtifact
@@ -31,6 +32,35 @@ class PredictionBatch:
 
     def __iter__(self):
         return iter(zip(self.labels.tolist(), self.probabilities.tolist()))
+
+
+class PendingPrediction:
+    """Unresolved device results from ``ServingPipeline.predict_async``.
+
+    Holds per-chunk (probability, valid_count) device arrays whose host copy
+    was already initiated asynchronously at dispatch; ``resolve()`` blocks on
+    the device and returns host numpy arrays. Only p(class=1) crosses the
+    device->host link — labels come from the identical ``p > threshold``
+    comparison on the host (for trees, argmax over the normalized binary
+    proba reduces to the same comparison)."""
+
+    def __init__(self, parts: List[Tuple[object, int]], threshold: float = 0.5,
+                 argmax: bool = False):
+        self._parts = parts
+        self.threshold = threshold
+        self.argmax = argmax  # parts hold full (B, C) probas (multiclass trees)
+
+    def resolve(self) -> PredictionBatch:
+        if not self._parts:
+            return PredictionBatch(np.empty(0, np.int32), np.empty(0, np.float32))
+        host = np.concatenate([np.asarray(p)[:n] for p, n in self._parts])
+        if self.argmax:
+            labels = np.argmax(host, axis=-1).astype(np.int32)
+            probs = host[:, 1].astype(np.float32)
+        else:
+            probs = host
+            labels = (probs > np.float32(self.threshold)).astype(np.int32)
+        return PredictionBatch(labels, probs)
 
 
 class ServingPipeline:
@@ -99,24 +129,45 @@ class ServingPipeline:
             lr.coefficients, lr.intercept, threshold=lr.threshold)
         return cls(featurizer, model, fold_idf=True, batch_size=batch_size)
 
-    def predict(self, texts: Sequence[str]) -> PredictionBatch:
-        """Score texts in fixed-size micro-batches (pads the tail batch)."""
-        labels: List[np.ndarray] = []
-        probs: List[np.ndarray] = []
+    def predict_async(self, texts: Sequence[str]) -> "PendingPrediction":
+        """Featurize + dispatch device scoring WITHOUT blocking on results.
+
+        Returns a handle whose ``resolve()`` materializes the PredictionBatch.
+        JAX dispatch is asynchronous, so the caller can overlap host work
+        (decode/produce of neighboring batches) with device execution — the
+        lever that hides the per-call device round-trip latency in the
+        streaming engine."""
+        parts: List[Tuple[object, int]] = []
+        threshold = 0.5
+        argmax = False
+        # Binary trees: p(class=1) > 0.5 equals argmax over the normalized
+        # proba (ties -> class 0 both ways), so the 1-D fast path is exact.
+        # Multiclass trees need the full (B, C) proba + host argmax — still
+        # a single device->host fetch per chunk.
+        tree_binary = isinstance(self.model, TreeEnsemble) and (
+            self.model.kind in ("gbt", "xgboost")  # boosted margins are binary
+            or self.model.leaf.shape[-1] == 2)
         for start in range(0, len(texts), self.batch_size):
             chunk = list(texts[start : start + self.batch_size])
             n = len(chunk)
             if self._fused_model is not None:
                 enc = self.featurizer.encode(chunk, batch_size=self.batch_size)
-                lab, p = linear_mod.predict_encoded(self._fused_model, enc)
+                p = linear_mod.prob_encoded(self._fused_model, enc)
+                threshold = self._fused_model.threshold
             else:
                 dense = self.featurizer.featurize_dense(chunk, batch_size=self.batch_size)
-                lab, p = trees_mod.predict(self.model, dense)
-            labels.append(np.asarray(lab)[:n])
-            probs.append(np.asarray(p)[:n])
-        if not labels:
-            return PredictionBatch(np.empty(0, np.int32), np.empty(0, np.float32))
-        return PredictionBatch(np.concatenate(labels), np.concatenate(probs))
+                proba = trees_mod.predict_proba(self.model, jnp.asarray(dense))
+                p = proba[:, 1] if tree_binary else proba
+                argmax = not tree_binary
+            copy_async = getattr(p, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()  # start the device->host fetch behind the dispatch
+            parts.append((p, n))
+        return PendingPrediction(parts, threshold=threshold, argmax=argmax)
+
+    def predict(self, texts: Sequence[str]) -> PredictionBatch:
+        """Score texts in fixed-size micro-batches (pads the tail batch)."""
+        return self.predict_async(texts).resolve()
 
     def predict_one(self, text: str) -> Tuple[int, float]:
         """Single-dialogue convenience (the reference's per-click path)."""

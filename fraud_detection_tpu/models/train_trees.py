@@ -176,8 +176,6 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
             break  # deepest level: leaves only
 
         if use_pallas:
-            from fraud_detection_tpu.ops.histogram import auto_interpret, best_splits
-
             best_f, best_b, best_gain = best_splits(
                 hist, totals, criterion=cfg.criterion, n_bins=nb,
                 reg_lambda=cfg.reg_lambda, min_child_weight=cfg.min_child_weight,

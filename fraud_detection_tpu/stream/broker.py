@@ -42,6 +42,14 @@ class Consumer(Protocol):
     def poll(self, timeout: float = 1.0) -> Optional[Message]: ...
     def poll_batch(self, max_messages: int, timeout: float) -> List[Message]: ...
     def commit(self) -> None: ...
+
+    def commit_offsets(self, offsets: Dict[tuple, int]) -> None:
+        """Commit explicit next-offsets per (topic, partition). Unlike
+        ``commit`` (which commits the consumer's current position), this lets
+        a pipelined engine durably record batch N while batch N+1 is already
+        consumed in flight."""
+        ...
+
     def close(self) -> None: ...
 
 
@@ -150,6 +158,11 @@ class InProcessConsumer:
 
     def commit(self) -> None:
         self._committed.update(self._position)
+
+    def commit_offsets(self, offsets: Dict[tuple, int]) -> None:
+        for key, off in offsets.items():
+            if off > self._committed.get(key, 0):
+                self._committed[key] = off
 
     def committed_offsets(self) -> Dict[tuple, int]:
         return dict(self._committed)

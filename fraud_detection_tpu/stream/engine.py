@@ -91,6 +91,7 @@ class StreamingClassifier:
         self.explain_fn = explain_fn
         self.stats = StreamStats()
         self._running = False
+        self._flush_failed = False
 
     def stop(self) -> None:
         self._running = False
@@ -103,15 +104,30 @@ class StreamingClassifier:
         text = payload.get(self.text_field) if isinstance(payload, dict) else None
         return text if isinstance(text, str) else None
 
-    def process_batch(self, msgs: List[Message]) -> int:
-        """Score one micro-batch and emit results. Returns messages handled."""
+    def _dispatch(self, msgs: List[Message]) -> "_InFlight":
+        """Decode + featurize + launch device scoring; does NOT block on the
+        device. Returns the in-flight batch handle for ``_finish``."""
         t0 = time.perf_counter()
         texts: List[Optional[str]] = [self._decode(m) for m in msgs]
         valid_idx = [i for i, t in enumerate(texts) if t is not None]
-        preds = self.pipeline.predict([texts[i] for i in valid_idx]) if valid_idx else None
+        pending = (self.pipeline.predict_async([texts[i] for i in valid_idx])
+                   if valid_idx else None)
+        offsets: dict = {}
+        for m in msgs:
+            key = (m.topic, m.partition)
+            offsets[key] = max(offsets.get(key, 0), m.offset + 1)
+        return _InFlight(msgs, texts, valid_idx, pending, offsets,
+                         time.perf_counter() - t0)
+
+    def _finish(self, inflight: "_InFlight") -> int:
+        """Block on device results for an in-flight batch, produce outputs,
+        flush, commit that batch's offsets. Returns messages handled."""
+        t1 = time.perf_counter()
+        msgs, texts = inflight.msgs, inflight.texts
+        preds = inflight.pending.resolve() if inflight.pending is not None else None
 
         results: List[Optional[tuple]] = [None] * len(msgs)
-        for j, i in enumerate(valid_idx):
+        for j, i in enumerate(inflight.valid_idx):
             results[i] = (int(preds.labels[j]), float(preds.probabilities[j]))
 
         for msg, text, res in zip(msgs, texts, results):
@@ -140,47 +156,103 @@ class StreamingClassifier:
         # Commit ONLY if the producer fully drained — committing past
         # undelivered outputs would silently drop messages. Skipping the
         # commit only preserves at-least-once if we also STOP: continuing
-        # would let the next batch's commit advance the position past this
-        # batch's offsets and orphan the lost outputs. Restart re-consumes
-        # from the last committed offset and re-drives this batch.
+        # would let a later batch's commit advance past this batch's offsets
+        # and orphan the lost outputs. Restart re-consumes from the last
+        # committed offset and re-drives this batch. Offsets are committed
+        # per batch (commit_offsets), so a batch already consumed in flight
+        # behind this one is never prematurely committed.
         undelivered = self.producer.flush()
         if undelivered:
             self.stats.commits_skipped += 1
+            self._flush_failed = True
             self._running = False
         else:
-            self.consumer.commit()
+            self.consumer.commit_offsets(inflight.offsets)
 
-        dt = time.perf_counter() - t0
+        # Active processing latency: dispatch-side host work + this finish
+        # leg (device wait, produce, flush, commit). Excludes time the batch
+        # spent parked behind the next batch's poll — that's pipeline
+        # queueing, not processing, and would inflate the number by up to
+        # max_wait on a sparse stream.
+        dt = inflight.dispatch_time + (time.perf_counter() - t1)
         self.stats.processed += len(msgs)
         self.stats.batches += 1
         self.stats.batch_latency_sum += dt
         self.stats.batch_latency_max = max(self.stats.batch_latency_max, dt)
         return len(msgs)
 
+    def process_batch(self, msgs: List[Message]) -> int:
+        """Score one micro-batch synchronously and emit results."""
+        return self._finish(self._dispatch(msgs))
+
     def run(self, max_messages: Optional[int] = None,
             idle_timeout: Optional[float] = None) -> StreamStats:
         """Run the loop until stopped, ``max_messages`` handled, or the input
-        stays empty for ``idle_timeout`` seconds."""
+        stays empty for ``idle_timeout`` seconds.
+
+        Depth-1 software pipeline: batch N's device scoring executes while the
+        host polls, decodes, and featurizes batch N+1 — hiding the device
+        round-trip latency that would otherwise serialize with host work
+        (~halves the per-batch critical path on latency-bound links)."""
         self._running = True
+        self._flush_failed = False
         started = time.perf_counter()
         idle_since: Optional[float] = None
+        in_flight: Optional[_InFlight] = None
         try:
             while self._running:
                 budget = self.batch_size
                 if max_messages is not None:
-                    budget = min(budget, max_messages - self.stats.processed)
-                    if budget <= 0:
-                        break
+                    consumed = self.stats.processed + (len(in_flight.msgs) if in_flight else 0)
+                    budget = min(budget, max_messages - consumed)
+                if budget <= 0:
+                    if in_flight is not None:
+                        self._finish(in_flight)
+                        in_flight = None
+                        continue
+                    break
                 msgs = self.consumer.poll_batch(budget, self.max_wait)
                 if not msgs:
+                    if in_flight is not None:
+                        # Drain the tail rather than idling behind it.
+                        self._finish(in_flight)
+                        in_flight = None
+                        continue
                     now = time.perf_counter()
                     idle_since = idle_since or now
                     if idle_timeout is not None and now - idle_since >= idle_timeout:
                         break
                     continue
                 idle_since = None
-                self.process_batch(msgs)
+                nxt = self._dispatch(msgs)
+                prev, in_flight = in_flight, nxt
+                if prev is not None:
+                    self._finish(prev)
+        except BaseException:
+            # An exception (including Ctrl-C) may have landed mid-_finish
+            # after some produces succeeded. Do NOT drain the newer in-flight
+            # batch below: committing its (later) offsets would orphan the
+            # interrupted batch's outputs. Leaving both uncommitted means a
+            # restart replays them — at-least-once, as documented.
+            in_flight = None
+            raise
         finally:
             # Interrupt-safe: Ctrl-C lands here with correct elapsed stats.
+            # A batch still in flight after a flush failure must NOT be
+            # finished: committing its (later) offsets would orphan the
+            # failed batch's outputs.
+            if in_flight is not None and not self._flush_failed:
+                self._finish(in_flight)
             self.stats.elapsed = time.perf_counter() - started
         return self.stats
+
+
+@dataclass
+class _InFlight:
+    """A micro-batch whose device scoring has been dispatched but not resolved."""
+    msgs: List[Message]
+    texts: List[Optional[str]]
+    valid_idx: List[int]
+    pending: Optional[object]   # models.pipeline.PendingPrediction
+    offsets: dict               # (topic, partition) -> next offset to commit
+    dispatch_time: float        # host seconds spent in _dispatch

@@ -81,6 +81,13 @@ class KafkaConsumer:
     def commit(self) -> None:
         self._consumer.commit(asynchronous=False)
 
+    def commit_offsets(self, offsets) -> None:
+        """Commit explicit next-offsets per (topic, partition) — the pipelined
+        engine's per-batch commit (see broker.Consumer.commit_offsets)."""
+        tps = [_ck.TopicPartition(topic, part, off)
+               for (topic, part), off in offsets.items()]
+        self._consumer.commit(offsets=tps, asynchronous=False)
+
     def close(self) -> None:
         self._consumer.close()
 

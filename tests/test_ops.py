@@ -61,8 +61,7 @@ def test_gain_scan_matches_xla(criterion):
         h = rng.uniform(0.1, 1.0, (L, F, NB, 1)).astype(np.float32)
         c = rng.integers(1, 5, (L, F, NB, 1)).astype(np.float32)
         hist = jnp.asarray(np.concatenate([g, h, c], axis=-1))
-    totals = hist.sum(axis=(1, 2)) / F  # per-node totals (sum over one feature's bins)
-    # recompute the way the builder does: totals from a single feature's bins
+    # Per-node totals the way the builder computes them: one feature's bins.
     totals = hist[:, 0].sum(axis=1)
 
     cum = jnp.cumsum(hist, axis=2)

@@ -239,3 +239,33 @@ def test_all_tree_models_on_synthetic_corpus():
         pred, _ = predict(m, jnp.asarray(Xte))
         acc = np.mean(np.asarray(pred) == yte)
         assert acc > 0.9, (name, acc)
+
+
+def test_serving_pipeline_multiclass_tree_uses_argmax():
+    """ServingPipeline labels for a >2-class ensemble must match device argmax
+    (the binary p1>0.5 shortcut is invalid there — review regression)."""
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+    rng = np.random.default_rng(5)
+    # Alphabetic-only vocab: the Spark-parity text prep strips digits, so
+    # names like "w0" would all collapse to the single token "w" (idf 0).
+    syll = ["ka", "lo", "mi", "ne", "pu", "ri", "so", "ta", "vu", "ze"]
+    vocab = [a + b for a in syll for b in syll][:30]
+    texts, labels = [], []
+    for i in range(240):
+        c = i % 3
+        words = rng.choice(vocab[c * 10:(c + 1) * 10], size=20)
+        texts.append(" ".join(words))
+        labels.append(c)
+    feat = HashingTfIdfFeaturizer(num_features=512)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    y = np.asarray(labels)
+
+    dt = fit_decision_tree(X, y, num_classes=3, config=TreeTrainConfig(max_depth=5))
+    pipe = ServingPipeline(feat, dt, batch_size=64)
+    got = pipe.predict(texts)
+    want, _ = predict(dt, jnp.asarray(X))
+    np.testing.assert_array_equal(got.labels, np.asarray(want))
+    assert np.mean(got.labels == y) > 0.9
