@@ -23,5 +23,5 @@ Layer map (mirrors SURVEY.md §7):
 
 __version__ = "0.1.0"
 
-from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer  # noqa: F401
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer  # noqa: F401
 from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline  # noqa: F401

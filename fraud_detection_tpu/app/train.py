@@ -63,6 +63,12 @@ def main(argv=None) -> int:
     ap.add_argument("--models", default="dt,rf,xgb,lr",
                     help="comma list from {dt,rf,xgb,lr}")
     ap.add_argument("--num-features", type=int, default=10000)
+    ap.add_argument("--featurizer", choices=("hashing", "count"), default="hashing",
+                    help="'hashing' = HashingTF(num-features) like the shipped "
+                         "artifact; 'count' = CountVectorizer(vocab-size) like "
+                         "the reference training script (fraud_detection_spark.py:51)")
+    ap.add_argument("--vocab-size", type=int, default=20000,
+                    help="vocabulary cap for --featurizer count")
     ap.add_argument("--max-depth", type=int, default=5)
     ap.add_argument("--n-trees", type=int, default=100)
     ap.add_argument("--n-rounds", type=int, default=100)
@@ -106,7 +112,13 @@ def main(argv=None) -> int:
     print(f"Training samples: {len(train)}\nValidation samples: {len(val)}"
           f"\nTest samples: {len(test)}")
 
-    feat = HashingTfIdfFeaturizer(num_features=args.num_features)
+    if args.featurizer == "count":
+        from fraud_detection_tpu.featurize.tfidf import VocabTfIdfFeaturizer
+
+        feat = VocabTfIdfFeaturizer.fit_vocabulary(
+            [t for t, _ in train], vocab_size=args.vocab_size)
+    else:
+        feat = HashingTfIdfFeaturizer(num_features=args.num_features)
     feat.fit_idf([t for t, _ in train])
     to_xy = lambda split: (
         np.asarray(feat.featurize_dense([t for t, _ in split])),

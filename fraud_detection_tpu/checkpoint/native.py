@@ -24,7 +24,7 @@ from typing import Tuple, Union
 import numpy as np
 
 from fraud_detection_tpu.featurize.text import StopWordFilter
-from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer
 from fraud_detection_tpu.models.linear import LogisticRegression
 from fraud_detection_tpu.models.trees import TreeEnsemble
 
@@ -49,6 +49,13 @@ def save_checkpoint(path: str, featurizer: HashingTfIdfFeaturizer, model: Model)
             "case_sensitive": featurizer.stop_filter.case_sensitive,
         },
     }
+    if isinstance(featurizer, VocabTfIdfFeaturizer):
+        meta["featurizer"]["kind"] = "vocab"
+        meta["featurizer"]["min_tf"] = featurizer.min_tf
+        # Fixed-width unicode array: npz-safe without pickle.
+        arrays["featurizer.vocabulary"] = np.asarray(featurizer.vocabulary, np.str_)
+    else:
+        meta["featurizer"]["kind"] = "hashing"
     if featurizer.idf is not None:
         arrays["featurizer.idf"] = np.asarray(featurizer.idf, np.float32)
     if getattr(featurizer, "doc_freq", None) is not None:
@@ -81,13 +88,19 @@ def load_checkpoint(path: str) -> Tuple[HashingTfIdfFeaturizer, Model]:
     arrays = np.load(os.path.join(path, "arrays.npz"))
 
     fz = meta["featurizer"]
-    featurizer = HashingTfIdfFeaturizer(
-        num_features=int(fz["num_features"]),
+    common = dict(
         idf=arrays["featurizer.idf"] if "featurizer.idf" in arrays else None,
         binary_tf=bool(fz["binary_tf"]),
         stop_filter=StopWordFilter(fz["stopwords"], fz["case_sensitive"]),
         remove_stopwords=bool(fz["remove_stopwords"]),
     )
+    if fz.get("kind") == "vocab":
+        featurizer: HashingTfIdfFeaturizer = VocabTfIdfFeaturizer(
+            vocabulary=[str(t) for t in arrays["featurizer.vocabulary"]],
+            min_tf=float(fz.get("min_tf", 1.0)), **common)
+    else:
+        featurizer = HashingTfIdfFeaturizer(
+            num_features=int(fz["num_features"]), **common)
     if "featurizer.doc_freq" in arrays:
         featurizer.doc_freq = arrays["featurizer.doc_freq"]
     if fz.get("num_docs") is not None:

@@ -42,10 +42,12 @@ class SideVocabulary:
         self.buckets: Dict[int, Counter] = {}
 
     def add_corpus(self, texts: Sequence[str]) -> "SideVocabulary":
-        tf = self.featurizer.hashing_tf
+        bucket = self.featurizer.bucket  # hashing: murmur3; vocab: index or -1
         for text in texts:
             for tok in self.featurizer.tokens(text):
-                self.buckets.setdefault(tf.bucket(tok), Counter())[tok] += 1
+                b = bucket(tok)
+                if b >= 0:
+                    self.buckets.setdefault(b, Counter())[tok] += 1
         return self
 
     def terms(self, bucket: int, k: int = 3) -> List[str]:
@@ -178,9 +180,10 @@ def analyze_word_associations(
         vocab = SideVocabulary(featurizer).add_corpus(texts)
 
     top = np.argsort(np.asarray(importances))[::-1][:top_n]
-    # doc -> set of buckets, one host pass
-    tf = featurizer.hashing_tf
-    doc_buckets = [set(tf.bucket(t) for t in featurizer.tokens(text)) for text in texts]
+    # doc -> set of buckets, one host pass (-1 = out-of-vocabulary, dropped)
+    doc_buckets = [
+        {b for b in (featurizer.bucket(t) for t in featurizer.tokens(text)) if b >= 0}
+        for text in texts]
 
     out: List[WordAssociation] = []
     for b in top:

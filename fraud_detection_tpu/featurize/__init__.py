@@ -1,9 +1,9 @@
 from fraud_detection_tpu.featurize.text import clean_text, tokenize, load_default_stopwords, StopWordFilter
 from fraud_detection_tpu.featurize.hashing import murmur3_x86_32, spark_hash_bucket, HashingTF
-from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, EncodedBatch, tfidf_dense
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer, EncodedBatch, tfidf_dense
 
 __all__ = [
     "clean_text", "tokenize", "load_default_stopwords", "StopWordFilter",
     "murmur3_x86_32", "spark_hash_bucket", "HashingTF",
-    "HashingTfIdfFeaturizer", "EncodedBatch", "tfidf_dense",
+    "HashingTfIdfFeaturizer", "VocabTfIdfFeaturizer", "EncodedBatch", "tfidf_dense",
 ]

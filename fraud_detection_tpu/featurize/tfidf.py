@@ -116,6 +116,15 @@ class HashingTfIdfFeaturizer:
         """The term->bucket hasher (public for the side-vocabulary builder)."""
         return self._hashing
 
+    def bucket(self, term: str) -> int:
+        """Feature index for a term, or -1 if the term maps to no feature.
+
+        Uniform across featurizers: hashing never returns -1; the vocabulary
+        featurizer returns -1 for out-of-vocabulary terms. Interpretability
+        code (eval/word_associations.py) relies on this instead of reaching
+        for the hasher directly."""
+        return self._hashing.bucket(term)
+
     def tokens(self, text: str) -> List[str]:
         toks = tokenize(clean_text(text))
         if self.remove_stopwords:
@@ -189,3 +198,95 @@ class HashingTfIdfFeaturizer:
 
 
 _tfidf_dense_jit = jax.jit(tfidf_dense)
+
+
+@dataclass
+class VocabTfIdfFeaturizer(HashingTfIdfFeaturizer):
+    """CountVectorizer-semantics featurizer: explicit vocabulary -> index.
+
+    Replicates the reference TRAINING pipeline's feature path
+    (fraud_detection_spark.py:47-54: Tokenizer -> StopWordsRemover ->
+    CountVectorizer(vocabSize=20000) -> IDF) — the path whose saved form is a
+    CountVectorizerModel stage, as opposed to the HashingTF stage the shipped
+    serving artifact uses (SURVEY.md Q1). Out-of-vocabulary terms drop (exact
+    Spark behavior); features are directly interpretable (``vocabulary[i]``
+    names feature i, so the Q11 word-association analysis needs no side
+    vocabulary here).
+
+    ``min_tf`` follows Spark's CountVectorizerModel: values >= 1 are an
+    absolute per-document count floor; values < 1 are a fraction of the
+    document's token count.
+    """
+
+    vocabulary: Sequence[str] = ()
+    min_tf: float = 1.0
+
+    def __post_init__(self):
+        self.vocabulary = list(self.vocabulary)
+        if self.vocabulary:
+            self.num_features = len(self.vocabulary)
+        self._index = {t: i for i, t in enumerate(self.vocabulary)}
+        super().__post_init__()
+        # The C++ fast path implements the *hashing* bucketizer; vocabulary
+        # lookup stays on the Python dict (still one pass per token).
+        self._native_tried = True
+        self._native = None
+
+    @property
+    def hashing_tf(self) -> HashingTF:
+        raise TypeError(
+            "VocabTfIdfFeaturizer maps terms through an explicit vocabulary; "
+            "there is no hasher (use .bucket(term) / .vocabulary instead)")
+
+    def bucket(self, term: str) -> int:
+        idx = self._index.get(term)
+        return -1 if idx is None else idx
+
+    @classmethod
+    def fit_vocabulary(cls, texts: Sequence[str], vocab_size: int = 20000, *,
+                       min_df: float = 1.0, min_tf: float = 1.0,
+                       binary_tf: bool = False,
+                       stop_filter: Optional[StopWordFilter] = None,
+                       remove_stopwords: bool = True) -> "VocabTfIdfFeaturizer":
+        """Spark ``CountVectorizer.fit`` semantics: vocabulary = the top
+        ``vocab_size`` terms by total corpus count, restricted to terms whose
+        document frequency is >= ``min_df`` (absolute if >= 1, else a fraction
+        of the corpus). Ties break lexicographically for determinism (Spark's
+        tie order is partition-dependent)."""
+        probe = cls(vocabulary=["\x00probe"], min_tf=min_tf, binary_tf=binary_tf,
+                    stop_filter=stop_filter or StopWordFilter(),
+                    remove_stopwords=remove_stopwords)
+        term_count: dict = {}
+        doc_freq: dict = {}
+        for text in texts:
+            toks = probe.tokens(text)
+            seen = set()
+            for t in toks:
+                term_count[t] = term_count.get(t, 0) + 1
+                seen.add(t)
+            for t in seen:
+                doc_freq[t] = doc_freq.get(t, 0) + 1
+        df_floor = min_df if min_df >= 1.0 else min_df * max(len(texts), 1)
+        eligible = [t for t, df in doc_freq.items() if df >= df_floor]
+        eligible.sort(key=lambda t: (-term_count[t], t))
+        return cls(vocabulary=eligible[:vocab_size], min_tf=min_tf,
+                   binary_tf=binary_tf,
+                   stop_filter=probe.stop_filter,
+                   remove_stopwords=remove_stopwords)
+
+    def sparse_row(self, text: str) -> Tuple[np.ndarray, np.ndarray]:
+        toks = self.tokens(text)
+        counts: dict = {}
+        for t in toks:
+            i = self._index.get(t)
+            if i is not None:
+                counts[i] = counts.get(i, 0) + 1
+        floor = self.min_tf if self.min_tf >= 1.0 else self.min_tf * max(len(toks), 1)
+        items = sorted((i, c) for i, c in counts.items() if c >= floor)
+        if not items:
+            return np.empty(0, np.int32), np.empty(0, np.float32)
+        ids = np.fromiter((i for i, _ in items), np.int32, len(items))
+        vals = np.fromiter((c for _, c in items), np.float32, len(items))
+        if self.binary_tf:
+            vals = np.ones_like(vals)
+        return ids, vals

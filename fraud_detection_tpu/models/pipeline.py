@@ -18,7 +18,7 @@ import numpy as np
 
 from fraud_detection_tpu.checkpoint.spark_artifact import SparkPipelineArtifact
 from fraud_detection_tpu.featurize.text import StopWordFilter
-from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer
 from fraud_detection_tpu.models import linear as linear_mod
 from fraud_detection_tpu.models import trees as trees_mod
 from fraud_detection_tpu.models.linear import LogisticRegression
@@ -103,6 +103,10 @@ class ServingPipeline:
 
     @classmethod
     def from_spark_artifact(cls, artifact: SparkPipelineArtifact, batch_size: int = 256) -> "ServingPipeline":
+        """Serve any reference artifact shape: the shipped HashingTF +
+        LogisticRegression pipeline (SURVEY.md §2.2) AND the training
+        script's CountVectorizer + tree pipelines
+        (fraud_detection_spark.py:47-91, saved at :389-393 — quirk Q1)."""
         from fraud_detection_tpu.checkpoint.spark_artifact import RegexTokenizerStage
 
         for s in artifact.stages:
@@ -111,22 +115,35 @@ class ServingPipeline:
                     "artifact uses RegexTokenizer; only plain Tokenizer semantics "
                     f"are implemented (pattern={s.pattern!r}, gaps={s.gaps})")
         htf = artifact.hashing_tf
+        cv = artifact.count_vectorizer
         idf_stage = artifact.idf
         lr = artifact.logistic_regression
-        if htf is None or lr is None:
-            raise ValueError(
-                "artifact must contain HashingTF + LogisticRegression stages "
-                f"(got {[type(s).__name__ for s in artifact.stages]})")
+        tree = artifact.tree_ensemble
         sw = artifact.stopwords
-        featurizer = HashingTfIdfFeaturizer(
-            num_features=htf.num_features,
-            idf=None if idf_stage is None else idf_stage.idf.astype(np.float32),
-            binary_tf=htf.binary,
-            stop_filter=StopWordFilter(sw.stopwords, sw.case_sensitive) if sw else StopWordFilter(),
-            remove_stopwords=sw is not None,
-        )
-        model = LogisticRegression.from_arrays(
-            lr.coefficients, lr.intercept, threshold=lr.threshold)
+        stop = StopWordFilter(sw.stopwords, sw.case_sensitive) if sw else StopWordFilter()
+        idf = None if idf_stage is None else idf_stage.idf.astype(np.float32)
+        if htf is not None:
+            featurizer: HashingTfIdfFeaturizer = HashingTfIdfFeaturizer(
+                num_features=htf.num_features, idf=idf, binary_tf=htf.binary,
+                stop_filter=stop, remove_stopwords=sw is not None)
+        elif cv is not None:
+            featurizer = VocabTfIdfFeaturizer(
+                vocabulary=cv.vocabulary, min_tf=cv.min_tf, idf=idf,
+                binary_tf=cv.binary, stop_filter=stop,
+                remove_stopwords=sw is not None)
+        else:
+            raise ValueError(
+                "artifact has no HashingTF or CountVectorizerModel stage "
+                f"(got {[type(s).__name__ for s in artifact.stages]})")
+        if lr is not None:
+            model: "LogisticRegression | TreeEnsemble" = LogisticRegression.from_arrays(
+                lr.coefficients, lr.intercept, threshold=lr.threshold)
+        elif tree is not None:
+            model = trees_mod.from_spark_stage(tree)
+        else:
+            raise ValueError(
+                "artifact has no LogisticRegression or tree classifier stage "
+                f"(got {[type(s).__name__ for s in artifact.stages]})")
         return cls(featurizer, model, fold_idf=True, batch_size=batch_size)
 
     def predict_async(self, texts: Sequence[str]) -> "PendingPrediction":

@@ -1,0 +1,225 @@
+"""CountVectorizer-semantics featurizer + serving the training script's
+artifact shape (Tokenizer -> StopWordsRemover -> CountVectorizer -> IDF ->
+DecisionTree — fraud_detection_spark.py:47-91, saved at :389-393, quirk Q1)."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from fraud_detection_tpu.featurize.tfidf import VocabTfIdfFeaturizer
+
+
+def test_fit_vocabulary_top_terms_and_min_df():
+    texts = [
+        "apple apple banana",
+        "apple cherry",
+        "banana cherry cherry",
+        "apple banana",
+    ]
+    f = VocabTfIdfFeaturizer.fit_vocabulary(texts, vocab_size=2)
+    # counts: apple 4, cherry 3, banana 3 -> top-2 = apple + banana (tie by name)
+    assert f.vocabulary == ["apple", "banana"]
+    assert f.num_features == 2
+
+    # min_df as an absolute floor: cherry appears in 2 docs, banana in 3
+    f2 = VocabTfIdfFeaturizer.fit_vocabulary(texts, vocab_size=10, min_df=3)
+    assert f2.vocabulary == ["apple", "banana"]
+
+
+def test_sparse_row_oov_drops_and_counts():
+    f = VocabTfIdfFeaturizer(vocabulary=["alpha", "beta"])
+    ids, vals = f.sparse_row("alpha gamma alpha beta gamma gamma")
+    np.testing.assert_array_equal(ids, [0, 1])
+    np.testing.assert_array_equal(vals, [2.0, 1.0])
+
+
+def test_min_tf_absolute_and_fractional():
+    f = VocabTfIdfFeaturizer(vocabulary=["alpha", "beta"], min_tf=2.0)
+    ids, vals = f.sparse_row("alpha alpha beta")
+    np.testing.assert_array_equal(ids, [0])  # beta count 1 < 2
+
+    # fractional: floor = 0.5 * 4 tokens = 2
+    f = VocabTfIdfFeaturizer(vocabulary=["alpha", "beta"], min_tf=0.5)
+    ids, vals = f.sparse_row("alpha alpha alpha beta")
+    np.testing.assert_array_equal(ids, [0])
+
+
+def test_binary_tf():
+    f = VocabTfIdfFeaturizer(vocabulary=["alpha", "beta"], binary_tf=True)
+    _, vals = f.sparse_row("alpha alpha beta")
+    np.testing.assert_array_equal(vals, [1.0, 1.0])
+
+
+def test_stopwords_and_cleaning_apply():
+    # "the" is a stopword; digits are stripped by the Spark-parity cleaner.
+    f = VocabTfIdfFeaturizer.fit_vocabulary(
+        ["the process99 takes the time", "process takes effort"], vocab_size=10)
+    assert "the" not in f.vocabulary
+    assert "process" in f.vocabulary
+
+
+def test_native_checkpoint_roundtrip(tmp_path):
+    from fraud_detection_tpu.checkpoint.native import load_checkpoint, save_checkpoint
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+
+    texts = [f"token{'a' * (i % 7 + 1)} filler words here" for i in range(40)]
+    y = np.asarray([i % 2 for i in range(40)], np.float32)
+    feat = VocabTfIdfFeaturizer.fit_vocabulary(texts, vocab_size=16)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_logistic_regression(X, y, max_iter=10)
+
+    save_checkpoint(str(tmp_path / "cv"), feat, model)
+    pipe = ServingPipeline.from_checkpoint(str(tmp_path / "cv"), batch_size=8)
+    orig = ServingPipeline(feat, model, batch_size=8)
+    got, want = pipe.predict(texts[:8]), orig.predict(texts[:8])
+    np.testing.assert_array_equal(got.labels, want.labels)
+    np.testing.assert_allclose(got.probabilities, want.probabilities, atol=1e-6)
+    assert isinstance(pipe.featurizer, VocabTfIdfFeaturizer)
+    assert pipe.featurizer.vocabulary == feat.vocabulary
+
+
+# ---------------------------------------------------------------------------
+# Synthetic Spark artifact in the training script's shape
+# ---------------------------------------------------------------------------
+
+def _write_stage(root, idx, cls, uid_suffix, params, data_rows=None):
+    d = os.path.join(root, "stages", f"{idx}_{cls.rsplit('.', 1)[-1]}_{uid_suffix}")
+    os.makedirs(os.path.join(d, "metadata"), exist_ok=True)
+    meta = {"class": cls, "timestamp": 0, "sparkVersion": "3.5.5",
+            "uid": f"{cls.rsplit('.', 1)[-1]}_{uid_suffix}",
+            "paramMap": params, "defaultParamMap": {}}
+    with open(os.path.join(d, "metadata", "part-00000"), "w") as fh:
+        fh.write(json.dumps(meta) + "\n")
+    if data_rows is not None:
+        os.makedirs(os.path.join(d, "data"), exist_ok=True)
+        pq.write_table(pa.Table.from_pylist(data_rows),
+                       os.path.join(d, "data", "part-00000.parquet"))
+    return meta["uid"]
+
+
+@pytest.fixture
+def training_script_artifact(tmp_path):
+    """CountVectorizer + IDF + DecisionTree pipeline, Spark save layout.
+
+    The stump splits on feature 0 ("scam") count-TF-IDF: docs containing the
+    term route right and predict class 1."""
+    root = str(tmp_path / "cv_dt_model")
+    os.makedirs(os.path.join(root, "metadata"), exist_ok=True)
+    vocab = ["scam", "prize", "hello", "meeting"]
+    idf = [0.1, 0.2, 0.05, 0.08]
+    uids = [
+        _write_stage(root, 0, "org.apache.spark.ml.feature.Tokenizer", "aaa1",
+                     {"inputCol": "clean_text", "outputCol": "words"}),
+        _write_stage(root, 1, "org.apache.spark.ml.feature.StopWordsRemover", "bbb2",
+                     {"inputCol": "words", "outputCol": "filtered_words",
+                      "stopWords": ["the", "a", "is"], "caseSensitive": False}),
+        _write_stage(root, 2, "org.apache.spark.ml.feature.CountVectorizerModel", "ccc3",
+                     {"inputCol": "filtered_words", "outputCol": "raw_features",
+                      "minTF": 1.0, "binary": False},
+                     [{"vocabulary": vocab}]),
+        _write_stage(root, 3, "org.apache.spark.ml.feature.IDFModel", "ddd4",
+                     {"inputCol": "raw_features", "outputCol": "features",
+                      "minDocFreq": 0},
+                     [{"idf": {"type": 1, "size": None, "indices": None, "values": idf},
+                       "docFreq": [10, 5, 40, 30], "numDocs": 50}]),
+        _write_stage(
+            root, 4,
+            "org.apache.spark.ml.classification.DecisionTreeClassificationModel",
+            "eee5",
+            {"featuresCol": "features", "labelCol": "label", "numFeatures": 4,
+             "numClasses": 2, "maxDepth": 1},
+            [
+                {"id": 0, "prediction": 1.0, "impurity": 0.5,
+                 "impurityStats": [25.0, 25.0], "gain": 0.4,
+                 "leftChild": 1, "rightChild": 2,
+                 "split": {"featureIndex": 0,
+                           "leftCategoriesOrThreshold": [0.05],
+                           "numCategories": -1}},
+                {"id": 1, "prediction": 0.0, "impurity": 0.0,
+                 "impurityStats": [25.0, 1.0], "gain": -1.0,
+                 "leftChild": -1, "rightChild": -1,
+                 "split": {"featureIndex": -1,
+                           "leftCategoriesOrThreshold": [],
+                           "numCategories": -1}},
+                {"id": 2, "prediction": 1.0, "impurity": 0.0,
+                 "impurityStats": [0.0, 24.0], "gain": -1.0,
+                 "leftChild": -1, "rightChild": -1,
+                 "split": {"featureIndex": -1,
+                           "leftCategoriesOrThreshold": [],
+                           "numCategories": -1}},
+            ]),
+    ]
+    with open(os.path.join(root, "metadata", "part-00000"), "w") as fh:
+        fh.write(json.dumps({
+            "class": "org.apache.spark.ml.PipelineModel",
+            "timestamp": 0, "sparkVersion": "3.5.5", "uid": "pipeline_xyz",
+            "paramMap": {"stageUids": uids}}) + "\n")
+    return root
+
+
+def test_serve_training_script_artifact(training_script_artifact):
+    from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+    from fraud_detection_tpu.models.trees import TreeEnsemble
+
+    art = load_spark_pipeline(training_script_artifact)
+    pipe = ServingPipeline.from_spark_artifact(art, batch_size=8)
+    assert isinstance(pipe.featurizer, VocabTfIdfFeaturizer)
+    assert pipe.featurizer.vocabulary == ["scam", "prize", "hello", "meeting"]
+    assert isinstance(pipe.model, TreeEnsemble)
+
+    # "scam" present: tfidf[0] = 1 * 0.1 > 0.05 threshold -> right leaf, class 1.
+    label, p = pipe.predict_one("this is a scam call about your prize")
+    assert label == 1 and p > 0.9
+    # No vocab terms beyond "hello"/"meeting": tfidf[0]=0 <= 0.05 -> class 0.
+    label, p = pipe.predict_one("hello about the meeting")
+    assert label == 0 and p < 0.1
+    # OOV-only text: all-zero features still route left (class 0).
+    label, _ = pipe.predict_one("completely unrelated words")
+    assert label == 0
+
+
+def test_train_cli_count_featurizer(tmp_path, capsys):
+    from fraud_detection_tpu.app.train import main
+
+    rc = main(["--data", "synthetic", "--n", "200", "--models", "lr",
+               "--featurizer", "count", "--vocab-size", "512",
+               "--save", f"lr={tmp_path / 'ckpt'}"])
+    assert rc in (0, None)
+    out = capsys.readouterr().out
+    assert "Test" in out
+
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+    pipe = ServingPipeline.from_checkpoint(str(tmp_path / "ckpt"))
+    assert isinstance(pipe.featurizer, VocabTfIdfFeaturizer)
+    lab, _ = pipe.predict_one("hello this is a benign scheduling call about tomorrow")
+    assert lab in (0, 1)
+
+
+def test_word_associations_with_vocab_featurizer():
+    """Interpretability over an explicit vocabulary (review regression: the
+    association path must not reach for the hasher)."""
+    from fraud_detection_tpu.eval import analyze_word_associations
+    from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_decision_tree
+
+    scam = "send the gift card now your account is suspended urgent verify"
+    ham = "the meeting is tomorrow please bring the quarterly report thanks"
+    texts = [scam] * 30 + [ham] * 30
+    labels = [1] * 30 + [0] * 30
+    feat = VocabTfIdfFeaturizer.fit_vocabulary(texts, vocab_size=64)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    dt = fit_decision_tree(X, np.asarray(labels), config=TreeTrainConfig(max_depth=3))
+
+    assocs = analyze_word_associations(dt, feat, texts, labels, top_n=5)
+    assert assocs, "expected at least one association"
+    top = assocs[0]
+    # Exact vocabulary: the word IS the feature (no hash-collision ambiguity).
+    assert top.word == feat.vocabulary[top.bucket]
+    assert top.scam_ratio in (0.0, 1.0)
