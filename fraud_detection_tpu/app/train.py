@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Dict, List, Tuple
@@ -33,25 +34,39 @@ def load_corpus(args) -> List[Tuple[str, int]]:
         from fraud_detection_tpu.data import generate_corpus
 
         return [(d.text, d.label) for d in generate_corpus(n=args.n, seed=args.seed)]
-    import pandas as pd
+    import csv as csv_mod
 
-    df = pd.read_csv(args.data)
-    if "dialogue" not in df.columns:
-        raise SystemExit(f"CSV {args.data} missing 'dialogue' column (has {list(df.columns)})")
-    label_col = "labels" if "labels" in df.columns else "label"
-    out = []
-    for text, raw in zip(df["dialogue"], df[label_col]):
-        try:
-            val = float(raw)  # accepts "0", "1", "0.0", "1.0", 0, 1.0, ...
-        except (TypeError, ValueError):
-            continue
-        if val in (0.0, 1.0):
-            out.append((str(text), int(val)))
-    if not out:
+    from fraud_detection_tpu.data import clean_rows, load_dialogue_csv
+
+    if args.data.startswith(("http://", "https://")):
+        rows = load_dialogue_csv(args.data)
+    else:
+        if not os.path.exists(args.data):
+            raise SystemExit(f"CSV {args.data} not found")
+        with open(args.data, newline="", encoding="utf-8") as fh:
+            raw = list(csv_mod.DictReader(fh))
+        if raw and "dialogue" not in raw[0]:
+            raise SystemExit(
+                f"CSV {args.data} missing 'dialogue' column (has {list(raw[0])})")
+        # CLI conveniences on top of the strict reference chain: accept a
+        # singular 'label' header and float-style labels ("1.0").
+        for r in raw:
+            if "labels" not in r and "label" in r:
+                r["labels"] = r["label"]
+            lab = (r.get("labels") or "").strip()
+            try:
+                val = float(lab)
+            except ValueError:
+                continue
+            if val in (0.0, 1.0):
+                r["labels"] = str(int(val))
+        rows = clean_rows(raw)
+    if not rows:
         raise SystemExit(
-            f"CSV {args.data}: no rows with {label_col} in {{0, 1}} "
-            f"(sample values: {df[label_col].head(5).tolist()})")
-    return out
+            f"CSV {args.data}: no usable rows — labels must be 0/1 "
+            "(column 'labels' or 'label') and clean_text non-empty "
+            "(fraud_detection_spark.py:40-45 semantics)")
+    return [(r.dialogue, r.label) for r in rows]
 
 
 def main(argv=None) -> int:
@@ -177,8 +192,6 @@ def main(argv=None) -> int:
         print(json.dumps(all_metrics, indent=2))
 
     if args.plots:
-        import os
-
         from fraud_detection_tpu.eval.report import (
             plot_confusion_matrices, plot_metrics_comparison)
 

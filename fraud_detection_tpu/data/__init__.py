@@ -1,3 +1,14 @@
+from fraud_detection_tpu.data.loader import (
+    REFERENCE_DATASET_URL,
+    DialogueRow,
+    as_xy,
+    clean_rows,
+    load_dialogue_csv,
+)
 from fraud_detection_tpu.data.synthetic import Dialogue, generate_corpus, train_val_test_split
 
-__all__ = ["Dialogue", "generate_corpus", "train_val_test_split"]
+__all__ = [
+    "Dialogue", "generate_corpus", "train_val_test_split",
+    "DialogueRow", "clean_rows", "load_dialogue_csv", "as_xy",
+    "REFERENCE_DATASET_URL",
+]

@@ -1,0 +1,109 @@
+"""Dataset loading + cleaning with the reference's exact semantics.
+
+Replicates ``load_and_clean_data`` (/root/reference/fraud_detection_spark.py:30-45)
+without a SparkSession: 4-column schema (dialogue, personality, type, labels —
+all strings), rows kept only when trimmed ``labels`` is "0" or "1" (then cast
+to a number), ``clean_text`` = lowercase + strip of everything outside
+``[a-zA-Z ]``, and rows with empty ``clean_text`` dropped.
+
+The reference streams the CSV straight from HuggingFace
+(fraud_detection_spark.py:331 — ``REFERENCE_DATASET_URL`` below); this loader
+takes a local path by default and only touches the network when the caller
+passes the URL explicitly (the build/test environment has no egress).
+
+Deliberate parity notes (SURVEY.md §2.5):
+  * Q3 — the empty-``clean_text`` drop is a TRAINING-side filter; the serving
+    path scores whatever arrives, exactly like the reference's agent
+    (utils/agent_api.py:139-145 never filters).
+  * The "personality" and "type" columns ride along untouched, as in the
+    reference (only dialogue/labels feed the model).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple, Union
+
+from fraud_detection_tpu.featurize.text import clean_text
+
+REFERENCE_DATASET_URL = (
+    "https://huggingface.co/datasets/BothBosu/multi-agent-scam-conversation/"
+    "raw/main/agent_conversation_all.csv")
+
+#: Reference schema, in column order (fraud_detection_spark.py:32-37).
+SCHEMA = ("dialogue", "personality", "type", "labels")
+
+
+@dataclass
+class DialogueRow:
+    dialogue: str
+    label: int                      # 0 | 1 (reference casts "0"/"1" to double)
+    clean_text: str                 # lowercase, [a-zA-Z ] only
+    personality: Optional[str] = None
+    kind: Optional[str] = None      # the reference's "type" column
+
+    @property
+    def text(self) -> str:
+        """Raw dialogue — alias so [(row.text, row.label)] code is uniform
+        with data.synthetic.Dialogue."""
+        return self.dialogue
+
+
+def clean_rows(rows: Sequence[dict], drop_empty: bool = True) -> List[DialogueRow]:
+    """Apply the reference's filter/cast/clean chain to raw CSV dicts."""
+    out: List[DialogueRow] = []
+    for r in rows:
+        raw_label = (r.get("labels") or "").strip()
+        if raw_label not in ("0", "1"):
+            continue  # fraud_detection_spark.py:40 — trim + isin filter
+        dialogue = r.get("dialogue") or ""
+        cleaned = clean_text(dialogue)
+        if drop_empty and cleaned == "":
+            # :45 — filter(clean_text != ""): the reference drops ONLY the
+            # exact empty string; an all-spaces clean_text survives (and
+            # tokenizes to stopword-filtered emptiness downstream).
+            continue
+        out.append(DialogueRow(
+            dialogue=dialogue,
+            label=int(raw_label),
+            clean_text=cleaned,
+            personality=r.get("personality"),
+            kind=r.get("type"),
+        ))
+    return out
+
+
+def load_dialogue_csv(source: Union[str, io.TextIOBase],
+                      drop_empty: bool = True) -> List[DialogueRow]:
+    """Load + clean the dialogue dataset from a path, file object, or URL.
+
+    URLs are fetched only when explicitly requested; any fetch failure raises
+    with a pointer to downloading the CSV manually.
+    """
+    if isinstance(source, io.TextIOBase):
+        return clean_rows(list(csv.DictReader(source)), drop_empty)
+    if isinstance(source, str) and source.startswith(("http://", "https://")):
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(source, timeout=60) as resp:  # noqa: S310
+                text = resp.read().decode("utf-8", "replace")
+        except OSError as e:
+            raise RuntimeError(
+                f"could not fetch {source} ({e}); download the CSV manually "
+                "and pass its local path") from e
+        return clean_rows(list(csv.DictReader(io.StringIO(text))), drop_empty)
+    if not os.path.exists(source):
+        raise FileNotFoundError(
+            f"{source} not found (the reference dataset is not vendored — "
+            f"SURVEY.md Q10; fetch {REFERENCE_DATASET_URL} and pass its path)")
+    with open(source, newline="", encoding="utf-8") as fh:
+        return clean_rows(list(csv.DictReader(fh)), drop_empty)
+
+
+def as_xy(rows: Sequence[DialogueRow]) -> Tuple[List[str], List[int]]:
+    """(texts, labels) view for featurizer/trainer consumption."""
+    return [r.dialogue for r in rows], [r.label for r in rows]

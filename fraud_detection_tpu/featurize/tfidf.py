@@ -34,8 +34,12 @@ from fraud_detection_tpu.featurize.text import StopWordFilter, clean_text, token
 class EncodedBatch(NamedTuple):
     """Fixed-shape sparse batch: per-row hashed-bucket ids and term counts.
 
-    ``ids`` is (B, L) int32, ``counts`` is (B, L) float32; padding has count 0
-    (its bucket id is 0 — harmless because every consumer weights by count).
+    ``ids`` is (B, L) int16 (int32 when num_features exceeds int16 range) and
+    ``counts`` is (B, L) uint16 — term counts are small non-negative integers,
+    and halving the bytes halves the host->device transfer on the serving
+    path, which is latency-critical over a remote-device link. Jitted
+    consumers widen to int32/float32 on-device. Padding has count 0 (its
+    bucket id is 0 — harmless because every consumer weights by count).
     """
 
     ids: jax.Array
@@ -58,6 +62,8 @@ def tfidf_dense(ids: jax.Array, counts: jax.Array, idf: jax.Array) -> jax.Array:
     """
     num_features = idf.shape[0]
     batch = ids.shape[0]
+    ids = ids.astype(jnp.int32)
+    counts = counts.astype(idf.dtype)
     dense = jnp.zeros((batch, num_features), counts.dtype)
     rows = jnp.arange(batch, dtype=ids.dtype)[:, None]
     dense = dense.at[rows, ids].add(counts)
@@ -148,20 +154,33 @@ class HashingTfIdfFeaturizer:
         native = self._native_featurizer()
         if native is not None:
             ids, counts = native.encode(texts, b, max_tokens, _pad_len)
-            return EncodedBatch(ids=ids, counts=counts)
+            return EncodedBatch(*self._narrow(ids, counts))
         rows = [self.sparse_row(t) for t in texts]
         width = max((len(i) for i, _ in rows), default=1)
         length = max_tokens if max_tokens is not None else _pad_len(width)
-        ids = np.zeros((b, length), np.int32)
-        counts = np.zeros((b, length), np.float32)
+        # Allocate the wire dtypes directly — no second narrowing pass.
+        ids = np.zeros((b, length), self._ids_dtype())
+        counts = np.zeros((b, length), np.uint16)
         for r, (idx, val) in enumerate(rows):
             if len(idx) > length:  # extremely long transcript: keep top-count buckets
                 keep = np.argsort(-val)[:length]
                 keep.sort()
                 idx, val = idx[keep], val[keep]
             ids[r, : len(idx)] = idx
-            counts[r, : len(val)] = val
+            counts[r, : len(val)] = np.minimum(val, 65535.0)
         return EncodedBatch(ids=ids, counts=counts)
+
+    def _ids_dtype(self):
+        return np.int16 if self.num_features <= np.iinfo(np.int16).max else np.int32
+
+    def _narrow(self, ids: np.ndarray, counts: np.ndarray):
+        """Shrink native-path int32/float32 output to the wire dtypes
+        (EncodedBatch docstring): int16 ids when the feature space fits,
+        uint16 counts (clipped — a >65535 repeat of one term in one document
+        is not a real transcript). The C ABI is fixed at int32/float32, so
+        only this path pays an astype."""
+        return (ids.astype(self._ids_dtype(), copy=False),
+                np.minimum(counts, 65535.0).astype(np.uint16))
 
     def fit_idf(self, texts: Sequence[str], min_doc_freq: int = 0) -> "HashingTfIdfFeaturizer":
         """Fit the IDF vector from a corpus (Spark ``IDF.fit`` semantics).

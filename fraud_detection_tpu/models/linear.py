@@ -69,8 +69,9 @@ def margin_encoded(model: LogisticRegression, ids: jax.Array, counts: jax.Array)
     ``model.weights`` must already include the IDF factor (see ``fold_idf``);
     padding rows have count 0 so they contribute nothing.
     """
-    gathered = model.weights[ids]                # (B, L)
-    return jnp.sum(gathered * counts, axis=-1) + model.intercept
+    gathered = model.weights[ids.astype(jnp.int32)]          # (B, L)
+    return jnp.sum(gathered * counts.astype(model.weights.dtype),
+                   axis=-1) + model.intercept
 
 
 @partial(jax.jit, static_argnames=())

@@ -1,0 +1,70 @@
+"""Dataset loader parity with the reference's load_and_clean_data chain
+(fraud_detection_spark.py:30-45): label filter/cast, clean_text, empty drop."""
+
+import io
+
+import pytest
+
+from fraud_detection_tpu.data import DialogueRow, as_xy, clean_rows, load_dialogue_csv
+
+CSV = """dialogue,personality,type,labels
+"Agent: Hello, you WON a prize!!! Call 555-1234.",aggressive,ssn,1
+"Agent: Confirming your 3pm appointment.",polite,appointment,0
+"Agent: maybe-scam with label noise",neutral,other,2
+"Agent: whitespace label survives trim",neutral,other," 1 "
+"12345 !!! ??? 678",neutral,other,1
+"Agent: label missing",neutral,other,
+"""
+
+
+def _rows():
+    return load_dialogue_csv(io.StringIO(CSV))
+
+
+def test_label_filter_and_trim():
+    rows = _rows()
+    # kept: rows 1, 2, 4 (trimmed " 1 "), and the digits-only dialogue —
+    # it cleans to SPACES, and the reference only drops the exact empty
+    # string (fraud_detection_spark.py:45). Dropped: label "2", empty label.
+    assert [r.label for r in rows] == [1, 0, 1, 1]
+    assert rows[2].dialogue == "Agent: whitespace label survives trim"
+    assert rows[3].clean_text.strip() == "" and rows[3].clean_text != ""
+
+
+def test_clean_text_semantics():
+    rows = _rows()
+    assert rows[0].clean_text == "agent hello you won a prize call "
+    # lowercase applied, digits/punctuation stripped, spaces kept
+
+
+def test_empty_clean_text_dropped_and_keepable():
+    # Exactly-empty clean_text drops by default (reference :45)...
+    empty_csv = 'dialogue,personality,type,labels\n"!!!",x,y,1\n'
+    assert load_dialogue_csv(io.StringIO(empty_csv)) == []
+    # ...Q3: serving never drops — the loader can keep empties on request.
+    kept = load_dialogue_csv(io.StringIO(empty_csv), drop_empty=False)
+    assert len(kept) == 1 and kept[0].clean_text == ""
+
+
+def test_extra_columns_ride_along():
+    rows = _rows()
+    assert rows[0].personality == "aggressive"
+    assert rows[0].kind == "ssn"
+    assert rows[0].text == rows[0].dialogue
+
+
+def test_as_xy():
+    texts, labels = as_xy(_rows())
+    assert len(texts) == len(labels) == 4
+    assert set(labels) == {0, 1}
+
+
+def test_missing_file_message():
+    with pytest.raises(FileNotFoundError, match="not vendored"):
+        load_dialogue_csv("/nonexistent/agent_conversation_all.csv")
+
+
+def test_clean_rows_direct():
+    rows = clean_rows([{"dialogue": "Hi THERE", "labels": "0"}])
+    assert rows == [DialogueRow(dialogue="Hi THERE", label=0, clean_text="hi there",
+                                personality=None, kind=None)]
