@@ -54,6 +54,10 @@ def main(argv=None) -> int:
     ap.add_argument("--input-topic", default=os.getenv("KAFKA_INPUT_TOPIC", "customer-dialogues-raw"))
     ap.add_argument("--output-topic", default=os.getenv("KAFKA_OUTPUT_TOPIC", "dialogues-classified"))
     ap.add_argument("--max-messages", type=int, default=None)
+    ap.add_argument("--supervise", type=int, metavar="N", default=0,
+                    help="restart the engine up to N times on crash/flush "
+                         "failure (resumes from committed offsets; see "
+                         "stream.engine.run_supervised)")
     args = ap.parse_args(argv)
 
     if args.kafka and args.demo:
@@ -64,12 +68,13 @@ def main(argv=None) -> int:
 
     pipe = build_pipeline(args.model, args.batch_size)
 
+    broker = None
     if args.kafka:
         if not kafka_available():
             raise SystemExit("confluent_kafka is not installed; cannot use --kafka")
         from fraud_detection_tpu.stream.kafka import KafkaConsumer, KafkaProducer
 
-        consumer, producer = KafkaConsumer([args.input_topic]), KafkaProducer()
+        make_clients = lambda: (KafkaConsumer([args.input_topic]), KafkaProducer())
         max_messages, idle = args.max_messages, None
     elif args.demo > 0:
         from fraud_detection_tpu.data import generate_corpus
@@ -82,28 +87,40 @@ def main(argv=None) -> int:
             feeder.produce(args.input_topic,
                            json.dumps({"text": d.text, "id": i}).encode(),
                            key=str(i).encode())
-        consumer = broker.consumer([args.input_topic], "serve-demo")
-        producer = broker.producer()
+        make_clients = lambda: (broker.consumer([args.input_topic], "serve-demo"),
+                                broker.producer())
         max_messages = args.max_messages if args.max_messages is not None else args.demo
         idle = 1.0
     else:
         raise SystemExit("choose --kafka or --demo N (no broker specified)")
 
-    engine = StreamingClassifier(
-        pipe, consumer, producer, args.output_topic,
-        batch_size=args.batch_size, max_wait=args.max_wait)
+    def make_engine():
+        c, p = make_clients()
+        return StreamingClassifier(pipe, c, p, args.output_topic,
+                                   batch_size=args.batch_size, max_wait=args.max_wait)
+
     print(f"serving: model={args.model} in={args.input_topic} out={args.output_topic} "
           f"batch={args.batch_size}", flush=True)
-    try:
-        stats = engine.run(max_messages=max_messages, idle_timeout=idle)
-    except KeyboardInterrupt:
-        engine.stop()
-        stats = engine.stats
+    if args.supervise > 0:
+        # The supervisor builds and closes every consumer/producer itself
+        # (including on Ctrl-C, where it returns the aggregated stats).
+        from fraud_detection_tpu.stream.engine import run_supervised
+
+        stats = run_supervised(make_engine, max_restarts=args.supervise,
+                               max_messages=max_messages, idle_timeout=idle)
+    else:
+        engine = make_engine()
+        try:
+            stats = engine.run(max_messages=max_messages, idle_timeout=idle)
+        except KeyboardInterrupt:
+            engine.stop()
+            stats = engine.stats
+        finally:
+            engine.consumer.close()
     print(json.dumps(stats.as_dict()))
     if args.demo:
         n_out = broker.topic_size(args.output_topic)
         print(f"classified messages on {args.output_topic}: {n_out}")
-    consumer.close()
     return 0
 
 

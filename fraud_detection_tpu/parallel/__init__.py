@@ -3,6 +3,9 @@ from fraud_detection_tpu.parallel.mesh import (
     FEATURE_AXIS,
     batch_sharding,
     feature_sharding,
+    global_batch_from_local,
+    initialize_distributed,
+    make_hybrid_mesh,
     make_mesh,
     pad_to_multiple,
     replicated,
@@ -11,5 +14,6 @@ from fraud_detection_tpu.parallel.mesh import (
 
 __all__ = [
     "DATA_AXIS", "FEATURE_AXIS", "batch_sharding", "feature_sharding",
-    "make_mesh", "pad_to_multiple", "replicated", "shard_rows",
+    "make_mesh", "make_hybrid_mesh", "initialize_distributed",
+    "global_batch_from_local", "pad_to_multiple", "replicated", "shard_rows",
 ]

@@ -84,3 +84,74 @@ def shard_rows(x: np.ndarray, mesh: Mesh) -> jax.Array:
         x = np.pad(x, pad_width)
     return jax.device_put(x, batch_sharding(mesh) if x.ndim > 1
                           else NamedSharding(mesh, P(DATA_AXIS)))
+
+
+# ---------------------------------------------------------------------------
+# Multi-host (DCN) support
+# ---------------------------------------------------------------------------
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Multi-host bootstrap over DCN (the NCCL/MPI-rendezvous analogue).
+
+    Reads ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` when arguments are omitted; a no-op (returns False)
+    when the job is single-process, so single-host code paths never pay for
+    it. After this, ``jax.devices()`` spans every host's chips and the
+    named-axis collectives in this package ride ICI within a host and DCN
+    across hosts with no further code changes.
+    """
+    import os
+
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env_np = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env_np) if env_np is not None else None
+    if process_id is None:
+        env_pid = os.environ.get("JAX_PROCESS_ID")
+        # Stays None when unset: jax.distributed.initialize auto-detects the
+        # process id on managed TPU environments — forcing 0 would make every
+        # host claim rank 0 and wedge the rendezvous.
+        process_id = int(env_pid) if env_pid is not None else None
+    if coordinator_address is None or (num_processes is not None and num_processes <= 1):
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def make_hybrid_mesh(feature_parallel: int = 1) -> Mesh:
+    """DCN×ICI-aware (data, feature) mesh for multi-host pods.
+
+    Layout follows the standard scaling recipe: the data axis spans hosts
+    (its psums tolerate DCN latency — one small histogram/gradient reduction
+    per step), while feature parallelism stays inside a host so its tighter
+    collectives ride ICI. Single-process jobs fall back to ``make_mesh``.
+    """
+    if jax.process_count() == 1:
+        return make_mesh(feature_parallel=feature_parallel)
+    from jax.experimental import mesh_utils
+
+    local = jax.local_device_count()
+    if local % feature_parallel:
+        raise ValueError(
+            f"{local} local devices not divisible by feature_parallel={feature_parallel}")
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(local // feature_parallel, feature_parallel),
+        dcn_mesh_shape=(jax.process_count(), 1))
+    return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
+
+
+def global_batch_from_local(x_local: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Per-process rows -> one global row-sharded array.
+
+    Each host feeds only the rows it loaded (e.g. from its own Kafka
+    partition assignment); the result behaves as the concatenated global
+    batch sharded over the data axis. Local row counts must be equal across
+    processes (pad with zero rows + a validity mask as in ``shard_rows``).
+    """
+    sharding = (batch_sharding(mesh) if x_local.ndim > 1
+                else NamedSharding(mesh, P(DATA_AXIS)))
+    return jax.make_array_from_process_local_data(sharding, x_local)

@@ -68,6 +68,11 @@ class InProcessBroker:
     def __init__(self, num_partitions: int = 3):
         self.num_partitions = num_partitions
         self._topics: Dict[str, List[List[Message]]] = {}
+        # Group-durable committed offsets: (group, topic, partition) -> next
+        # offset. Lives on the BROKER, like Kafka's __consumer_offsets — a
+        # fresh consumer in the same group resumes where the group left off
+        # (this is what makes crash/restart tests honest).
+        self._group_offsets: Dict[tuple, int] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
 
@@ -114,9 +119,14 @@ class InProcessConsumer:
         self.broker = broker
         self.topics = topics
         self.group_id = group_id
-        # committed/position per (topic, partition)
-        self._position: Dict[tuple, int] = {}
-        self._committed: Dict[tuple, int] = {}
+        # Start from the group's broker-durable committed offsets (Kafka
+        # semantics: auto.offset.reset='earliest' applies only to partitions
+        # the group has never committed).
+        with broker._lock:
+            self._position: Dict[tuple, int] = {
+                (t, p): off for (g, t, p), off in broker._group_offsets.items()
+                if g == group_id and t in topics}
+        self._committed: Dict[tuple, int] = dict(self._position)
         self._closed = False
 
     def _next_from(self, topic: str, part_idx: int) -> Optional[Message]:
@@ -158,11 +168,20 @@ class InProcessConsumer:
 
     def commit(self) -> None:
         self._committed.update(self._position)
+        self._write_through()
 
     def commit_offsets(self, offsets: Dict[tuple, int]) -> None:
         for key, off in offsets.items():
             if off > self._committed.get(key, 0):
                 self._committed[key] = off
+        self._write_through()
+
+    def _write_through(self) -> None:
+        with self.broker._lock:
+            for (t, p), off in self._committed.items():
+                key = (self.group_id, t, p)
+                if off > self.broker._group_offsets.get(key, 0):
+                    self.broker._group_offsets[key] = off
 
     def committed_offsets(self) -> Dict[tuple, int]:
         return dict(self._committed)

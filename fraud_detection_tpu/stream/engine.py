@@ -19,6 +19,7 @@ raised and died — app_ui.py:200-201).
 from __future__ import annotations
 
 import json
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
@@ -34,9 +35,38 @@ class StreamStats:
     malformed: int = 0
     batches: int = 0
     commits_skipped: int = 0  # producer didn't drain; offsets left uncommitted
+    restarts: int = 0         # supervised engine rebuilds (run_supervised)
     elapsed: float = 0.0
     batch_latency_sum: float = 0.0
     batch_latency_max: float = 0.0
+    # Per-batch latencies for percentiles. Bounded: beyond the cap, random
+    # replacement keeps a uniform sample (reservoir) so a week-long run
+    # doesn't grow memory while p50/p99 stay honest.
+    latencies: List[float] = field(default_factory=list)
+    _latency_cap: int = 4096
+    _seen: int = 0
+
+    def record_latency(self, dt: float) -> None:
+        self.batch_latency_sum += dt
+        self.batch_latency_max = max(self.batch_latency_max, dt)
+        self._reservoir_add(dt)
+
+    def _reservoir_add(self, dt: float) -> None:
+        """Add a sample to the percentile reservoir WITHOUT touching the
+        exact sum/max accumulators (merge path reuses this)."""
+        self._seen += 1
+        if len(self.latencies) < self._latency_cap:
+            self.latencies.append(dt)
+        else:
+            j = random.randrange(self._seen)
+            if j < self._latency_cap:
+                self.latencies[j] = dt
+
+    def latency_percentile(self, q: float) -> float:
+        if not self.latencies:
+            return 0.0
+        s = sorted(self.latencies)
+        return s[min(len(s) - 1, int(q / 100.0 * len(s)))]
 
     @property
     def msgs_per_sec(self) -> float:
@@ -52,9 +82,12 @@ class StreamStats:
             "malformed": self.malformed,
             "batches": self.batches,
             "commits_skipped": self.commits_skipped,
+            "restarts": self.restarts,
             "elapsed_sec": round(self.elapsed, 4),
             "msgs_per_sec": round(self.msgs_per_sec, 1),
             "mean_batch_latency_sec": round(self.mean_batch_latency, 5),
+            "p50_batch_latency_sec": round(self.latency_percentile(50), 5),
+            "p99_batch_latency_sec": round(self.latency_percentile(99), 5),
             "max_batch_latency_sec": round(self.batch_latency_max, 5),
         }
 
@@ -163,11 +196,14 @@ class StreamingClassifier:
         # behind this one is never prematurely committed.
         undelivered = self.producer.flush()
         if undelivered:
+            # NOT counted as processed: the batch's outputs are (partially)
+            # lost and its offsets uncommitted, so a restart re-drives it —
+            # counting it would let a supervisor believe the work is done.
             self.stats.commits_skipped += 1
             self._flush_failed = True
             self._running = False
-        else:
-            self.consumer.commit_offsets(inflight.offsets)
+            return 0
+        self.consumer.commit_offsets(inflight.offsets)
 
         # Active processing latency: dispatch-side host work + this finish
         # leg (device wait, produce, flush, commit). Excludes time the batch
@@ -177,8 +213,7 @@ class StreamingClassifier:
         dt = inflight.dispatch_time + (time.perf_counter() - t1)
         self.stats.processed += len(msgs)
         self.stats.batches += 1
-        self.stats.batch_latency_sum += dt
-        self.stats.batch_latency_max = max(self.stats.batch_latency_max, dt)
+        self.stats.record_latency(dt)
         return len(msgs)
 
     def process_batch(self, msgs: List[Message]) -> int:
@@ -256,3 +291,92 @@ class _InFlight:
     pending: Optional[object]   # models.pipeline.PendingPrediction
     offsets: dict               # (topic, partition) -> next offset to commit
     dispatch_time: float        # host seconds spent in _dispatch
+
+
+def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
+                   max_restarts: int = 5,
+                   backoff: float = 0.5,
+                   backoff_cap: float = 30.0,
+                   max_messages: Optional[int] = None,
+                   idle_timeout: Optional[float] = None,
+                   sleep=time.sleep) -> StreamStats:
+    """Failure-detecting restart loop around the streaming engine.
+
+    The reference's loop dies on the first Kafka error and, because it never
+    commits offsets, restarts by re-reading the topic from the beginning
+    (SURVEY.md Q2 / §5 "no elasticity"). Here the commit protocol makes a
+    crash recoverable: ``make_engine`` builds a fresh engine (new consumer —
+    it resumes from the group's last committed offsets), restarts use
+    exponential backoff, and the backoff resets after any healthy run that
+    made progress. Gives up after ``max_restarts`` consecutive failures and
+    re-raises the last error.
+
+    Aggregated StreamStats across incarnations (restarts counted).
+    """
+    total = StreamStats()
+    consecutive = 0
+    last_error: Optional[BaseException] = None
+    while True:
+        budget = None if max_messages is None else max_messages - total.processed
+        if budget is not None and budget <= 0:
+            break
+        engine = make_engine()
+        failed: Optional[BaseException] = None
+        interrupted = False
+        try:
+            stats = engine.run(max_messages=budget, idle_timeout=idle_timeout)
+        except KeyboardInterrupt:
+            # Operator shutdown: report what was done, don't restart.
+            stats = engine.stats
+            interrupted = True
+        except Exception as e:  # noqa: BLE001 — supervisor's whole job
+            stats = engine.stats
+            failed = e
+        finally:
+            # The supervisor owns client lifecycles: a crashed incarnation's
+            # consumer must leave the group promptly (a zombie would hold its
+            # partition assignment until session timeout and stall the
+            # replacement), and sockets must not accumulate across restarts.
+            for client in (engine.consumer, engine.producer):
+                close = getattr(client, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001
+                        pass
+        _merge_stats(total, stats)
+        if interrupted:
+            break
+        flush_failed = stats.commits_skipped > 0
+        if failed is None and not flush_failed:
+            break  # clean exit (idle timeout / max_messages / stop())
+        last_error = failed
+        if stats.processed > 0:
+            consecutive = 0  # made progress: treat as a fresh incident
+        consecutive += 1
+        if consecutive > max_restarts:
+            if failed is not None:
+                raise failed
+            raise RuntimeError(
+                f"producer flush kept failing after {max_restarts} restarts "
+                f"(last committed offsets hold; {total.processed} processed)")
+        total.restarts += 1
+        sleep(min(backoff * (2 ** (consecutive - 1)), backoff_cap))
+    if last_error is not None and total.processed == 0:
+        raise last_error
+    return total
+
+
+def _merge_stats(total: StreamStats, part: StreamStats) -> None:
+    total.processed += part.processed
+    total.malformed += part.malformed
+    total.batches += part.batches
+    total.commits_skipped += part.commits_skipped
+    total.elapsed += part.elapsed
+    # Sum/max merge exactly; the percentile reservoir merges by samples (an
+    # incarnation that overflowed its reservoir contributes its subsample —
+    # percentiles stay estimates, mean/max stay exact).
+    total.batch_latency_sum += part.batch_latency_sum
+    total.batch_latency_max = max(total.batch_latency_max, part.batch_latency_max)
+    for dt in part.latencies:
+        total._reservoir_add(dt)

@@ -136,6 +136,127 @@ def test_engine_stops_when_producer_cannot_deliver(pipeline):
         pipeline, consumer, FailingProducer(broker.producer()), "out",
         batch_size=8, max_wait=0.01)
     stats = engine.run(max_messages=40, idle_timeout=0.5)
-    assert stats.batches == 1          # stopped after the first failed batch
-    assert stats.commits_skipped == 1
+    assert stats.commits_skipped == 1  # stopped after the first failed batch
+    assert stats.batches == 0          # a lost batch is NOT counted as done
+    assert stats.processed == 0        # (restart re-drives it: at-least-once)
     assert consumer.committed_offsets() == {}  # no offsets durably committed
+
+
+def test_group_offsets_survive_consumer_restart(pipeline):
+    """A NEW consumer in the same group resumes from the group's committed
+    offsets (broker-durable, like Kafka's __consumer_offsets)."""
+    broker = InProcessBroker(num_partitions=2)
+    prod = broker.producer()
+    for i in range(20):
+        prod.produce("t", json.dumps({"text": f"hello message {i}"}).encode(),
+                     key=str(i).encode())
+    c1 = broker.consumer(["t"], "g1")
+    engine = StreamingClassifier(pipeline, c1, broker.producer(), "out", batch_size=8)
+    engine.run(max_messages=20, idle_timeout=0.2)
+    # Fresh consumer, same group: nothing left.
+    c2 = broker.consumer(["t"], "g1")
+    assert c2.poll_batch(20, 0.05) == []
+    # Fresh group: re-reads from earliest.
+    c3 = broker.consumer(["t"], "g2")
+    assert len(c3.poll_batch(20, 0.05)) == 20
+
+
+def test_run_supervised_restarts_after_crash(pipeline):
+    """The supervisor rebuilds the engine after a crash and finishes the
+    stream without dropping or duplicating committed work."""
+    from fraud_detection_tpu.stream.engine import run_supervised
+
+    broker = InProcessBroker(num_partitions=1)
+    prod = broker.producer()
+    for i in range(40):
+        prod.produce("t", json.dumps({"text": f"message number {i}"}).encode())
+
+    calls = {"n": 0}
+
+    class CrashOnceProducer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def produce(self, topic, value, key=None):
+            self.inner.produce(topic, value, key)
+
+        def flush(self, timeout: float = 10.0) -> int:
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise ConnectionError("broker went away")
+            return self.inner.flush(timeout)
+
+    def make_engine():
+        return StreamingClassifier(
+            pipeline, broker.consumer(["t"], "sup"),
+            CrashOnceProducer(broker.producer()), "out", batch_size=8)
+
+    stats = run_supervised(make_engine, max_restarts=3, backoff=0.0,
+                           max_messages=40, idle_timeout=0.2, sleep=lambda s: None)
+    assert stats.restarts == 1
+    assert stats.processed >= 40  # crashed batch replays: at-least-once
+    outs = broker.messages("out")
+    assert len(outs) >= 40
+    # every input eventually classified
+    import json as j
+    seen = {j.loads(m.value)["original_text"] for m in outs}
+    assert len(seen) == 40
+
+
+def test_run_supervised_gives_up(pipeline):
+    from fraud_detection_tpu.stream.engine import run_supervised
+
+    broker = InProcessBroker(num_partitions=1)
+    prod = broker.producer()
+    for i in range(8):
+        prod.produce("t", json.dumps({"text": "x"}).encode())
+
+    class AlwaysFailProducer:
+        def produce(self, topic, value, key=None):
+            pass
+
+        def flush(self, timeout: float = 10.0) -> int:
+            return 3  # never drains
+
+    def make_engine():
+        return StreamingClassifier(
+            pipeline, broker.consumer(["t"], "fail"),
+            AlwaysFailProducer(), "out", batch_size=8)
+
+    with pytest.raises(RuntimeError, match="flush kept failing"):
+        run_supervised(make_engine, max_restarts=2, backoff=0.0,
+                       max_messages=8, idle_timeout=0.2, sleep=lambda s: None)
+
+
+def test_latency_percentiles_recorded(pipeline):
+    broker = InProcessBroker(num_partitions=1)
+    prod = broker.producer()
+    for i in range(30):
+        prod.produce("t", json.dumps({"text": f"dialogue {i}"}).encode())
+    cons = broker.consumer(["t"], "lat")
+    engine = StreamingClassifier(pipeline, cons, broker.producer(), "out", batch_size=10)
+    stats = engine.run(max_messages=30, idle_timeout=0.2)
+    assert len(stats.latencies) == stats.batches > 0
+    p50, p99 = stats.latency_percentile(50), stats.latency_percentile(99)
+    assert 0 < p50 <= p99 <= stats.batch_latency_max
+    assert set(stats.as_dict()) >= {"p50_batch_latency_sec", "p99_batch_latency_sec"}
+
+
+def test_run_supervised_closes_clients(pipeline):
+    """Every incarnation's consumer must leave the group promptly (a zombie
+    would hold its partitions until session timeout)."""
+    from fraud_detection_tpu.stream.engine import run_supervised
+
+    broker = InProcessBroker(num_partitions=1)
+    prod = broker.producer()
+    for i in range(8):
+        prod.produce("t", json.dumps({"text": "hello there"}).encode())
+    consumers = []
+
+    def make_engine():
+        c = broker.consumer(["t"], "closing")
+        consumers.append(c)
+        return StreamingClassifier(pipeline, c, broker.producer(), "out", batch_size=8)
+
+    run_supervised(make_engine, max_messages=8, idle_timeout=0.2, sleep=lambda s: None)
+    assert consumers and all(c._closed for c in consumers)
