@@ -88,7 +88,9 @@ def main(argv=None) -> int:
     ap.add_argument("--n-trees", type=int, default=100)
     ap.add_argument("--n-rounds", type=int, default=100)
     ap.add_argument("--save", action="append", default=[],
-                    help="model=dir pairs, e.g. dt=./fraud_model_dt (repeatable)")
+                    help="model=dir pairs, e.g. dt=./fraud_model_dt (repeatable); "
+                         "model=spark:<dir> exports the Spark PipelineModel "
+                         "layout instead of the native format")
     ap.add_argument("--mesh", action="store_true",
                     help="train data-parallel over all available devices")
     ap.add_argument("--json", action="store_true", help="emit metrics as JSON")
@@ -116,10 +118,11 @@ def main(argv=None) -> int:
     save_pairs = []
     for pair in args.save:  # validate before any training time is spent
         name, _, out_dir = pair.partition("=")
-        if not out_dir or name not in chosen:
+        target = out_dir[len("spark:"):] if out_dir.startswith("spark:") else out_dir
+        if not target or name not in chosen:
             raise SystemExit(
-                f"--save expects model=dir with the model in --models (got {pair!r}, "
-                f"models: {chosen})")
+                f"--save expects model=dir or model=spark:dir with the model in "
+                f"--models (got {pair!r}, models: {chosen})")
         save_pairs.append((name, out_dir))
 
     corpus = load_corpus(args)
@@ -229,8 +232,14 @@ def main(argv=None) -> int:
     from fraud_detection_tpu.checkpoint.native import save_checkpoint
 
     for name, out_dir in save_pairs:
-        save_checkpoint(out_dir, feat, trained[name])
-        print(f"saved {name} -> {out_dir}")
+        if out_dir.startswith("spark:"):
+            from fraud_detection_tpu.checkpoint import save_spark_pipeline
+
+            save_spark_pipeline(out_dir[len("spark:"):], feat, trained[name])
+            print(f"saved {name} -> {out_dir[len('spark:'):]} (Spark PipelineModel layout)")
+        else:
+            save_checkpoint(out_dir, feat, trained[name])
+            print(f"saved {name} -> {out_dir}")
     return 0
 
 

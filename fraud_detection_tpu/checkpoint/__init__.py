@@ -2,5 +2,6 @@ from fraud_detection_tpu.checkpoint.spark_artifact import (
     SparkPipelineArtifact,
     load_spark_pipeline,
 )
+from fraud_detection_tpu.checkpoint.spark_writer import save_spark_pipeline
 
-__all__ = ["SparkPipelineArtifact", "load_spark_pipeline"]
+__all__ = ["SparkPipelineArtifact", "load_spark_pipeline", "save_spark_pipeline"]

@@ -315,42 +315,48 @@ def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
     """
     total = StreamStats()
     consecutive = 0
-    last_error: Optional[BaseException] = None
     while True:
         budget = None if max_messages is None else max_messages - total.processed
         if budget is not None and budget <= 0:
             break
-        engine = make_engine()
+        engine: Optional[StreamingClassifier] = None
         failed: Optional[BaseException] = None
         interrupted = False
+        stats = StreamStats()
         try:
+            # make_engine is inside the guard: with the broker down, building
+            # the clients themselves can raise — that's a failed incarnation
+            # (backoff + retry), not a supervisor crash.
+            engine = make_engine()
             stats = engine.run(max_messages=budget, idle_timeout=idle_timeout)
         except KeyboardInterrupt:
             # Operator shutdown: report what was done, don't restart.
-            stats = engine.stats
+            if engine is not None:
+                stats = engine.stats
             interrupted = True
         except Exception as e:  # noqa: BLE001 — supervisor's whole job
-            stats = engine.stats
+            if engine is not None:
+                stats = engine.stats
             failed = e
         finally:
             # The supervisor owns client lifecycles: a crashed incarnation's
             # consumer must leave the group promptly (a zombie would hold its
             # partition assignment until session timeout and stall the
             # replacement), and sockets must not accumulate across restarts.
-            for client in (engine.consumer, engine.producer):
-                close = getattr(client, "close", None)
-                if close is not None:
-                    try:
-                        close()
-                    except Exception:  # noqa: BLE001
-                        pass
+            if engine is not None:
+                for client in (engine.consumer, engine.producer):
+                    close = getattr(client, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001
+                            pass
         _merge_stats(total, stats)
         if interrupted:
             break
         flush_failed = stats.commits_skipped > 0
         if failed is None and not flush_failed:
             break  # clean exit (idle timeout / max_messages / stop())
-        last_error = failed
         if stats.processed > 0:
             consecutive = 0  # made progress: treat as a fresh incident
         consecutive += 1
@@ -361,9 +367,10 @@ def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
                 f"producer flush kept failing after {max_restarts} restarts "
                 f"(last committed offsets hold; {total.processed} processed)")
         total.restarts += 1
-        sleep(min(backoff * (2 ** (consecutive - 1)), backoff_cap))
-    if last_error is not None and total.processed == 0:
-        raise last_error
+        try:
+            sleep(min(backoff * (2 ** (consecutive - 1)), backoff_cap))
+        except KeyboardInterrupt:
+            break  # operator shutdown during backoff: report and stop
     return total
 
 

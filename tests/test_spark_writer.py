@@ -1,0 +1,116 @@
+"""Round-trip tests for the Spark PipelineModel writer: everything written by
+save_spark_pipeline must load through the (shipped-artifact-validated) reader
+and score identically to the original native model."""
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.checkpoint import load_spark_pipeline, save_spark_pipeline
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer
+from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    from fraud_detection_tpu.data import generate_corpus
+
+    dialogues = generate_corpus(n=300, seed=21)
+    return [d.text for d in dialogues], np.asarray([d.label for d in dialogues])
+
+
+def _assert_roundtrip(tmp_path, featurizer, model, texts):
+    orig = ServingPipeline(featurizer, model, batch_size=64)
+    save_spark_pipeline(str(tmp_path / "export"), featurizer, model)
+    loaded = ServingPipeline.from_spark_artifact(
+        load_spark_pipeline(str(tmp_path / "export")), batch_size=64)
+    a, b = orig.predict(texts[:64]), loaded.predict(texts[:64])
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_allclose(a.probabilities, b.probabilities, atol=1e-6)
+
+
+def test_lr_hashing_idf_roundtrip(tmp_path, corpus):
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+
+    texts, y = corpus
+    feat = HashingTfIdfFeaturizer(num_features=2048)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_logistic_regression(X, y.astype(np.float32), max_iter=20)
+    _assert_roundtrip(tmp_path, feat, model, texts)
+
+
+def test_dt_count_vectorizer_roundtrip(tmp_path, corpus):
+    from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_decision_tree
+
+    texts, y = corpus
+    feat = VocabTfIdfFeaturizer.fit_vocabulary(texts, vocab_size=1024)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=4))
+    _assert_roundtrip(tmp_path, feat, model, texts)
+
+
+def test_rf_roundtrip_with_tree_weights(tmp_path, corpus):
+    from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_random_forest
+
+    texts, y = corpus
+    feat = HashingTfIdfFeaturizer(num_features=1024)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_random_forest(X, y, n_trees=8, tree_chunk=4,
+                              config=TreeTrainConfig(max_depth=4))
+    _assert_roundtrip(tmp_path, feat, model, texts)
+
+
+def test_xgboost_exports_as_gbt_with_identical_probabilities(tmp_path, corpus):
+    """Our sigmoid(margin) ensembles export as Spark GBT (sigmoid(2*margin))
+    with halved tree weights — probabilities must match exactly."""
+    from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_gradient_boosting
+
+    texts, y = corpus
+    # Imbalanced subset -> nonzero base-score bias, exercising the
+    # fold-bias-into-tree-0 path of the exporter.
+    keep = np.concatenate([np.where(y == 1)[0][:40], np.where(y == 0)[0]])
+    texts = [texts[i] for i in keep]
+    y = y[keep]
+    feat = HashingTfIdfFeaturizer(num_features=1024)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_gradient_boosting(
+        X, y, n_rounds=10, config=TreeTrainConfig(max_depth=3, criterion="xgb"))
+    assert abs(model.bias) > 1e-6, "expected a nonzero base-score bias"
+    save_spark_pipeline(str(tmp_path / "gbt"), feat, model)
+    art = load_spark_pipeline(str(tmp_path / "gbt"))
+    assert art.tree_ensemble.kind == "gbt"
+    loaded = ServingPipeline.from_spark_artifact(art, batch_size=64)
+    orig = ServingPipeline(feat, model, batch_size=64)
+    a, b = orig.predict(texts[:64]), loaded.predict(texts[:64])
+    np.testing.assert_allclose(a.probabilities, b.probabilities, atol=1e-5)
+    np.testing.assert_array_equal(a.labels, b.labels)
+
+
+def test_written_layout_matches_spark_shape(tmp_path, corpus):
+    """Directory shape: metadata/part-00000 JSON + stages/<i>_<uid>/..."""
+    import json
+    import os
+
+    texts, y = corpus
+    feat = HashingTfIdfFeaturizer(num_features=512)
+    feat.fit_idf(texts)
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_logistic_regression(X, y.astype(np.float32), max_iter=5)
+    out = str(tmp_path / "layout")
+    save_spark_pipeline(out, feat, model)
+
+    meta = json.loads(open(os.path.join(out, "metadata", "part-00000")).readline())
+    assert meta["class"] == "org.apache.spark.ml.PipelineModel"
+    uids = meta["paramMap"]["stageUids"]
+    assert [u.split("_")[0] for u in uids] == [
+        "Tokenizer", "StopWordsRemover", "HashingTF", "IDFModel",
+        "LogisticRegressionModel"]
+    stage_dirs = sorted(os.listdir(os.path.join(out, "stages")))
+    assert len(stage_dirs) == 5
+    for d in stage_dirs:
+        assert os.path.isfile(os.path.join(out, "stages", d, "metadata", "part-00000"))
