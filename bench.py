@@ -51,6 +51,7 @@ def main() -> None:
     batch_size = int(os.environ.get("BENCH_BATCH", "4096"))
     n_msgs = int(os.environ.get("BENCH_MSGS", "20000"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
+    depth = int(os.environ.get("BENCH_DEPTH", "4"))
 
     corpus = generate_corpus(n=2000, seed=123)
     texts = [d.text for d in corpus]
@@ -71,7 +72,7 @@ def main() -> None:
         consumer = broker.consumer(["customer-dialogues-raw"], "bench")
         engine = StreamingClassifier(
             pipe, consumer, broker.producer(), "dialogues-classified",
-            batch_size=batch_size, max_wait=0.01)
+            batch_size=batch_size, max_wait=0.01, pipeline_depth=depth)
         stats = engine.run(max_messages=n_msgs, idle_timeout=1.0)
         assert stats.processed == n_msgs, stats.as_dict()
         best = max(best, stats.msgs_per_sec)

@@ -47,6 +47,8 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-size", type=int, default=1024)
     ap.add_argument("--max-wait", type=float, default=0.05,
                     help="micro-batch assembly deadline (seconds)")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="device batches kept in flight (hides round-trip latency)")
     ap.add_argument("--kafka", action="store_true",
                     help="use real Kafka via confluent_kafka + KAFKA_* env vars")
     ap.add_argument("--demo", type=int, metavar="N", default=0,
@@ -97,7 +99,8 @@ def main(argv=None) -> int:
     def make_engine():
         c, p = make_clients()
         return StreamingClassifier(pipe, c, p, args.output_topic,
-                                   batch_size=args.batch_size, max_wait=args.max_wait)
+                                   batch_size=args.batch_size, max_wait=args.max_wait,
+                                   pipeline_depth=args.pipeline_depth)
 
     print(f"serving: model={args.model} in={args.input_topic} out={args.output_topic} "
           f"batch={args.batch_size}", flush=True)

@@ -141,26 +141,35 @@ def save_spark_pipeline(path: str,
         path, idx, "org.apache.spark.ml.feature.Tokenizer",
         {"inputCol": "clean_text", "outputCol": "words"}))
     idx += 1
-    uids.append(_write_stage(
-        path, idx, "org.apache.spark.ml.feature.StopWordsRemover",
-        {"inputCol": "words", "outputCol": "filtered_words",
-         "stopWords": list(featurizer.stop_filter.words),
-         "caseSensitive": featurizer.stop_filter.case_sensitive,
-         "locale": "en"}))
-    idx += 1
+    # A StopWordsRemover stage is written ONLY when the featurizer actually
+    # filters — the reader infers remove_stopwords from the stage's presence,
+    # so an unconditional stage would flip a remove_stopwords=False model's
+    # serve-time behavior after a round trip.
+    tokens_col = "words"
+    if featurizer.remove_stopwords:
+        uids.append(_write_stage(
+            path, idx, "org.apache.spark.ml.feature.StopWordsRemover",
+            {"inputCol": "words", "outputCol": "filtered_words",
+             "stopWords": list(featurizer.stop_filter.words),
+             "caseSensitive": featurizer.stop_filter.case_sensitive,
+             "locale": "en"}))
+        idx += 1
+        tokens_col = "filtered_words"
     if isinstance(featurizer, VocabTfIdfFeaturizer):
         uids.append(_write_stage(
             path, idx, "org.apache.spark.ml.feature.CountVectorizerModel",
-            {"inputCol": "filtered_words", "outputCol": raw_col,
+            {"inputCol": tokens_col, "outputCol": raw_col,
              "minTF": featurizer.min_tf, "binary": featurizer.binary_tf,
              "vocabSize": len(featurizer.vocabulary)},
             data_rows=[{"vocabulary": list(featurizer.vocabulary)}]))
+        n_features = len(featurizer.vocabulary)
     else:
         uids.append(_write_stage(
             path, idx, "org.apache.spark.ml.feature.HashingTF",
-            {"inputCol": "filtered_words", "outputCol": raw_col,
+            {"inputCol": tokens_col, "outputCol": raw_col,
              "numFeatures": featurizer.num_features,
              "binary": featurizer.binary_tf}))
+        n_features = featurizer.num_features
     idx += 1
     if has_idf:
         doc_freq = getattr(featurizer, "doc_freq", None)
@@ -191,7 +200,7 @@ def save_spark_pipeline(path: str,
                 "isMultinomial": False,
             }]))
     elif isinstance(model, TreeEnsemble):
-        uids.append(_write_tree_model(path, idx, model))
+        uids.append(_write_tree_model(path, idx, model, n_features))
     else:
         raise TypeError(f"unsupported model type {type(model).__name__}")
 
@@ -206,8 +215,8 @@ def save_spark_pipeline(path: str,
         }) + "\n")
 
 
-def _write_tree_model(path: str, idx: int, model: TreeEnsemble) -> str:
-    n_feat = 0  # unknown post-training; loaders that need it read the featurizer
+def _write_tree_model(path: str, idx: int, model: TreeEnsemble,
+                      n_feat: int) -> str:
     common = {"featuresCol": "features", "labelCol": "label",
               "maxDepth": model.max_depth}
     num_classes = max(model.num_outputs, 2)

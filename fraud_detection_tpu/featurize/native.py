@@ -35,7 +35,7 @@ def _build() -> Optional[str]:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_LIB))
         os.close(fd)
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)
         return _LIB

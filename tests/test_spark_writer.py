@@ -114,3 +114,39 @@ def test_written_layout_matches_spark_shape(tmp_path, corpus):
     assert len(stage_dirs) == 5
     for d in stage_dirs:
         assert os.path.isfile(os.path.join(out, "stages", d, "metadata", "part-00000"))
+
+
+def test_no_stopword_featurizer_roundtrip(tmp_path, corpus):
+    """remove_stopwords=False must NOT write a StopWordsRemover stage: the
+    reader infers stopword filtering from the stage's presence, so an
+    unconditional stage flips serve-time behavior after a round trip."""
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+
+    texts, y = corpus
+    feat = HashingTfIdfFeaturizer(num_features=2048, remove_stopwords=False)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_logistic_regression(X, y.astype(np.float32), max_iter=20)
+    _assert_roundtrip(tmp_path, feat, model, texts)
+    loaded = ServingPipeline.from_spark_artifact(
+        load_spark_pipeline(str(tmp_path / "export")), batch_size=64)
+    assert loaded.featurizer.remove_stopwords is False
+
+
+def test_tree_stage_records_num_features(tmp_path, corpus):
+    import json as _json
+    import glob as _glob
+
+    from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_decision_tree
+
+    texts, y = corpus
+    feat = HashingTfIdfFeaturizer(num_features=2048)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    model = fit_decision_tree(X, y, config=TreeTrainConfig(max_depth=3))
+    save_spark_pipeline(str(tmp_path / "export"), feat, model)
+    [meta_path] = _glob.glob(
+        str(tmp_path / "export" / "stages" / "*DecisionTree*" / "metadata" / "part-00000"))
+    with open(meta_path) as fh:
+        meta = _json.loads(fh.read())
+    assert meta["paramMap"]["numFeatures"] == 2048
