@@ -64,6 +64,10 @@ def main(argv=None) -> int:
 
     if args.kafka and args.demo:
         raise SystemExit("--kafka and --demo are mutually exclusive")
+    if args.pipeline_depth < 1:
+        # Fail fast: inside --supervise this would read as a transient
+        # incarnation failure and burn restarts on a pure config error.
+        raise SystemExit(f"--pipeline-depth must be >= 1, got {args.pipeline_depth}")
 
     from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
     from fraud_detection_tpu.stream.kafka import kafka_available

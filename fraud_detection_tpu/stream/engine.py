@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import random
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -141,7 +142,7 @@ class StreamingClassifier:
     def _decode(self, msg: Message) -> Optional[str]:
         try:
             payload = json.loads(msg.value)  # bytes accepted; skips a copy
-        except (UnicodeDecodeError, json.JSONDecodeError, ValueError):
+        except ValueError:  # JSONDecodeError and UnicodeDecodeError subclass it
             return None
         text = payload.get(self.text_field) if isinstance(payload, dict) else None
         return text if isinstance(text, str) else None
@@ -252,8 +253,6 @@ class StreamingClassifier:
         hides the full device round-trip behind host work — on a remote
         (tunneled) TPU the round-trip latency exceeds one batch of host work,
         so deeper pipelining is what makes the stream host-bound."""
-        from collections import deque
-
         self._running = True
         self._flush_failed = False
         started = time.perf_counter()

@@ -119,3 +119,19 @@ def test_native_speedup_sanity():
     twin.encode(docs, batch_size=512)
     t_python = time.perf_counter() - t0
     assert t_native < t_python, (t_native, t_python)
+
+
+def test_threaded_batch_parity():
+    """Batches >= 256 docs take the multithreaded C++ branch (worker threads
+    split the batch); parity with the Python twin must hold across chunk
+    boundaries — the single-threaded branch passing is not evidence."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    docs = [d.text for d in generate_corpus(n=600, seed=44)]
+    docs += ["", "   ", "a", "üñïçödé only", docs[0] * 3]  # edge rows in the last chunk
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    twin = _python_twin(feat)
+    got = feat.encode(docs, batch_size=1024)
+    want = twin.encode(docs, batch_size=1024)
+    np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
