@@ -81,6 +81,28 @@ def load_library() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
             ctypes.c_int, ctypes.c_int]
+        try:  # stale prebuilt .so without the JSON path: degrade, don't fail
+            lib.ftok_encode_json_begin.restype = ctypes.c_int
+            lib.ftok_encode_json_begin.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_char_p),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+            lib._has_json = True
+        except AttributeError:
+            lib._has_json = False
+        try:  # direct wire-dtype fill (int16 ids / uint16 counts)
+            lib.ftok_encode_fill16.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS"),
+                ctypes.c_int, ctypes.c_int]
+            lib._has_fill16 = True
+        except AttributeError:
+            lib._has_fill16 = False
         _lib = lib
         return _lib
 
@@ -109,20 +131,70 @@ class NativeFeaturizer:
     def hash_bucket(self, term: str) -> int:
         return self._lib.ftok_hash_bucket(self._handle, term.encode("utf-8"))
 
+    def supports_json(self) -> bool:
+        return bool(getattr(self._lib, "_has_json", False))
+
+    def _fill(self, rows: int, length: int, want16: bool
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Drain handle row state into padded arrays. ``want16`` (and library
+        support) emits the device wire dtypes (int16 ids / uint16 counts,
+        clipped) directly from C++, skipping a Python astype+copy of both
+        (B, L) arrays; callers gate want16 on num_features <= int16 max."""
+        if want16 and getattr(self._lib, "_has_fill16", False):
+            ids = np.empty((rows, length), np.int16)
+            counts = np.empty((rows, length), np.uint16)
+            self._lib.ftok_encode_fill16(self._handle, ids, counts, rows, length)
+        else:
+            ids = np.empty((rows, length), np.int32)
+            counts = np.empty((rows, length), np.float32)
+            self._lib.ftok_encode_fill(self._handle, ids, counts, rows, length)
+        return ids, counts
+
     def encode(self, texts: Sequence[str], rows: int,
-               max_tokens: Optional[int], pad_len) -> Tuple[np.ndarray, np.ndarray]:
+               max_tokens: Optional[int], pad_len,
+               want16: bool = False) -> Tuple[np.ndarray, np.ndarray]:
         """Padded (rows, L) ids/counts — same contract as the Python encode."""
         # NULs would truncate the C string; clean() strips them anyway, and
         # they are not token separators, so removal preserves parity.
-        buf: List[bytes] = [t.encode("utf-8").replace(b"\x00", b"") for t in texts]
+        # surrogatepass: json.loads legally yields lone surrogates (\ud800);
+        # the C++ permissive decoder strips those codepoints exactly like the
+        # Python clean regex strips the surrogate char.
+        buf: List[bytes] = [
+            t.encode("utf-8", "surrogatepass").replace(b"\x00", b"") for t in texts]
         arr = (ctypes.c_char_p * len(buf))(*buf)
         with self._call_lock:
             width = self._lib.ftok_encode_begin(self._handle, arr, len(buf))
             length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
-            ids = np.zeros((rows, length), np.int32)
-            counts = np.zeros((rows, length), np.float32)
-            self._lib.ftok_encode_fill(self._handle, ids, counts, rows, length)
-        return ids, counts
+            return self._fill(rows, length, want16)
+
+    def encode_json(self, values: Sequence[bytes], key: bytes, rows: int,
+                    max_tokens: Optional[int], pad_len,
+                    want16: bool = False) -> Tuple[
+                        np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Raw-JSON batch encode: one native pass extracts the string field
+        ``key`` from each JSON message, cleans+tokenizes+hashes it.
+
+        Returns (ids, counts, status, span_start, span_len): padded (rows, L)
+        arrays where malformed messages (status 0) are all-padding rows, plus
+        the raw string literal's byte span (including quotes) inside each
+        message for zero-copy splicing into output frames. Explicit lengths
+        are passed, so embedded NULs in message bytes are handled exactly
+        (json.loads would reject them inside strings as raw control chars)."""
+        if not getattr(self._lib, "_has_json", False):
+            raise RuntimeError("native library predates the JSON encode path")
+        n = len(values)
+        arr = (ctypes.c_char_p * n)(*values)
+        lens = np.fromiter((len(v) for v in values), np.int32, n)
+        status = np.zeros(n, np.int32)
+        span_start = np.zeros(n, np.int32)
+        span_len = np.zeros(n, np.int32)
+        with self._call_lock:
+            width = self._lib.ftok_encode_json_begin(
+                self._handle, arr, lens, n, key, len(key),
+                status, span_start, span_len)
+            length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
+            ids, counts = self._fill(rows, length, want16)
+        return ids, counts, status, span_start, span_len
 
 
 def available() -> bool:

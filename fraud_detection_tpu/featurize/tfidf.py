@@ -153,7 +153,10 @@ class HashingTfIdfFeaturizer:
             raise ValueError(f"{len(texts)} texts > batch_size {b}")
         native = self._native_featurizer()
         if native is not None:
-            ids, counts = native.encode(texts, b, max_tokens, _pad_len)
+            ids, counts = native.encode(texts, b, max_tokens, _pad_len,
+                                        want16=self._ids_dtype() is np.int16)
+            if ids.dtype == np.int16:  # C++ emitted wire dtypes directly
+                return EncodedBatch(ids=ids, counts=counts)
             return EncodedBatch(*self._narrow(ids, counts))
         rows = [self.sparse_row(t) for t in texts]
         width = max((len(i) for i, _ in rows), default=1)
@@ -169,6 +172,34 @@ class HashingTfIdfFeaturizer:
             ids[r, : len(idx)] = idx
             counts[r, : len(val)] = np.minimum(val, 65535.0)
         return EncodedBatch(ids=ids, counts=counts)
+
+    def encode_json(self, values: Sequence[bytes], text_field: str = "text",
+                    batch_size: Optional[int] = None,
+                    max_tokens: Optional[int] = None) -> Optional[Tuple[
+                        "EncodedBatch", np.ndarray, np.ndarray, np.ndarray]]:
+        """Raw-JSON fast path: encode Kafka message bytes WITHOUT Python-side
+        json.loads — one native pass extracts ``text_field``, cleans,
+        tokenizes, and hashes (featurize/native.py ``encode_json``).
+
+        Returns ``(batch, status, span_start, span_len)`` where row i of the
+        batch corresponds to values[i] (status 0 rows are all-padding and
+        score as garbage to be discarded by the caller), and the spans locate
+        each message's raw string literal (quotes included) for zero-copy
+        splicing into output JSON. Returns None when the native path is
+        unavailable (no toolchain, or a vocabulary featurizer) — callers fall
+        back to json.loads + ``encode``."""
+        native = self._native_featurizer()
+        if native is None or not native.supports_json():
+            return None
+        b = batch_size if batch_size is not None else len(values)
+        if len(values) > b:
+            raise ValueError(f"{len(values)} values > batch_size {b}")
+        ids, counts, status, span_start, span_len = native.encode_json(
+            values, text_field.encode("utf-8"), b, max_tokens, _pad_len,
+            want16=self._ids_dtype() is np.int16)
+        if ids.dtype != np.int16:
+            ids, counts = self._narrow(ids, counts)
+        return EncodedBatch(ids=ids, counts=counts), status, span_start, span_len
 
     def _ids_dtype(self):
         return np.int16 if self.num_features <= np.iinfo(np.int16).max else np.int32

@@ -146,6 +146,47 @@ class ServingPipeline:
                 f"(got {[type(s).__name__ for s in artifact.stages]})")
         return cls(featurizer, model, fold_idf=True, batch_size=batch_size)
 
+    def predict_json_async(self, values: Sequence[bytes], text_field: str = "text"
+                           ) -> Optional[Tuple["PendingPrediction", np.ndarray, np.ndarray, np.ndarray]]:
+        """Raw-JSON fast path: score Kafka message bytes without Python-side
+        json.loads (featurize/tfidf.py ``encode_json`` — one native pass from
+        message bytes to hashed sparse rows).
+
+        Returns ``(pending, status, span_start, span_len)`` where the pending
+        prediction covers ALL rows positionally (row i = values[i]; status 0
+        rows are all-padding and score as garbage the caller must discard),
+        or None when unavailable (no native library, vocabulary featurizer,
+        or tree model — trees need the dense matrix built from decoded text).
+        The spans locate each message's raw string literal for zero-copy
+        output framing (stream/engine.py)."""
+        if self._fused_model is None:
+            return None
+        encode_json = getattr(self.featurizer, "encode_json", None)
+        if encode_json is None:
+            return None
+        parts: List[Tuple[object, int]] = []
+        stats: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        for start in range(0, len(values), self.batch_size):
+            chunk = values[start : start + self.batch_size]
+            out = encode_json(chunk, text_field, batch_size=self.batch_size)
+            if out is None:
+                return None
+            enc, status, span_start, span_len = out
+            p = linear_mod.prob_encoded(self._fused_model, enc)
+            copy_async = getattr(p, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+            parts.append((p, len(chunk)))
+            stats.append((status, span_start, span_len))
+        if not stats:
+            empty = np.empty(0, np.int32)
+            return PendingPrediction([], threshold=0.5), empty, empty, empty
+        status = np.concatenate([s[0] for s in stats])
+        span_start = np.concatenate([s[1] for s in stats])
+        span_len = np.concatenate([s[2] for s in stats])
+        return (PendingPrediction(parts, threshold=self._fused_model.threshold),
+                status, span_start, span_len)
+
     def predict_async(self, texts: Sequence[str]) -> "PendingPrediction":
         """Featurize + dispatch device scoring WITHOUT blocking on results.
 

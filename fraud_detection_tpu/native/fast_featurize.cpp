@@ -92,16 +92,132 @@ inline int hash_bucket(std::string_view term, int num_features) {
   return non_negative_mod(static_cast<int32_t>(h), num_features);
 }
 
-// Unicode-aware clean: lowercase, keep [a-z ] only. Non-ASCII handled per the
-// contract above (U+0130 -> 'i', U+212A -> 'k', everything else stripped).
-void clean_utf8(const char* text, std::string& out) {
-  out.clear();
+struct Featurizer {
+  int num_features;
+  bool binary;
+  bool remove_stopwords;
+  std::vector<std::string> stopword_storage;          // owns the bytes
+  std::unordered_set<std::string_view> stopwords;     // views into storage
+  // Murmur-keyed open-addressing stopword table: tokens are murmur3-hashed
+  // exactly once, and that hash serves BOTH the stopword probe and the
+  // feature bucket — the std::hash pass of an unordered_set per token was
+  // ~20% of single-core encode time.
+  std::vector<std::pair<uint32_t, std::string_view>> stop_table;
+  uint32_t stop_mask = 0;
+  bool empty_is_stop = false;
+  int empty_bucket = 0;  // bucket of the "" token (Java "".split -> [""])
+  // per-batch scratch (kept between begin/fill calls; capacity persists
+  // across batches so steady-state encodes do zero row allocations)
+  std::vector<std::vector<std::pair<int, float>>> rows;  // sorted by bucket id
+  int n_rows = 0;
+
+  void build_stop_table() {
+    size_t cap = 8;
+    while (cap < stopwords.size() * 2 + 1) cap <<= 1;
+    stop_table.assign(cap, {0u, std::string_view()});
+    stop_mask = uint32_t(cap - 1);
+    for (const auto& s : stopwords) {
+      uint32_t h = murmur3_x86_32(
+          reinterpret_cast<const unsigned char*>(s.data()), s.size(), 42u);
+      uint32_t i = h & stop_mask;
+      while (stop_table[i].second.data() != nullptr) i = (i + 1) & stop_mask;
+      stop_table[i] = {h, s};
+    }
+    empty_is_stop = stopwords.count(std::string_view()) > 0;
+    empty_bucket = hash_bucket(std::string_view(), num_features);
+  }
+
+  inline bool is_stop(uint32_t h, const char* data, size_t len) const {
+    uint32_t i = h & stop_mask;
+    while (true) {
+      const auto& e = stop_table[i];
+      if (e.second.data() == nullptr) return false;
+      if (e.first == h && e.second.size() == len &&
+          std::memcmp(e.second.data(), data, len) == 0)
+        return true;
+      i = (i + 1) & stop_mask;
+    }
+  }
+};
+
+// Streaming tokenizer: consumes already-cleaned chars (only [a-z ] can
+// arrive) one at a time and emits hashed buckets — fused clean -> split ->
+// stopword -> murmur in a single pass with no intermediate cleaned string or
+// token views. Replicates Java String.split("\\s") semantics: interior empty
+// tokens are real (deferred via `pending_empty` until a later non-empty token
+// proves them interior), trailing empties drop, and a fully-empty input is
+// the single token [""].
+struct TokenSink {
+  const Featurizer* f;
+  std::vector<int>& buckets;
+  std::string tok;
+  int pending_empty = 0;
+  bool seen_any = false;  // any cleaned char at all (incl. spaces)
+
+  TokenSink(const Featurizer* f_, std::vector<int>& b) : f(f_), buckets(b) {}
+
+  inline void put(char c) {
+    seen_any = true;
+    if (c == ' ') {
+      if (tok.empty()) ++pending_empty;
+      else emit();
+    } else {
+      tok.push_back(c);
+    }
+  }
+
+  inline void flush_empties() {
+    if (pending_empty) {
+      if (!f->remove_stopwords || !f->empty_is_stop)
+        buckets.insert(buckets.end(), pending_empty, f->empty_bucket);
+      pending_empty = 0;
+    }
+  }
+
+  inline void emit() {
+    flush_empties();
+    uint32_t h = murmur3_x86_32(
+        reinterpret_cast<const unsigned char*>(tok.data()), tok.size(), 42u);
+    if (!f->remove_stopwords || !f->is_stop(h, tok.data(), tok.size()))
+      buckets.push_back(non_negative_mod(static_cast<int32_t>(h), f->num_features));
+    tok.clear();
+  }
+
+  void finish() {
+    if (!tok.empty()) emit();            // final non-empty segment
+    else if (!seen_any) emit();          // "" -> [""] (hash of empty token)
+    pending_empty = 0;                   // trailing empties drop
+  }
+};
+
+// Collapse a doc's hashed buckets into its id-sorted unique (bucket, count)
+// row. sort + run-length count beats a hash map at typical (~100-300 token)
+// dialogue sizes. Returns the row width.
+int build_row(const Featurizer* f, std::vector<int>& buckets,
+              std::vector<std::pair<int, float>>& row) {
+  std::sort(buckets.begin(), buckets.end());
+  row.clear();
+  for (size_t i = 0; i < buckets.size();) {
+    size_t j = i + 1;
+    while (j < buckets.size() && buckets[j] == buckets[i]) ++j;
+    row.emplace_back(buckets[i], f->binary ? 1.0f : float(j - i));
+    i = j;
+  }
+  return int(row.size());
+}
+
+// Fused clean+tokenize+hash over raw UTF-8 (the plain-text encode path).
+void encode_text_utf8(const Featurizer* f, const char* text,
+                      std::vector<int>& buckets,
+                      std::vector<std::pair<int, float>>& row) {
+  buckets.clear();
+  TokenSink sink(f, buckets);
   const unsigned char* p = reinterpret_cast<const unsigned char*>(text);
   while (*p) {
     unsigned char c = *p;
     if (c < 0x80) {
       if (c >= 'A' && c <= 'Z') c = c - 'A' + 'a';
-      if ((c >= 'a' && c <= 'z') || c == ' ') out.push_back(char(c));
+      if ((c >= 'a' && c <= 'z') || c == ' ') sink.put(char(c));
       ++p;
     } else {
       // decode one UTF-8 sequence (permissive; invalid bytes skipped)
@@ -119,40 +235,380 @@ void clean_utf8(const char* text, std::string& out) {
         ++p;
       }
       if (!ok) continue;
-      if (cp == 0x0130) out.push_back('i');       // İ -> i + U+0307(stripped)
-      else if (cp == 0x212A) out.push_back('k');  // Kelvin sign -> k
+      if (cp == 0x0130) sink.put('i');       // İ -> i + U+0307(stripped)
+      else if (cp == 0x212A) sink.put('k');  // Kelvin sign -> k
       // all other non-ASCII codepoints lowercase outside [a-z ] and strip
     }
   }
+  sink.finish();
+  build_row(f, buckets, row);
 }
 
-// Java String.split("\\s") on cleaned text (only ' ' can remain). Tokens are
-// views into the cleaned buffer — zero per-token allocation.
-void java_split(const std::string& s, std::vector<std::string_view>& out) {
-  out.clear();
-  if (s.empty()) {
-    out.emplace_back();  // Java: "".split -> [""]
-    return;
+// ---------------------------------------------------------------------------
+// Raw-JSON fast path: scan a whole Kafka message's JSON bytes, pull out the
+// target string field, and clean+tokenize it in the same pass — so the serving
+// engine never runs Python json.loads / json.dumps per message. The scanner
+// matches CPython json.loads semantics (strict UTF-8, control-char rejection,
+// escape validation, last-duplicate-key-wins, NaN/Infinity literals) so that
+// a message it accepts is exactly one the Python slow path would accept; any
+// message it REJECTS is re-checked by the engine with json.loads, keeping
+// behavior identical even on inputs this scanner is stricter about.
+// ---------------------------------------------------------------------------
+
+struct JsonScanner {
+  const unsigned char* base;
+  const unsigned char* p;
+  const unsigned char* end;
+  static constexpr int kMaxDepth = 512;  // stricter than CPython's recursion
+                                         // limit; deeper inputs fall back to
+                                         // the Python decode path
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
   }
-  size_t start = 0;
-  for (size_t i = 0; i <= s.size(); ++i) {
-    if (i == s.size() || s[i] == ' ') {
-      out.emplace_back(s.data() + start, i - start);
-      start = i + 1;
+
+  bool lit(const char* s, size_t n) {
+    if (size_t(end - p) < n || std::memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  static bool hex4(const unsigned char* q, uint32_t* out) {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      unsigned char c = q[i];
+      uint32_t d;
+      if (c >= '0' && c <= '9') d = c - '0';
+      else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+      else return false;
+      v = (v << 4) | d;
+    }
+    *out = v;
+    return true;
+  }
+
+  // Strict UTF-8 validation (overlongs, surrogates, > U+10FFFF rejected —
+  // the same inputs Python's bytes.decode("utf-8") rejects before json even
+  // parses). Advances past one multi-byte sequence.
+  bool skip_valid_utf8() {
+    unsigned char c = *p;
+    if (c < 0xC2) return false;  // stray continuation or overlong C0/C1 lead
+    int need;
+    unsigned char lo = 0x80, hi = 0xBF;
+    if (c < 0xE0) need = 1;
+    else if (c < 0xF0) {
+      need = 2;
+      if (c == 0xE0) lo = 0xA0;             // overlong
+      else if (c == 0xED) hi = 0x9F;        // surrogates
+    } else if (c < 0xF5) {
+      need = 3;
+      if (c == 0xF0) lo = 0x90;             // overlong
+      else if (c == 0xF4) hi = 0x8F;        // > U+10FFFF
+    } else {
+      return false;
+    }
+    if (end - p <= need) return false;
+    if (p[1] < lo || p[1] > hi) return false;
+    for (int i = 2; i <= need; ++i)
+      if ((p[i] & 0xC0) != 0x80) return false;
+    p += need + 1;
+    return true;
+  }
+
+  // Validate+skip a string starting at '"'. On success `*content_start` /
+  // `*content_end` hold the offsets of the raw (still-escaped) contents.
+  bool scan_string(int* content_start, int* content_end) {
+    if (p >= end || *p != '"') return false;
+    ++p;
+    *content_start = int(p - base);
+    while (p < end) {
+      unsigned char c = *p;
+      if (c == '"') {
+        *content_end = int(p - base);
+        ++p;
+        return true;
+      }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return false;
+        unsigned char e = *p;
+        if (e == '"' || e == '\\' || e == '/' || e == 'b' || e == 'f' ||
+            e == 'n' || e == 'r' || e == 't') {
+          ++p;
+        } else if (e == 'u') {
+          ++p;
+          uint32_t cp;
+          if (end - p < 4 || !hex4(p, &cp)) return false;
+          p += 4;
+        } else {
+          return false;
+        }
+      } else if (c < 0x20) {
+        return false;  // raw control char (json.loads strict mode rejects)
+      } else if (c < 0x80) {
+        ++p;
+      } else if (!skip_valid_utf8()) {
+        return false;
+      }
+    }
+    return false;  // unterminated
+  }
+
+  bool number() {
+    if (p < end && *p == '-') ++p;
+    if (p >= end) return false;
+    if (*p == '0') {
+      ++p;
+    } else if (*p >= '1' && *p <= '9') {
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    } else {
+      return false;
+    }
+    if (p < end && *p == '.') {
+      ++p;
+      if (p >= end || *p < '0' || *p > '9') return false;
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      ++p;
+      if (p < end && (*p == '+' || *p == '-')) ++p;
+      if (p >= end || *p < '0' || *p > '9') return false;
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    return true;
+  }
+
+  bool object(int depth) {
+    if (depth > kMaxDepth) return false;
+    ++p;  // '{'
+    ws();
+    if (p < end && *p == '}') { ++p; return true; }
+    while (true) {
+      ws();
+      int s, e;
+      if (!scan_string(&s, &e)) return false;
+      ws();
+      if (p >= end || *p != ':') return false;
+      ++p;
+      if (!value(depth)) return false;
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; return true; }
+      return false;
     }
   }
-  while (!out.empty() && out.back().empty()) out.pop_back();  // drop trailing
+
+  bool array(int depth) {
+    if (depth > kMaxDepth) return false;
+    ++p;  // '['
+    ws();
+    if (p < end && *p == ']') { ++p; return true; }
+    while (true) {
+      if (!value(depth)) return false;
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return true; }
+      return false;
+    }
+  }
+
+  bool value(int depth) {
+    ws();
+    if (p >= end) return false;
+    switch (*p) {
+      case '"': { int s, e; return scan_string(&s, &e); }
+      case '{': return object(depth + 1);
+      case '[': return array(depth + 1);
+      case 't': return lit("true", 4);
+      case 'f': return lit("false", 5);
+      case 'n': return lit("null", 4);
+      case 'N': return lit("NaN", 3);          // json.loads accepts these
+      case 'I': return lit("Infinity", 8);
+      case '-':
+        if (end - p >= 9 && std::memcmp(p, "-Infinity", 9) == 0) { p += 9; return true; }
+        return number();
+      default: return number();
+    }
+  }
+};
+
+// Decode the (validated) raw contents of a JSON string literal straight into
+// the fused tokenizer — escapes like \n, \", \\ all clean to nothing; \uXXXX
+// goes through the same codepoint rule as raw UTF-8. No intermediate decoded
+// or cleaned string is ever materialized.
+void decode_clean_json(const unsigned char* s, const unsigned char* e, TokenSink& sink) {
+  while (s < e) {
+    unsigned char c = *s;
+    if (c == '\\') {
+      unsigned char esc = s[1];
+      s += 2;
+      if (esc == 'u') {
+        uint32_t cp = 0;
+        JsonScanner::hex4(s, &cp);
+        s += 4;
+        if (cp >= 0xD800 && cp <= 0xDBFF && e - s >= 6 && s[0] == '\\' && s[1] == 'u') {
+          uint32_t lo2 = 0;
+          if (JsonScanner::hex4(s + 2, &lo2) && lo2 >= 0xDC00 && lo2 <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo2 - 0xDC00);
+            s += 6;
+          }
+          // lone high surrogate: falls through as cp in D800-DBFF -> strips,
+          // exactly like the surrogate char json.loads produces
+        }
+        if (cp < 0x80) {
+          unsigned char a = (unsigned char)cp;
+          if (a >= 'A' && a <= 'Z') a = a - 'A' + 'a';
+          if ((a >= 'a' && a <= 'z') || a == ' ') sink.put(char(a));
+        } else if (cp == 0x0130) sink.put('i');
+        else if (cp == 0x212A) sink.put('k');
+      }
+      // " \\ / b f n r t : none land in [a-z ] after cleaning -> emit nothing
+    } else if (c < 0x80) {
+      if (c >= 'A' && c <= 'Z') c = c - 'A' + 'a';
+      if ((c >= 'a' && c <= 'z') || c == ' ') sink.put(char(c));
+      ++s;
+    } else {
+      // already validated UTF-8: decode the codepoint permissively
+      uint32_t cp = 0;
+      int extra = 0;
+      if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; extra = 1; }
+      else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; extra = 2; }
+      else { cp = c & 0x07; extra = 3; }
+      ++s;
+      for (int i = 0; i < extra && s < e; ++i, ++s) cp = (cp << 6) | (*s & 0x3F);
+      if (cp == 0x0130) sink.put('i');
+      else if (cp == 0x212A) sink.put('k');
+      // cp < 0x80 impossible here (multi-byte lead); others strip
+    }
+  }
 }
 
-struct Featurizer {
-  int num_features;
-  bool binary;
-  bool remove_stopwords;
-  std::vector<std::string> stopword_storage;          // owns the bytes
-  std::unordered_set<std::string_view> stopwords;     // views into storage
-  // per-batch scratch (kept between begin/fill calls)
-  std::vector<std::vector<std::pair<int, float>>> rows;  // sorted by bucket id
-};
+// Parse one message. Returns 1 and fills span_start/span_len (raw string
+// literal INCLUDING quotes) + the tokenized row when the top level is a JSON
+// object whose last `key` entry is a string; 0 otherwise (any malformation —
+// the engine re-checks 0s with Python json.loads for exact-semantics routing).
+int parse_json_message(const Featurizer* f, const unsigned char* base, int len,
+                       std::string_view key, int32_t* span_start,
+                       int32_t* span_len, std::vector<int>& buckets,
+                       std::vector<std::pair<int, float>>& row) {
+  JsonScanner sc{base, base, base + len};
+  sc.ws();
+  if (sc.p >= sc.end || *sc.p != '{') return 0;
+  ++sc.p;
+  sc.ws();
+  bool found = false, found_str = false;
+  int fs = 0, fe = 0;  // raw contents offsets of the last matching value
+  if (sc.p < sc.end && *sc.p == '}') {
+    ++sc.p;
+  } else {
+    while (true) {
+      sc.ws();
+      int ks, ke;
+      if (!sc.scan_string(&ks, &ke)) return 0;
+      bool is_key = size_t(ke - ks) == key.size() &&
+                    std::memcmp(base + ks, key.data(), key.size()) == 0;
+      sc.ws();
+      if (sc.p >= sc.end || *sc.p != ':') return 0;
+      ++sc.p;
+      if (is_key) {
+        sc.ws();
+        if (sc.p < sc.end && *sc.p == '"') {
+          int vs, ve;
+          if (!sc.scan_string(&vs, &ve)) return 0;
+          found = true;
+          found_str = true;
+          fs = vs;
+          fe = ve;
+        } else {
+          if (!sc.value(1)) return 0;
+          found = true;
+          found_str = false;  // duplicate keys: LAST one wins (json.loads)
+        }
+      } else {
+        if (!sc.value(1)) return 0;
+      }
+      sc.ws();
+      if (sc.p < sc.end && *sc.p == ',') { ++sc.p; continue; }
+      if (sc.p < sc.end && *sc.p == '}') { ++sc.p; break; }
+      return 0;
+    }
+  }
+  sc.ws();
+  if (sc.p != sc.end) return 0;  // trailing garbage
+  if (!found || !found_str) return 0;
+  *span_start = fs - 1;        // include the opening quote
+  *span_len = (fe - fs) + 2;   // ... and the closing one
+  buckets.clear();
+  TokenSink sink(f, buckets);
+  decode_clean_json(base + fs, base + fe, sink);
+  sink.finish();
+  build_row(f, buckets, row);
+  return 1;
+}
+
+// Split [0, n) across worker threads; each shard returns its max row width
+// and the overall max is returned. Docs are independent, so the batch
+// parallelizes trivially (the caller holds the GIL-released ctypes call —
+// this is where the host-side throughput headroom lives, SURVEY.md §7 hard
+// part 3).
+template <typename Fn>
+int run_sharded(int n, Fn&& encode_range) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int n_threads = std::min<int>(hw ? hw : 1, 8);
+  // Thread spawn costs ~10s of microseconds each; only worth it for real batches.
+  if (n_threads <= 1 || n < 256) return encode_range(0, n);
+
+  std::atomic<int> width{0};
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  const int per = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const int lo = t * per;
+    const int hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    workers.emplace_back([&width, &encode_range, lo, hi] {
+      int w = encode_range(lo, hi);
+      int cur = width.load(std::memory_order_relaxed);
+      while (w > cur &&
+             !width.compare_exchange_weak(cur, w, std::memory_order_relaxed)) {
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  return width.load(std::memory_order_relaxed);
+}
+
+// Shared fill core: drain handle row state into padded (n_rows, L) output
+// arrays, truncating over-long rows by the parity-critical keep-top-L rule.
+template <typename IdT, typename CtT, typename IdCast, typename CtCast>
+void fill_rows(Featurizer* f, IdT* ids, CtT* counts, int n_rows, int L,
+               IdCast id_cast, CtCast ct_cast) {
+  std::memset(ids, 0, sizeof(IdT) * size_t(n_rows) * L);
+  std::memset(counts, 0, sizeof(CtT) * size_t(n_rows) * L);
+  const int n = std::min<int>(f->n_rows, n_rows);
+  std::vector<std::pair<int, float>> kept;
+  for (int d = 0; d < n; ++d) {
+    auto* row = &f->rows[d];
+    if (int(row->size()) > L) {
+      // keep the L highest counts; ties resolved toward the lower bucket id
+      // (numpy stable argsort(-val) over id-sorted input), then re-sort by id
+      kept.assign(row->begin(), row->end());
+      std::stable_sort(kept.begin(), kept.end(),
+                       [](const auto& a, const auto& b) { return a.second > b.second; });
+      kept.resize(L);
+      std::sort(kept.begin(), kept.end());
+      row = &kept;
+    }
+    IdT* idp = ids + size_t(d) * L;
+    CtT* ctp = counts + size_t(d) * L;
+    for (size_t j = 0; j < row->size(); ++j) {
+      idp[j] = id_cast((*row)[j].first);
+      ctp[j] = ct_cast((*row)[j].second);
+    }
+  }
+  f->n_rows = 0;  // rows keep their capacity for the next batch
+}
 
 }  // namespace
 
@@ -169,6 +625,7 @@ void* ftok_create(const char** stopwords, int n_stop, int num_features,
     f->stopword_storage.emplace_back(stopwords[i]);
     f->stopwords.insert(std::string_view(f->stopword_storage.back()));
   }
+  f->build_stop_table();
   return f;
 }
 
@@ -184,89 +641,73 @@ int ftok_hash_bucket(void* h, const char* term) {
 // throughput headroom lives — SURVEY.md §7 hard part 3).
 int ftok_encode_begin(void* h, const char** texts, int n_texts) {
   auto* f = static_cast<Featurizer*>(h);
-  f->rows.assign(n_texts, {});
+  // rows keep their per-doc capacity across batches: steady-state encodes do
+  // zero row allocations (assign() would free every vector each call).
+  if (int(f->rows.size()) < n_texts) f->rows.resize(n_texts);
+  f->n_rows = n_texts;
 
   auto encode_range = [f, texts](int lo, int hi) -> int {
-    std::string cleaned;
-    std::vector<std::string_view> toks;
     std::vector<int> buckets;
     int width = 0;
     for (int d = lo; d < hi; ++d) {
-      clean_utf8(texts[d], cleaned);
-      java_split(cleaned, toks);
-      buckets.clear();
-      for (const auto& t : toks) {
-        if (f->remove_stopwords && f->stopwords.count(t)) continue;
-        buckets.push_back(hash_bucket(t, f->num_features));
-      }
-      // sort + run-length count: yields the id-sorted unique rows directly,
-      // cheaper than a hash map at typical (~100-300 token) dialogue sizes
-      std::sort(buckets.begin(), buckets.end());
-      auto& row = f->rows[d];
-      row.clear();
-      for (size_t i = 0; i < buckets.size();) {
-        size_t j = i + 1;
-        while (j < buckets.size() && buckets[j] == buckets[i]) ++j;
-        row.emplace_back(buckets[i], f->binary ? 1.0f : float(j - i));
-        i = j;
-      }
-      width = std::max(width, int(row.size()));
+      encode_text_utf8(f, texts[d], buckets, f->rows[d]);
+      width = std::max(width, int(f->rows[d].size()));
     }
     return width;
   };
-
-  unsigned hw = std::thread::hardware_concurrency();
-  int n_threads = std::min<int>(hw ? hw : 1, 8);
-  // Thread spawn costs ~10s of microseconds each; only worth it for real batches.
-  if (n_threads <= 1 || n_texts < 256) return encode_range(0, n_texts);
-
-  std::atomic<int> width{0};
-  std::vector<std::thread> workers;
-  workers.reserve(n_threads);
-  const int per = (n_texts + n_threads - 1) / n_threads;
-  for (int t = 0; t < n_threads; ++t) {
-    const int lo = t * per;
-    const int hi = std::min(n_texts, lo + per);
-    if (lo >= hi) break;
-    workers.emplace_back([&width, &encode_range, lo, hi] {
-      int w = encode_range(lo, hi);
-      int cur = width.load(std::memory_order_relaxed);
-      while (w > cur &&
-             !width.compare_exchange_weak(cur, w, std::memory_order_relaxed)) {
-      }
-    });
-  }
-  for (auto& w : workers) w.join();
-  return width.load(std::memory_order_relaxed);
+  return run_sharded(n_texts, encode_range);
 }
 
-// Fill padded (rows, L) arrays from handle state; frees the state.
-void ftok_encode_fill(void* h, int32_t* ids, float* counts, int n_rows, int L) {
+// Raw-JSON batch encode: per message, parse the JSON object, pull the string
+// value of `key` (utf8, key_len bytes), clean+tokenize+hash it into the
+// handle's row state (same state ftok_encode_fill reads). Outputs per
+// message: status[i] (1 = encoded, 0 = malformed / key missing / non-string
+// — those rows are all-padding) and the raw string literal's span in
+// msgs[i] (INCLUDING both quotes) for zero-copy splicing into output JSON.
+// Returns the max unique-bucket width over successfully encoded rows.
+int ftok_encode_json_begin(void* h, const char** msgs, const int32_t* lens,
+                           int n_msgs, const char* key, int key_len,
+                           int32_t* status, int32_t* span_start,
+                           int32_t* span_len) {
   auto* f = static_cast<Featurizer*>(h);
-  std::memset(ids, 0, sizeof(int32_t) * size_t(n_rows) * L);
-  std::memset(counts, 0, sizeof(float) * size_t(n_rows) * L);
-  const int n = std::min<int>(f->rows.size(), n_rows);
-  std::vector<std::pair<int, float>> kept;
-  for (int d = 0; d < n; ++d) {
-    auto* row = &f->rows[d];
-    if (int(row->size()) > L) {
-      // keep the L highest counts; ties resolved toward the lower bucket id
-      // (numpy stable argsort(-val) over id-sorted input), then re-sort by id
-      kept.assign(row->begin(), row->end());
-      std::stable_sort(kept.begin(), kept.end(),
-                       [](const auto& a, const auto& b) { return a.second > b.second; });
-      kept.resize(L);
-      std::sort(kept.begin(), kept.end());
-      row = &kept;
+  if (int(f->rows.size()) < n_msgs) f->rows.resize(n_msgs);
+  f->n_rows = n_msgs;
+  std::string_view key_view(key, key_len);
+
+  auto encode_range = [&](int lo, int hi) -> int {
+    std::vector<int> buckets;
+    int width = 0;
+    for (int d = lo; d < hi; ++d) {
+      span_start[d] = 0;
+      span_len[d] = 0;
+      f->rows[d].clear();
+      status[d] = parse_json_message(
+          f, reinterpret_cast<const unsigned char*>(msgs[d]), lens[d], key_view,
+          span_start + d, span_len + d, buckets, f->rows[d]);
+      if (status[d]) width = std::max(width, int(f->rows[d].size()));
     }
-    int32_t* idp = ids + size_t(d) * L;
-    float* ctp = counts + size_t(d) * L;
-    for (size_t j = 0; j < row->size(); ++j) {
-      idp[j] = (*row)[j].first;
-      ctp[j] = (*row)[j].second;
-    }
-  }
-  f->rows.clear();
+    return width;
+  };
+  return run_sharded(n_msgs, encode_range);
+}
+
+// Fill padded (rows, L) arrays from handle state. The truncate-to-L rule is
+// parity-critical (keep the L highest counts; ties toward the lower bucket
+// id — numpy stable argsort(-val) over id-sorted input — then re-sort by id)
+// and shared by both output-dtype variants below.
+void ftok_encode_fill(void* h, int32_t* ids, float* counts, int n_rows, int L) {
+  fill_rows(static_cast<Featurizer*>(h), ids, counts, n_rows, L,
+            [](int b) { return int32_t(b); },
+            [](float v) { return v; });
+}
+
+// Same fill but emitting the device wire dtypes directly — int16 ids
+// (callers gate on num_features <= 32767) and uint16 counts (clipped) —
+// skipping the Python-side astype+copy of two (B, L) arrays.
+void ftok_encode_fill16(void* h, int16_t* ids, uint16_t* counts, int n_rows, int L) {
+  fill_rows(static_cast<Featurizer*>(h), ids, counts, n_rows, L,
+            [](int b) { return int16_t(b); },
+            [](float v) { return uint16_t(v > 65535.0f ? 65535u : uint32_t(v)); });
 }
 
 }  // extern "C"

@@ -33,6 +33,10 @@ from fraud_detection_tpu.stream.broker import Consumer, Message, Producer
 # precision as the dict path's round(confidence, 6)).
 _OUT_TEMPLATE = '{"prediction": %d, "label": %s, "confidence": %.6f, "original_text": %s}'
 _LABEL_JSON = {0: json.dumps(label_name(0)), 1: json.dumps(label_name(1))}
+# Raw-JSON mode emits bytes directly, splicing the input's own string literal
+# (no decode/re-encode round trip — the literal is already valid JSON).
+_OUT_TEMPLATE_B = _OUT_TEMPLATE.encode()
+_LABEL_JSON_B = {k: v.encode() for k, v in _LABEL_JSON.items()}
 
 
 @dataclass
@@ -135,6 +139,10 @@ class StreamingClassifier:
         self.stats = StreamStats()
         self._running = False
         self._flush_failed = False
+        # Raw-JSON fast path: None = untried, False = unavailable (no native
+        # library / tree model / vocab featurizer), True = in use. The explain
+        # hook needs decoded text, so it forces the slow path.
+        self._json_fast: Optional[bool] = None if explain_fn is None else False
 
     def stop(self) -> None:
         self._running = False
@@ -151,16 +159,48 @@ class StreamingClassifier:
         """Decode + featurize + launch device scoring; does NOT block on the
         device. Returns the in-flight batch handle for ``_finish``."""
         t0 = time.perf_counter()
-        texts: List[Optional[str]] = [self._decode(m) for m in msgs]
-        valid_idx = [i for i, t in enumerate(texts) if t is not None]
-        pending = (self.pipeline.predict_async([texts[i] for i in valid_idx])
-                   if valid_idx else None)
         offsets: dict = {}
         for m in msgs:
             key = (m.topic, m.partition)
             offsets[key] = max(offsets.get(key, 0), m.offset + 1)
+
+        if self._json_fast is not False:
+            inflight = self._dispatch_raw_json(msgs, offsets, t0)
+            if inflight is not None:
+                return inflight
+
+        texts: List[Optional[str]] = [self._decode(m) for m in msgs]
+        valid_idx = [i for i, t in enumerate(texts) if t is not None]
+        pending = (self.pipeline.predict_async([texts[i] for i in valid_idx])
+                   if valid_idx else None)
         return _InFlight(msgs, texts, valid_idx, pending, offsets,
                          time.perf_counter() - t0)
+
+    def _dispatch_raw_json(self, msgs: List[Message], offsets: dict,
+                           t0: float) -> Optional["_InFlight"]:
+        """Try the raw-JSON path: one native pass from message bytes to hashed
+        rows, no Python json.loads. Returns None to use the slow path — either
+        permanently (pipeline can't do it) or for this batch only (the native
+        scanner rejected a message that Python's json.loads accepts, e.g. an
+        escaped key; per-message behavior must match the slow path exactly)."""
+        fast = self.pipeline.predict_json_async(
+            [m.value for m in msgs], self.text_field)
+        if fast is None:
+            self._json_fast = False
+            return None
+        self._json_fast = True
+        pending, status, span_start, span_len = fast
+        literals: List[Optional[bytes]] = [None] * len(msgs)
+        valid_idx: List[int] = []
+        for i, ok in enumerate(status):
+            if ok:
+                valid_idx.append(i)
+                s = span_start[i]
+                literals[i] = msgs[i].value[s : s + span_len[i]]
+            elif self._decode(msgs[i]) is not None:
+                return None  # stricter-than-json.loads rejection: slow path
+        return _InFlight(msgs, literals, valid_idx, pending, offsets,
+                         time.perf_counter() - t0, raw=True)
 
     def _finish(self, inflight: "_InFlight") -> int:
         """Block on device results for an in-flight batch, produce outputs,
@@ -170,9 +210,15 @@ class StreamingClassifier:
         preds = inflight.pending.resolve() if inflight.pending is not None else None
 
         results: List[Optional[tuple]] = [None] * len(msgs)
-        for j, i in enumerate(inflight.valid_idx):
-            results[i] = (int(preds.labels[j]), float(preds.probabilities[j]))
+        if inflight.raw:
+            # Raw-JSON mode: predictions cover all rows positionally.
+            for i in inflight.valid_idx:
+                results[i] = (int(preds.labels[i]), float(preds.probabilities[i]))
+        else:
+            for j, i in enumerate(inflight.valid_idx):
+                results[i] = (int(preds.labels[j]), float(preds.probabilities[j]))
 
+        wires: List[tuple] = []
         for msg, text, res in zip(msgs, texts, results):
             if res is None:
                 self.stats.malformed += 1
@@ -184,11 +230,17 @@ class StreamingClassifier:
                 confidence = p1 if label == 1 else 1.0 - p1
                 # Same field semantics as FraudAnalysisAgent.predict_and_get_label:
                 # prediction = int class, label = display name.
-                if self.explain_fn is None:
+                if inflight.raw:
+                    # Zero-copy text: splice the input's own (already-valid)
+                    # string literal into the fixed byte frame.
+                    # .get fallback: multiclass tree pipelines emit labels >= 2.
+                    label_json = (_LABEL_JSON_B.get(label)
+                                  or json.dumps(label_name(label)).encode())
+                    wire = _OUT_TEMPLATE_B % (label, label_json, confidence, text)
+                elif self.explain_fn is None:
                     # Fast path: only the text needs JSON escaping; the frame
                     # is a fixed template (json.dumps of the full dict costs
                     # ~2.5x more and this runs per message at 30k+/sec).
-                    # .get fallback: multiclass tree pipelines emit labels >= 2.
                     label_json = (_LABEL_JSON.get(label)
                                   or json.dumps(label_name(label)))
                     wire = (_OUT_TEMPLATE % (label, label_json,
@@ -204,7 +256,14 @@ class StreamingClassifier:
                     if analysis is not None:
                         out["analysis"] = analysis
                     wire = json.dumps(out).encode()
-            self.producer.produce(self.output_topic, wire, key=msg.key)
+            wires.append((wire, msg.key))
+
+        produce_batch = getattr(self.producer, "produce_batch", None)
+        if produce_batch is not None:
+            produce_batch(self.output_topic, wires)
+        else:
+            for wire, key in wires:
+                self.producer.produce(self.output_topic, wire, key=key)
 
         # Produce-then-commit: at-least-once with durable progress (fixes Q2).
         # Commit ONLY if the producer fully drained — committing past
@@ -307,11 +366,13 @@ class StreamingClassifier:
 class _InFlight:
     """A micro-batch whose device scoring has been dispatched but not resolved."""
     msgs: List[Message]
-    texts: List[Optional[str]]
+    texts: List[Optional[str]]  # decoded strs; raw mode: raw literal bytes
     valid_idx: List[int]
     pending: Optional[object]   # models.pipeline.PendingPrediction
     offsets: dict               # (topic, partition) -> next offset to commit
     dispatch_time: float        # host seconds spent in _dispatch
+    raw: bool = False           # raw-JSON mode: pending covers ALL rows
+                                # positionally; texts[i] is the string literal
 
 
 def run_supervised(make_engine: Callable[[], StreamingClassifier], *,

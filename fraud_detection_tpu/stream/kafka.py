@@ -110,6 +110,25 @@ class KafkaProducer:
         self._producer.produce(topic, value=value, key=key,
                                on_delivery=self._on_delivery)
 
+    def produce_batch(self, topic: str, items) -> None:
+        """Produce (value, key) pairs. librdkafka's produce() only enqueues
+        (batching happens in its background thread); a local-full queue needs
+        draining — poll() services delivery callbacks to free space, looping
+        until the enqueue succeeds (the recommended produce loop: one retry
+        is not enough when every queued message is still in flight)."""
+        produce = self._producer.produce
+        for value, key in items:
+            while True:
+                try:
+                    produce(topic, value=value, key=key,
+                            on_delivery=self._on_delivery)
+                    break
+                except BufferError:
+                    # Blocks up to 100ms per attempt; progress is guaranteed
+                    # because queued messages either deliver or terminally
+                    # fail (message.timeout.ms), both of which free space.
+                    self._producer.poll(0.1)
+
     def flush(self, timeout: float = 10.0) -> int:
         """Returns the number of messages NOT durably delivered: still queued
         plus terminally failed. Terminal failures (e.g. message too large)

@@ -6,6 +6,8 @@ artifact parity) — any divergence silently shifts F1, SURVEY.md §7 hard
 part 1. Tests compare the two paths on adversarial inputs.
 """
 
+import json
+
 import numpy as np
 import pytest
 
@@ -135,3 +137,130 @@ def test_threaded_batch_parity():
     want = twin.encode(docs, batch_size=1024)
     np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
     np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+
+
+# ---------------------------------------------------------------------------
+# Raw-JSON fast path (encode_json): the native scanner must match CPython
+# json.loads acceptance semantics, its encoded rows must equal encode() on
+# the decoded text, and its spans must reconstruct the exact string.
+# ---------------------------------------------------------------------------
+
+JSON_CASES = [
+    b'{"text": "Hello WORLD this is a PRIZE call", "id": 3}',
+    b'{"text": "with \\"escapes\\" and \\n newlines \\u0041\\u0042 \\u0130 \\u212A tab\\there"}',
+    b'{"id": 1}',                                # key missing
+    b'{"text": 42}',                             # non-string value
+    b'{"text": null}',
+    b'{"text": "a", "text": "second wins"}',     # duplicate key: LAST wins
+    b'{"text": "a", "text": 42}',                # last duplicate not a string
+    b'not json at all',
+    b'{"text": "trailing"} garbage',
+    b'{"text": "caf\xc3\xa9 r\xc3\xa9sum\xc3\xa9 na\xc3\xafve"}',  # raw utf-8
+    b'{"text": "bad utf8 \xff\xfe"}',            # invalid utf-8 -> reject
+    b'{"text": "overlong \xc0\xaf"}',            # overlong encoding -> reject
+    b'{"text": "surrogate pair \\ud83d\\ude00 lone \\ud800 end"}',
+    b'{"nested": {"text": "inner"}, "text": "outer"}',  # only top level counts
+    b'{"arr": [1, 2.5e3, -0.5, null, true, false, NaN, Infinity, -Infinity], "text": "after exotics"}',
+    b'["text", "in array"]',                     # top level not an object
+    b'"just a string"',
+    b'{"text": "ctrl \x01 char"}',               # raw control char -> reject
+    b'{}',
+    b'  {"text" : "spaced"}  ',
+    b'{"text": ""}',                             # empty text is a real token
+    b'{"text": "   "}',
+    b'{"n": 01, "text": "bad number"}',          # leading zero -> reject
+    b'{"n": 1., "text": "bad frac"}',            # bare dot -> reject
+    b'{"n": 1e, "text": "bad exp"}',             # bare exponent -> reject
+    b'{"deep": {"a": {"b": [{"c": "d"}]}}, "text": "nested ok"}',
+    b'{"text": "quote at end\\""}',
+    b'{"text": "backslash at end\\\\"}',
+    b'',                                         # empty message
+    b'{"text":"no spaces","k":"v"}',
+]
+
+
+def _py_reference(value: bytes):
+    """What the engine's Python slow path would extract: the decoded text, or
+    None when the message is malformed (bad JSON / non-dict / non-str field)."""
+    try:
+        payload = json.loads(value)
+    except ValueError:
+        return None
+    text = payload.get("text") if isinstance(payload, dict) else None
+    return text if isinstance(text, str) else None
+
+
+def test_json_path_matches_python_loads_semantics():
+    import json as _json
+
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    out = feat.encode_json(JSON_CASES, "text", batch_size=len(JSON_CASES))
+    assert out is not None
+    batch, status, span_start, span_len = out
+    for i, raw in enumerate(JSON_CASES):
+        want = _py_reference(raw)
+        if status[i]:
+            # Native accepted: Python must agree AND the row/span must match.
+            assert want is not None, raw
+            ref = feat.encode([want], batch_size=1,
+                              max_tokens=batch.ids.shape[1])
+            np.testing.assert_array_equal(np.asarray(batch.ids[i]),
+                                          np.asarray(ref.ids[0]), err_msg=repr(raw))
+            np.testing.assert_array_equal(np.asarray(batch.counts[i]),
+                                          np.asarray(ref.counts[0]), err_msg=repr(raw))
+            literal = raw[span_start[i] : span_start[i] + span_len[i]]
+            decoded = _json.loads(literal.decode("utf-8", "surrogatepass"))
+            assert decoded == want, raw
+        else:
+            # Native rejected: padding row. Python MAY still accept (the
+            # scanner is deliberately stricter, never more permissive) —
+            # the engine falls back to the slow path for those batches.
+            assert not np.asarray(batch.counts[i]).any(), raw
+
+
+def test_json_path_stricter_cases_fall_to_python():
+    """Inputs where the scanner is stricter than json.loads: it must reject
+    (status 0), never mis-encode — the engine re-checks rejections."""
+    feat = HashingTfIdfFeaturizer(num_features=4096)
+    stricter = [
+        b'{"te\\u0078t": "escaped key"}',     # json.loads sees key "text"
+        b"[" * 600 + b"]" * 600,              # beyond the native depth cap
+    ]
+    out = feat.encode_json(stricter, "text", batch_size=len(stricter))
+    assert out is not None
+    _, status, _, _ = out
+    assert not status.any()
+
+
+def test_json_path_threaded_batch_parity():
+    """>=256 messages take the multithreaded branch; rows must match the
+    per-message Python reference across shard boundaries."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    docs = [d.text for d in generate_corpus(n=300, seed=9)]
+    values = [json.dumps({"text": t, "id": i}).encode()
+              for i, t in enumerate(docs)]
+    values[50] = b'broken'
+    values[173] = b'{"text": 9}'
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    out = feat.encode_json(values, "text", batch_size=512)
+    assert out is not None
+    batch, status, _, _ = out
+    assert status.sum() == len(values) - 2
+    ok_idx = [i for i in range(len(values)) if status[i]]
+    ref = feat.encode([docs[i] for i in ok_idx], batch_size=512,
+                      max_tokens=batch.ids.shape[1])
+    for j, i in enumerate(ok_idx):
+        np.testing.assert_array_equal(np.asarray(batch.ids[i]), np.asarray(ref.ids[j]))
+        np.testing.assert_array_equal(np.asarray(batch.counts[i]), np.asarray(ref.counts[j]))
+
+
+def test_json_path_embedded_nul_rejected():
+    """Explicit lengths mean embedded NULs are SEEN (not truncated at the C
+    string) and rejected as raw control chars — same as json.loads."""
+    feat = HashingTfIdfFeaturizer(num_features=4096)
+    out = feat.encode_json([b'{"text": "nul \x00 here"}'], "text", batch_size=1)
+    assert out is not None
+    _, status, _, _ = out
+    assert status[0] == 0
+    assert _py_reference(b'{"text": "nul \x00 here"}') is None

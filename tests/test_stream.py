@@ -260,3 +260,98 @@ def test_run_supervised_closes_clients(pipeline):
 
     run_supervised(make_engine, max_messages=8, idle_timeout=0.2, sleep=lambda s: None)
     assert consumers and all(c._closed for c in consumers)
+
+
+def _run_engine(pipeline, values, keys=None, force_slow=False, **kw):
+    """Feed raw message bytes through a fresh engine; return (stats, outputs)."""
+    broker = InProcessBroker(num_partitions=3)
+    producer = broker.producer()
+    for i, v in enumerate(values):
+        key = keys[i] if keys else str(i).encode()
+        producer.produce("in", v, key=key)
+    consumer = broker.consumer(["in"], "grp")
+    engine = StreamingClassifier(pipeline, consumer, broker.producer(), "out",
+                                 batch_size=32, max_wait=0.01, **kw)
+    if force_slow:
+        engine._json_fast = False  # pin the json.loads path for comparison
+    stats = engine.run(max_messages=len(values), idle_timeout=0.3)
+    outs = {m.key: json.loads(m.value) for m in broker.messages("out")}
+    return engine, stats, outs
+
+
+def test_raw_json_fast_path_matches_slow_path(pipeline):
+    """The native raw-JSON path and the Python json.loads path must emit
+    semantically identical output messages (parsed equality — byte equality
+    is not required: raw mode splices the input's own string literal)."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    corpus = generate_corpus(n=60, seed=21)
+    values = [json.dumps({"text": d.text, "id": i}).encode()
+              for i, d in enumerate(corpus)]
+    values[7] = b'not json'
+    values[23] = b'{"text": 42}'
+    values[41] = '{"text": "unicode café ☃ ok"}'.encode()
+
+    fast_engine, fast_stats, fast = _run_engine(pipeline, values)
+    if fast_engine._json_fast is not True:
+        pytest.skip("native JSON path unavailable in this environment")
+
+    slow_engine, slow_stats, slow = _run_engine(pipeline, values, force_slow=True)
+    assert slow_engine._json_fast is False
+
+    assert fast_stats.processed == slow_stats.processed == 60
+    assert fast_stats.malformed == slow_stats.malformed == 2
+    assert fast.keys() == slow.keys()
+    for k in fast:
+        f, s = fast[k], slow[k]
+        assert f.get("prediction") == s.get("prediction"), k
+        assert f.get("original_text") == s.get("original_text"), k
+        if f.get("prediction") is not None:
+            assert abs(f["confidence"] - s["confidence"]) < 1e-6, k
+
+
+def test_raw_json_fast_path_strict_rejection_falls_back(pipeline):
+    """A message the native scanner rejects but json.loads accepts (escaped
+    key) must still be scored — the engine falls back to the slow path for
+    that batch instead of mis-routing it as malformed."""
+    values = [
+        json.dumps({"text": "hello there agent calling about your account"}).encode(),
+        b'{"te\\u0078t": "prize claim urgent gift card payment now"}',
+    ]
+    engine, stats, outs = _run_engine(pipeline, values)
+    assert stats.processed == 2
+    assert stats.malformed == 0
+    assert all(o["prediction"] in (0, 1) for o in outs.values())
+
+
+def test_raw_json_output_preserves_exotic_text(pipeline):
+    """Raw-literal splicing must round-trip escapes and unicode exactly."""
+    exotic = 'tab\there "quoted" back\\slash café \U0001f600 end'
+    values = [json.dumps({"text": exotic}).encode()]
+    _, stats, outs = _run_engine(pipeline, values)
+    assert stats.processed == 1
+    (out,) = outs.values()
+    assert out["original_text"] == exotic
+
+
+def test_produce_batch_and_poll_batch_equivalent():
+    """Broker batch ops must preserve per-partition FIFO + offset semantics."""
+    broker = InProcessBroker(num_partitions=3)
+    p = broker.producer()
+    p.produce_batch("t", [(f"v{i}".encode(), f"k{i % 5}".encode())
+                          for i in range(40)])
+    assert broker.topic_size("t") == 40
+    c = broker.consumer(["t"], "g")
+    got = c.poll_batch(100, 0.1)
+    assert len(got) == 40
+    # per-partition offsets are contiguous from 0
+    seen = {}
+    for m in got:
+        seen.setdefault(m.partition, []).append(m.offset)
+    for offs in seen.values():
+        assert offs == list(range(len(offs)))
+    # same key -> same partition
+    by_key = {}
+    for m in got:
+        by_key.setdefault(m.key, set()).add(m.partition)
+    assert all(len(parts) == 1 for parts in by_key.values())
