@@ -172,20 +172,25 @@ class ServingPipeline:
             if out is None:
                 return None
             enc, status, span_start, span_len = out
-            p = linear_mod.prob_encoded(self._fused_model, enc)
-            copy_async = getattr(p, "copy_to_host_async", None)
-            if copy_async is not None:
-                copy_async()
-            parts.append((p, len(chunk)))
+            parts.append((self._dispatch_fused(enc), len(chunk)))
             stats.append((status, span_start, span_len))
+        pending = PendingPrediction(parts, threshold=self._fused_model.threshold)
         if not stats:
             empty = np.empty(0, np.int32)
-            return PendingPrediction([], threshold=0.5), empty, empty, empty
-        status = np.concatenate([s[0] for s in stats])
-        span_start = np.concatenate([s[1] for s in stats])
-        span_len = np.concatenate([s[2] for s in stats])
-        return (PendingPrediction(parts, threshold=self._fused_model.threshold),
-                status, span_start, span_len)
+            return pending, empty, empty, empty
+        return (pending,
+                np.concatenate([s[0] for s in stats]),
+                np.concatenate([s[1] for s in stats]),
+                np.concatenate([s[2] for s in stats]))
+
+    def _dispatch_fused(self, enc) -> object:
+        """Launch fused sparse LR scoring for one encoded chunk and start the
+        async device->host fetch; shared by both predict paths."""
+        p = linear_mod.prob_encoded(self._fused_model, enc)
+        copy_async = getattr(p, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()  # start the device->host fetch behind the dispatch
+        return p
 
     def predict_async(self, texts: Sequence[str]) -> "PendingPrediction":
         """Featurize + dispatch device scoring WITHOUT blocking on results.
@@ -210,13 +215,13 @@ class ServingPipeline:
             n = len(chunk)
             if self._fused_model is not None:
                 enc = self.featurizer.encode(chunk, batch_size=self.batch_size)
-                p = linear_mod.prob_encoded(self._fused_model, enc)
+                parts.append((self._dispatch_fused(enc), n))
                 threshold = self._fused_model.threshold
-            else:
-                dense = self.featurizer.featurize_dense(chunk, batch_size=self.batch_size)
-                proba = trees_mod.predict_proba(self.model, jnp.asarray(dense))
-                p = proba[:, 1] if tree_binary else proba
-                argmax = not tree_binary
+                continue
+            dense = self.featurizer.featurize_dense(chunk, batch_size=self.batch_size)
+            proba = trees_mod.predict_proba(self.model, jnp.asarray(dense))
+            p = proba[:, 1] if tree_binary else proba
+            argmax = not tree_binary
             copy_async = getattr(p, "copy_to_host_async", None)
             if copy_async is not None:
                 copy_async()  # start the device->host fetch behind the dispatch

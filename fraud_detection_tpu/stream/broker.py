@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Protocol, Sequence
 
 
-@dataclass
+@dataclass(slots=True)
 class Message:
     topic: str
     value: bytes

@@ -118,16 +118,23 @@ class KafkaProducer:
         is not enough when every queued message is still in flight)."""
         produce = self._producer.produce
         for value, key in items:
-            while True:
+            # Bounded retry (~10s like the flush default): each poll services
+            # delivery callbacks to free queue space. If the queue stays full
+            # that long the broker is down — re-raise so the engine's
+            # fail-fast path runs (offsets stay uncommitted, supervisor
+            # backoff/restart re-drives the batch). An unbounded loop here
+            # would stall _finish for message.timeout.ms (default 300s) with
+            # stop() unable to interrupt it.
+            for _ in range(100):
                 try:
                     produce(topic, value=value, key=key,
                             on_delivery=self._on_delivery)
                     break
                 except BufferError:
-                    # Blocks up to 100ms per attempt; progress is guaranteed
-                    # because queued messages either deliver or terminally
-                    # fail (message.timeout.ms), both of which free space.
                     self._producer.poll(0.1)
+            else:
+                raise BufferError(
+                    f"librdkafka queue full for 10s producing to {topic!r}")
 
     def flush(self, timeout: float = 10.0) -> int:
         """Returns the number of messages NOT durably delivered: still queued
