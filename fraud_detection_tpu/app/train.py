@@ -69,6 +69,13 @@ def load_corpus(args) -> List[Tuple[str, int]]:
     return [(r.dialogue, r.label) for r in rows]
 
 
+def _ckpt_subdir(args, model_name: str):
+    """Per-model snapshot directory under --checkpoint-dir (None when off)."""
+    if args.checkpoint_dir is None:
+        return None
+    return os.path.join(args.checkpoint_dir, model_name)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--data", default="synthetic",
@@ -101,6 +108,14 @@ def main(argv=None) -> int:
                     help="word-association analysis over the top N features "
                          "per model (side-vocabulary inversion of hashed "
                          "features — SURVEY.md Q11)")
+    ap.add_argument("--checkpoint-dir", metavar="DIR", default=None,
+                    help="mid-training snapshot directory for the iterative "
+                         "trainers (rf/xgb): snapshots land in DIR/<model>, "
+                         "and an interrupted run resumes bit-identically "
+                         "(the reference has no training resume, SURVEY §5)")
+    ap.add_argument("--checkpoint-every", type=int, metavar="K", default=10,
+                    help="snapshot cadence: boosting rounds / forest trees "
+                         "(default 10)")
     args = ap.parse_args(argv)
 
     import jax.numpy as jnp
@@ -159,11 +174,15 @@ def main(argv=None) -> int:
             trained[name] = fit_decision_tree(Xtr, ytr, config=cfg, mesh=mesh)
         elif name == "rf":
             trained[name] = fit_random_forest(
-                Xtr, ytr, n_trees=args.n_trees, seed=args.seed, config=cfg, mesh=mesh)
+                Xtr, ytr, n_trees=args.n_trees, seed=args.seed, config=cfg, mesh=mesh,
+                checkpoint_dir=_ckpt_subdir(args, name),
+                checkpoint_every=args.checkpoint_every)
         elif name == "xgb":
             trained[name] = fit_gradient_boosting(
                 Xtr, ytr, n_rounds=args.n_rounds, mesh=mesh,
-                config=TreeTrainConfig(max_depth=args.max_depth, criterion="xgb"))
+                config=TreeTrainConfig(max_depth=args.max_depth, criterion="xgb"),
+                checkpoint_dir=_ckpt_subdir(args, name),
+                checkpoint_every=args.checkpoint_every)
         elif name == "lr":
             trained[name] = fit_logistic_regression(
                 Xtr, ytr.astype(np.float32), mesh=mesh)

@@ -3,5 +3,10 @@ from fraud_detection_tpu.checkpoint.spark_artifact import (
     load_spark_pipeline,
 )
 from fraud_detection_tpu.checkpoint.spark_writer import save_spark_pipeline
+from fraud_detection_tpu.checkpoint.train_state import (
+    load_train_state,
+    save_train_state,
+)
 
-__all__ = ["SparkPipelineArtifact", "load_spark_pipeline", "save_spark_pipeline"]
+__all__ = ["SparkPipelineArtifact", "load_spark_pipeline", "save_spark_pipeline",
+           "load_train_state", "save_train_state"]

@@ -294,6 +294,7 @@ def fit_random_forest(
     X, y, *, n_trees: int = 100, num_classes: int = 2, seed: int = 42,
     config: Optional[TreeTrainConfig] = None, tree_chunk: int = 4,
     feature_subset: bool = True, edges: Optional[np.ndarray] = None, mesh=None,
+    checkpoint_dir: Optional[str] = None, checkpoint_every: int = 10,
 ) -> TreeEnsemble:
     """Random forest: Poisson(1) bootstrap + per-node feature subsets.
 
@@ -302,6 +303,12 @@ def fit_random_forest(
     resampling; the feature subset is Bernoulli with expected size sqrt(F)
     rather than an exact sqrt(F)-subset (vectorization-friendly deviation,
     same expectation).
+
+    ``checkpoint_dir`` snapshots every ``checkpoint_every`` trees (and at
+    completion) and resumes by skipping completed chunks
+    (checkpoint/train_state.py). Per-chunk PRNG keys are
+    ``fold_in(root, start)`` — a pure function of (seed, start) — so resumed
+    forests are bit-identical to uninterrupted ones.
     """
     cfg = config or TreeTrainConfig()
     edges, bins, _, stats, base_weights, n = _prepare_inputs(
@@ -311,8 +318,30 @@ def fit_random_forest(
     root = jax.random.PRNGKey(seed)
     build = jax.vmap(_build_tree_jit, in_axes=(None, None, 0, 0, None, None))
 
+    fingerprint = None
+    if checkpoint_dir is not None:
+        from fraud_detection_tpu.checkpoint import train_state as ts
+
+        fingerprint = ts.data_fingerprint(
+            cfg.__dict__, edges, n,
+            extra={"seed": seed, "tree_chunk": tree_chunk,
+                   "feature_subset": feature_subset, "num_classes": num_classes})
+
     feats, sbins, lefts, rights, all_stats = [], [], [], [], []
-    for start in range(0, n_trees, tree_chunk):
+    trees_done = 0
+    if checkpoint_dir is not None:
+        snap = ts.load_for(checkpoint_dir, "random_forest", fingerprint)
+        if snap is not None:
+            progress, arrays = snap
+            trees_done = min(progress, n_trees)
+            feats.append(arrays["feature"][:trees_done])
+            sbins.append(arrays["split_bin"][:trees_done])
+            lefts.append(arrays["left"][:trees_done])
+            rights.append(arrays["right"][:trees_done])
+            all_stats.append(arrays["node_stats"][:trees_done])
+
+    last_saved = trees_done
+    for start in range(trees_done, n_trees, tree_chunk):
         chunk = min(tree_chunk, n_trees - start)
         key = jax.random.fold_in(root, start)
         wkey, mkey = jax.random.split(key)
@@ -325,6 +354,18 @@ def fit_random_forest(
         feats.append(np.asarray(f_)); sbins.append(np.asarray(b_))
         lefts.append(np.asarray(l_)); rights.append(np.asarray(r_))
         all_stats.append(np.asarray(s_))
+        done = start + chunk
+        # Snapshot on the cadence (each save rewrites the full accumulated
+        # state, so per-chunk saves would cost O(n_trees^2) bytes) and at
+        # completion (the seed for extending the forest later).
+        if checkpoint_dir is not None and (
+                done - last_saved >= checkpoint_every or done == n_trees):
+            ts.save_train_state(
+                checkpoint_dir, "random_forest", done, fingerprint,
+                {"feature": np.concatenate(feats), "split_bin": np.concatenate(sbins),
+                 "left": np.concatenate(lefts), "right": np.concatenate(rights),
+                 "node_stats": np.concatenate(all_stats)})
+            last_saved = done
     cat = lambda xs: list(np.concatenate(xs, axis=0))
     return _assemble(cat(feats), cat(sbins), cat(lefts), cat(rights), cat(all_stats),
                      edges, np.ones(n_trees), "random_forest", cfg)
@@ -333,7 +374,7 @@ def fit_random_forest(
 def fit_gradient_boosting(
     X, y, *, n_rounds: int = 100, config: Optional[TreeTrainConfig] = None,
     edges: Optional[np.ndarray] = None, base_score: Optional[float] = None,
-    mesh=None,
+    mesh=None, checkpoint_dir: Optional[str] = None, checkpoint_every: int = 10,
 ) -> TreeEnsemble:
     """XGBoost-style second-order boosting (binary logloss).
 
@@ -343,6 +384,13 @@ def fit_gradient_boosting(
     on (grad, hess) histograms — the distributed histogram reduction is the
     psum the engine inserts when rows are sharded, standing in for Rabit
     allreduce.
+
+    ``checkpoint_dir`` enables mid-training snapshots every
+    ``checkpoint_every`` rounds (checkpoint/train_state.py — the reference
+    has no training resume, SURVEY.md §5). Resume is bit-identical: the
+    margin is replayed from the saved trees in round order, so the ensemble
+    equals an uninterrupted run's. A snapshot taken under a different
+    config/data refuses to load.
     """
     cfg = config or TreeTrainConfig(criterion="xgb")
     if cfg.criterion != "xgb":
@@ -359,6 +407,13 @@ def fit_gradient_boosting(
     margin = jnp.full((n_padded,), base_score, jnp.float32)
     feats, sbins, lefts, rights, leaf_vals = [], [], [], [], []
 
+    fingerprint = None
+    if checkpoint_dir is not None:
+        from fraud_detection_tpu.checkpoint import train_state as ts
+
+        fingerprint = ts.data_fingerprint(
+            cfg.__dict__, edges, n, extra={"base_score": base_score})
+
     @jax.jit
     def grad_hess(margin):
         p = jax.nn.sigmoid(margin)
@@ -373,7 +428,37 @@ def fit_gradient_boosting(
     def update_margin(margin, row_node, values):
         return margin + values[row_node]
 
-    for _ in range(n_rounds):
+    start_round = 0
+    if checkpoint_dir is not None:
+        snap = ts.load_for(checkpoint_dir, "gradient_boosting", fingerprint)
+        if snap is not None:
+            progress, arrays = snap
+            # Clamp: a snapshot from a longer run must not overfill a shorter
+            # one (tree count would exceed n_rounds and its tree_weights).
+            progress = min(progress, n_rounds)
+            for r in range(progress):
+                f_ = arrays["feature"][r]; b_ = arrays["split_bin"][r]
+                l_ = arrays["left"][r]; r__ = arrays["right"][r]
+                v_ = arrays["leaf_values"][r]
+                feats.append(f_); sbins.append(b_)
+                lefts.append(l_); rights.append(r__)
+                leaf_vals.append(v_[:, None])
+                # Replay the margin in round order — same float additions as
+                # the original incremental updates, so resume is bit-exact.
+                row_leaf = _row_leaves(bins, jnp.asarray(f_), jnp.asarray(b_),
+                                       jnp.asarray(l_), jnp.asarray(r__),
+                                       cfg.max_depth)
+                margin = update_margin(margin, row_leaf, jnp.asarray(v_))
+            start_round = progress
+
+    def snapshot(rounds_done: int) -> None:
+        ts.save_train_state(
+            checkpoint_dir, "gradient_boosting", rounds_done, fingerprint,
+            {"feature": np.stack(feats), "split_bin": np.stack(sbins),
+             "left": np.stack(lefts), "right": np.stack(rights),
+             "leaf_values": np.stack([v[:, 0] for v in leaf_vals])})
+
+    for r in range(start_round, n_rounds):
         g, h = grad_hess(margin)
         stats = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
         f_, b_, l_, r_, s_ = _build_tree_jit(bins, stats, weights, dummy_keys, cfg, False)
@@ -383,6 +468,11 @@ def fit_gradient_boosting(
         feats.append(np.asarray(f_)); sbins.append(np.asarray(b_))
         lefts.append(np.asarray(l_)); rights.append(np.asarray(r_))
         leaf_vals.append(np.asarray(values)[:, None])
+        # Snapshot on the cadence AND at completion (a finished run's snapshot
+        # is the seed for extending training to more rounds later).
+        if checkpoint_dir is not None and (
+                (r + 1) % checkpoint_every == 0 or r + 1 == n_rounds):
+            snapshot(r + 1)
 
     return _assemble(feats, sbins, lefts, rights, leaf_vals,
                      edges, np.ones(n_rounds), "xgboost", cfg, bias=base_score)
