@@ -38,10 +38,14 @@ FORMAT_VERSION = 1
 
 
 def data_fingerprint(cfg_fields: Dict, edges: np.ndarray, n_rows: int,
+                     y: Optional[np.ndarray] = None,
                      extra: Optional[Dict] = None) -> Dict:
     """Deterministic identity of a training setup: trainer config, data shape,
-    and a checksum of the quantile bin edges (which are a function of X —
-    matching edges on matching shapes is strong evidence of the same data)."""
+    a checksum of the quantile bin edges (a function of X — matching edges on
+    matching shapes is strong evidence of the same features), and a checksum
+    of the labels (same X under relabeled y must refuse to resume: blending
+    trees fit on different targets is the silent frankenmodel this exists to
+    prevent)."""
     h = hashlib.sha256(np.ascontiguousarray(edges, np.float32).tobytes())
     fp = {
         "config": {k: (v if not isinstance(v, (np.floating, np.integer)) else v.item())
@@ -50,6 +54,9 @@ def data_fingerprint(cfg_fields: Dict, edges: np.ndarray, n_rows: int,
         "n_features": int(edges.shape[0]),
         "edges_sha256": h.hexdigest(),
     }
+    if y is not None:
+        fp["y_sha256"] = hashlib.sha256(
+            np.ascontiguousarray(y, np.float64).tobytes()).hexdigest()
     if extra:
         fp.update(extra)
     return fp

@@ -121,6 +121,13 @@ class NativeFeaturizer:
         self._handle = lib.ftok_create(arr, len(stopwords), num_features,
                                        int(binary), int(remove_stopwords))
         self._call_lock = threading.Lock()  # begin/fill state is per-handle
+        # Race tripwire (utils/racecheck.py): begin/fill share handle state,
+        # so interleaved pairs from two threads corrupt rows. _call_lock
+        # prevents that today; the checker catches any future path that
+        # reaches the C ABI without it.
+        from fraud_detection_tpu.utils.racecheck import PairedCallChecker
+
+        self._pair_check = PairedCallChecker(name="NativeFeaturizer")
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -163,9 +170,15 @@ class NativeFeaturizer:
             t.encode("utf-8", "surrogatepass").replace(b"\x00", b"") for t in texts]
         arr = (ctypes.c_char_p * len(buf))(*buf)
         with self._call_lock:
-            width = self._lib.ftok_encode_begin(self._handle, arr, len(buf))
-            length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
-            return self._fill(rows, length, want16)
+            # try/finally: an exception between begin and fill must not leave
+            # the pair checker poisoned (spurious RaceErrors forever after).
+            self._pair_check.begin()
+            try:
+                width = self._lib.ftok_encode_begin(self._handle, arr, len(buf))
+                length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
+                return self._fill(rows, length, want16)
+            finally:
+                self._pair_check.finish()
 
     def encode_json(self, values: Sequence[bytes], key: bytes, rows: int,
                     max_tokens: Optional[int], pad_len,
@@ -189,11 +202,15 @@ class NativeFeaturizer:
         span_start = np.zeros(n, np.int32)
         span_len = np.zeros(n, np.int32)
         with self._call_lock:
-            width = self._lib.ftok_encode_json_begin(
-                self._handle, arr, lens, n, key, len(key),
-                status, span_start, span_len)
-            length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
-            ids, counts = self._fill(rows, length, want16)
+            self._pair_check.begin()
+            try:
+                width = self._lib.ftok_encode_json_begin(
+                    self._handle, arr, lens, n, key, len(key),
+                    status, span_start, span_len)
+                length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
+                ids, counts = self._fill(rows, length, want16)
+            finally:
+                self._pair_check.finish()
         return ids, counts, status, span_start, span_len
 
 

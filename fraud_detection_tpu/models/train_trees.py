@@ -322,8 +322,10 @@ def fit_random_forest(
     if checkpoint_dir is not None:
         from fraud_detection_tpu.checkpoint import train_state as ts
 
+        if checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
         fingerprint = ts.data_fingerprint(
-            cfg.__dict__, edges, n,
+            cfg.__dict__, edges, n, y=np.asarray(y),
             extra={"seed": seed, "tree_chunk": tree_chunk,
                    "feature_subset": feature_subset, "num_classes": num_classes})
 
@@ -334,6 +336,13 @@ def fit_random_forest(
         if snap is not None:
             progress, arrays = snap
             trees_done = min(progress, n_trees)
+            if trees_done < n_trees:
+                # Snap the resume point to the original chunk grid: chunk PRNG
+                # keys are fold_in(root, start) with start a multiple of
+                # tree_chunk, so an off-grid tail (a completed run's final
+                # partial chunk being extended) must be dropped and rebuilt
+                # for the extension to stay bit-identical to a fresh run.
+                trees_done = (trees_done // tree_chunk) * tree_chunk
             feats.append(arrays["feature"][:trees_done])
             sbins.append(arrays["split_bin"][:trees_done])
             lefts.append(arrays["left"][:trees_done])
@@ -411,8 +420,11 @@ def fit_gradient_boosting(
     if checkpoint_dir is not None:
         from fraud_detection_tpu.checkpoint import train_state as ts
 
+        if checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
         fingerprint = ts.data_fingerprint(
-            cfg.__dict__, edges, n, extra={"base_score": base_score})
+            cfg.__dict__, edges, n, y=np.asarray(y),
+            extra={"base_score": base_score})
 
     @jax.jit
     def grad_hess(margin):

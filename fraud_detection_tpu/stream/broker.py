@@ -27,6 +27,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Protocol, Sequence
 
+from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
+
 
 @dataclass(slots=True)
 class Message:
@@ -143,6 +145,11 @@ class InProcessConsumer:
                 if g == group_id and t in topics}
         self._committed: Dict[tuple, int] = dict(self._position)
         self._closed = False
+        # Kafka consumers are not thread-safe and neither is this one
+        # (._position/._committed are read-modify-write). The region turns
+        # concurrent poll/commit from two threads into a RaceError instead of
+        # lost offsets (utils/racecheck.py).
+        self._region = ExclusiveRegion("InProcessConsumer")
 
     def _next_from(self, topic: str, part_idx: int) -> Optional[Message]:
         parts = self.broker._partitions(topic)
@@ -156,16 +163,17 @@ class InProcessConsumer:
         return None
 
     def poll(self, timeout: float = 1.0) -> Optional[Message]:
-        deadline = time.time() + timeout
-        while True:
-            for topic in self.topics:
-                for p in range(self.broker.num_partitions):
-                    msg = self._next_from(topic, p)
-                    if msg is not None:
-                        return msg
-            if time.time() >= deadline:
-                return None
-            time.sleep(0.001)
+        with self._region:
+            deadline = time.time() + timeout
+            while True:
+                for topic in self.topics:
+                    for p in range(self.broker.num_partitions):
+                        msg = self._next_from(topic, p)
+                        if msg is not None:
+                            return msg
+                if time.time() >= deadline:
+                    return None
+                time.sleep(0.001)
 
     def poll_batch(self, max_messages: int, timeout: float) -> List[Message]:
         """Drain up to max_messages; waits at most ``timeout`` for the first.
@@ -179,7 +187,7 @@ class InProcessConsumer:
         if first is None:
             return out
         out.append(first)
-        with self.broker._lock:
+        with self._region, self.broker._lock:
             for topic in self.topics:
                 all_parts = self.broker._topics.get(topic)
                 if all_parts is None:
@@ -196,14 +204,16 @@ class InProcessConsumer:
         return out
 
     def commit(self) -> None:
-        self._committed.update(self._position)
-        self._write_through()
+        with self._region:
+            self._committed.update(self._position)
+            self._write_through()
 
     def commit_offsets(self, offsets: Dict[tuple, int]) -> None:
-        for key, off in offsets.items():
-            if off > self._committed.get(key, 0):
-                self._committed[key] = off
-        self._write_through()
+        with self._region:
+            for key, off in offsets.items():
+                if off > self._committed.get(key, 0):
+                    self._committed[key] = off
+            self._write_through()
 
     def _write_through(self) -> None:
         with self.broker._lock:

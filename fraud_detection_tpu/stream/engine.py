@@ -28,6 +28,7 @@ from typing import Callable, List, Optional
 from fraud_detection_tpu.explain.prompts import label_name
 from fraud_detection_tpu.models.pipeline import ServingPipeline
 from fraud_detection_tpu.stream.broker import Consumer, Message, Producer
+from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
 
 # Output wire-format fast path: fixed frame, %.6f confidence (same 6-decimal
 # precision as the dict path's round(confidence, 6)).
@@ -143,6 +144,12 @@ class StreamingClassifier:
         # library / tree model / vocab featurizer), True = in use. The explain
         # hook needs decoded text, so it forces the slow path.
         self._json_fast: Optional[bool] = None if explain_fn is None else False
+        # The engine is single-driver by contract: stats, consumer position,
+        # and in-flight state all assume one thread runs the loop. stop() is
+        # the one cross-thread entry point (a bare flag write). The region
+        # turns a second concurrent run()/process_batch() into an immediate
+        # RaceError instead of silent stat/offset corruption.
+        self._drive_region = ExclusiveRegion("StreamingClassifier.drive")
 
     def stop(self) -> None:
         self._running = False
@@ -298,7 +305,8 @@ class StreamingClassifier:
 
     def process_batch(self, msgs: List[Message]) -> int:
         """Score one micro-batch synchronously and emit results."""
-        return self._finish(self._dispatch(msgs))
+        with self._drive_region:
+            return self._finish(self._dispatch(msgs))
 
     def run(self, max_messages: Optional[int] = None,
             idle_timeout: Optional[float] = None) -> StreamStats:
@@ -312,11 +320,20 @@ class StreamingClassifier:
         hides the full device round-trip behind host work — on a remote
         (tunneled) TPU the round-trip latency exceeds one batch of host work,
         so deeper pipelining is what makes the stream host-bound."""
-        self._running = True
-        self._flush_failed = False
-        started = time.perf_counter()
-        idle_since: Optional[float] = None
-        in_flight: "deque[_InFlight]" = deque()
+        with self._drive_region:
+            # State writes only AFTER the region admits us: a second run()
+            # resetting _running/_flush_failed before its RaceError fired
+            # would corrupt the active run's abort logic.
+            self._running = True
+            self._flush_failed = False
+            started = time.perf_counter()
+            idle_since: Optional[float] = None
+            in_flight: "deque[_InFlight]" = deque()
+            return self._run_loop(started, idle_since, in_flight,
+                                  max_messages, idle_timeout)
+
+    def _run_loop(self, started, idle_since, in_flight, max_messages,
+                  idle_timeout) -> StreamStats:
         try:
             while self._running:
                 budget = self.batch_size

@@ -1,0 +1,147 @@
+"""Race detection for the framework's documented threading contracts.
+
+The reference has no race detection of any kind, while actually shipping a
+shared-mutable-state hazard (Streamlit session state mutated inside its
+blocking Kafka loop — SURVEY.md §5 "Race detection / sanitizers: absent").
+This framework's concurrency story is deliberately simple — one engine
+thread, C++ worker threads that never touch Python state, an internally
+locked broker — but "simple by design" only stays true if the single-threaded
+contracts are *checked*. This module is that check: a lightweight exclusivity
+detector in the style of a lock-discipline sanitizer.
+
+Usage:
+
+    _region = ExclusiveRegion("engine.run")
+    with _region:          # raises RaceError if another thread is inside
+        ...
+
+Semantics:
+
+  * An ``ExclusiveRegion`` may be held by one thread at a time; re-entry by
+    the same thread is allowed (it is a contract checker, not a lock — it
+    never blocks, it FAILS, because a second thread being here at all means
+    the caller broke the documented contract).
+  * Violations raise ``RaceError`` carrying both thread names, and are also
+    recorded in a process-wide log (``violations()``) so supervised code
+    that swallows exceptions still leaves evidence.
+  * Guards are cheap (one mutex + two attribute writes) and sit on per-batch
+    / per-call paths, never per-message ones.
+
+This is detection for the framework's own invariants — the moral equivalent
+of TSAN annotations, not a general happens-before checker.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+_log_lock = threading.Lock()
+_violations: List["RaceViolation"] = []
+
+
+@dataclass
+class RaceViolation:
+    region: str
+    holder: str          # thread name that was inside
+    intruder: str        # thread name that entered concurrently
+    intruder_stack: str  # where the second entry came from
+
+
+class RaceError(RuntimeError):
+    """A documented single-threaded contract was violated."""
+
+    def __init__(self, violation: RaceViolation):
+        self.violation = violation
+        super().__init__(
+            f"race on {violation.region!r}: held by thread "
+            f"{violation.holder!r} when thread {violation.intruder!r} entered "
+            f"— this code path is documented single-threaded")
+
+
+def violations() -> List[RaceViolation]:
+    """All contract violations detected so far in this process."""
+    with _log_lock:
+        return list(_violations)
+
+
+def clear_violations() -> None:
+    with _log_lock:
+        _violations.clear()
+
+
+def _record(v: RaceViolation) -> None:
+    with _log_lock:
+        _violations.append(v)
+
+
+class ExclusiveRegion:
+    """Detects concurrent entry into a code region documented as
+    single-threaded. Same-thread re-entry is fine; cross-thread overlap
+    raises ``RaceError`` (and is recorded either way)."""
+
+    def __init__(self, name: str, strict: bool = True):
+        self.name = name
+        self.strict = strict
+        self._lock = threading.Lock()
+        self._owner: Optional[threading.Thread] = None
+        self._depth = 0
+
+    def __enter__(self) -> "ExclusiveRegion":
+        me = threading.current_thread()
+        with self._lock:
+            if self._owner is None or self._owner is me:
+                self._owner = me
+                self._depth += 1
+                return self
+            v = RaceViolation(
+                region=self.name,
+                holder=self._owner.name,
+                intruder=me.name,
+                intruder_stack="".join(traceback.format_stack(limit=8)),
+            )
+        _record(v)
+        if self.strict:
+            raise RaceError(v)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        me = threading.current_thread()
+        with self._lock:
+            if self._owner is me:
+                self._depth -= 1
+                if self._depth == 0:
+                    self._owner = None
+
+
+@dataclass
+class PairedCallChecker:
+    """Detects broken begin/finish pairing across threads — e.g. the native
+    featurizer's ``encode_begin`` / ``encode_fill`` pair, which shares handle
+    state and must be issued by one caller at a time (native.py holds a lock;
+    this checker catches any future path that forgets to)."""
+
+    name: str
+    strict: bool = True
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _pending_by: Optional[str] = None
+
+    def begin(self) -> None:
+        me = threading.current_thread().name
+        with self._lock:
+            if self._pending_by is not None and self._pending_by != me:
+                v = RaceViolation(
+                    region=f"{self.name}.begin",
+                    holder=self._pending_by,
+                    intruder=me,
+                    intruder_stack="".join(traceback.format_stack(limit=8)))
+                _record(v)
+                if self.strict:
+                    raise RaceError(v)
+            self._pending_by = me
+
+    def finish(self) -> None:
+        with self._lock:
+            self._pending_by = None

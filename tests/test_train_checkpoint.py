@@ -179,3 +179,46 @@ def test_rf_snapshot_cadence_respected(data, tmp_path):
                       tree_chunk=2, checkpoint_dir=ckpt, checkpoint_every=6)
     snap = ts.load_train_state(ckpt)
     assert snap is not None and snap[1] == 8
+
+
+def test_relabeled_y_refuses_resume(data, tmp_path):
+    """Same X (same edges/shapes), different labels: the fingerprint must
+    refuse — blending trees fit on different targets is the frankenmodel
+    case the module exists to prevent."""
+    X, y = data
+    cfg = TreeTrainConfig(max_depth=2, criterion="xgb")
+    ckpt = str(tmp_path / "relabel")
+    fit_gradient_boosting(X, y, n_rounds=4, config=cfg,
+                          checkpoint_dir=ckpt, checkpoint_every=2)
+    y2 = 1 - y  # same class prior -> same base_score; only y_sha256 differs
+    with pytest.raises(ValueError, match="different setup"):
+        fit_gradient_boosting(X, y2, n_rounds=6, config=cfg,
+                              checkpoint_dir=ckpt)
+
+
+def test_rf_extension_snaps_to_chunk_grid(data, tmp_path):
+    """Extending a completed forest whose final chunk was partial (progress
+    off the chunk grid) must still match a fresh larger run bit-for-bit —
+    the off-grid tail is rebuilt from its aligned chunk start."""
+    X, y = data
+    cfg = TreeTrainConfig(max_depth=2)
+    ckpt = str(tmp_path / "extend")
+    # n_trees=7, chunk=3: chunks at 0,3,6 -> final snapshot progress=7 (off grid)
+    fit_random_forest(X, y, n_trees=7, config=cfg, tree_chunk=3, seed=11,
+                      checkpoint_dir=ckpt)
+    assert ts.load_train_state(ckpt)[1] == 7
+    extended = fit_random_forest(X, y, n_trees=11, config=cfg, tree_chunk=3,
+                                 seed=11, checkpoint_dir=ckpt)
+    fresh = fit_random_forest(X, y, n_trees=11, config=cfg, tree_chunk=3, seed=11)
+    _trees_equal(extended, fresh)
+
+
+def test_checkpoint_every_validated(data, tmp_path):
+    X, y = data
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        fit_gradient_boosting(X, y, n_rounds=4,
+                              config=TreeTrainConfig(max_depth=2, criterion="xgb"),
+                              checkpoint_dir=str(tmp_path / "z"), checkpoint_every=0)
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        fit_random_forest(X, y, n_trees=4, config=TreeTrainConfig(max_depth=2),
+                          checkpoint_dir=str(tmp_path / "z"), checkpoint_every=0)
