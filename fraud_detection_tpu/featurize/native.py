@@ -123,8 +123,9 @@ class NativeFeaturizer:
         self._call_lock = threading.Lock()  # begin/fill state is per-handle
         # Race tripwire (utils/racecheck.py): begin/fill share handle state,
         # so interleaved pairs from two threads corrupt rows. _call_lock
-        # prevents that today; the checker catches any future path that
-        # reaches the C ABI without it.
+        # prevents that today; the checker wraps the ABI calls themselves
+        # (``_begin`` / ``_fill``) so a future path using those helpers
+        # without the lock trips it instead of corrupting rows.
         from fraud_detection_tpu.utils.racecheck import PairedCallChecker
 
         self._pair_check = PairedCallChecker(name="NativeFeaturizer")
@@ -141,20 +142,31 @@ class NativeFeaturizer:
     def supports_json(self) -> bool:
         return bool(getattr(self._lib, "_has_json", False))
 
+    def _begin(self, lib_begin, *args) -> int:
+        """All C-ABI ``*_begin`` calls route through here so the race
+        tripwire (utils/racecheck.py) wraps the shared-handle-state calls
+        themselves — a future code path that reaches the ABI without
+        ``_call_lock`` trips the checker instead of corrupting rows."""
+        self._pair_check.begin()
+        return lib_begin(self._handle, *args)
+
     def _fill(self, rows: int, length: int, want16: bool
               ) -> Tuple[np.ndarray, np.ndarray]:
         """Drain handle row state into padded arrays. ``want16`` (and library
         support) emits the device wire dtypes (int16 ids / uint16 counts,
         clipped) directly from C++, skipping a Python astype+copy of both
         (B, L) arrays; callers gate want16 on num_features <= int16 max."""
-        if want16 and getattr(self._lib, "_has_fill16", False):
-            ids = np.empty((rows, length), np.int16)
-            counts = np.empty((rows, length), np.uint16)
-            self._lib.ftok_encode_fill16(self._handle, ids, counts, rows, length)
-        else:
-            ids = np.empty((rows, length), np.int32)
-            counts = np.empty((rows, length), np.float32)
-            self._lib.ftok_encode_fill(self._handle, ids, counts, rows, length)
+        try:
+            if want16 and getattr(self._lib, "_has_fill16", False):
+                ids = np.empty((rows, length), np.int16)
+                counts = np.empty((rows, length), np.uint16)
+                self._lib.ftok_encode_fill16(self._handle, ids, counts, rows, length)
+            else:
+                ids = np.empty((rows, length), np.int32)
+                counts = np.empty((rows, length), np.float32)
+                self._lib.ftok_encode_fill(self._handle, ids, counts, rows, length)
+        finally:
+            self._pair_check.finish()
         return ids, counts
 
     def encode(self, texts: Sequence[str], rows: int,
@@ -170,11 +182,11 @@ class NativeFeaturizer:
             t.encode("utf-8", "surrogatepass").replace(b"\x00", b"") for t in texts]
         arr = (ctypes.c_char_p * len(buf))(*buf)
         with self._call_lock:
-            # try/finally: an exception between begin and fill must not leave
-            # the pair checker poisoned (spurious RaceErrors forever after).
-            self._pair_check.begin()
+            # Outer finally: an exception between begin and fill (e.g. a
+            # raising pad_len) must not leave the checker poisoned with a
+            # stale pending entry (finish is idempotent; _fill also finishes).
             try:
-                width = self._lib.ftok_encode_begin(self._handle, arr, len(buf))
+                width = self._begin(self._lib.ftok_encode_begin, arr, len(buf))
                 length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
                 return self._fill(rows, length, want16)
             finally:
@@ -202,11 +214,10 @@ class NativeFeaturizer:
         span_start = np.zeros(n, np.int32)
         span_len = np.zeros(n, np.int32)
         with self._call_lock:
-            self._pair_check.begin()
             try:
-                width = self._lib.ftok_encode_json_begin(
-                    self._handle, arr, lens, n, key, len(key),
-                    status, span_start, span_len)
+                width = self._begin(self._lib.ftok_encode_json_begin,
+                                    arr, lens, n, key, len(key),
+                                    status, span_start, span_len)
                 length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
                 ids, counts = self._fill(rows, length, want16)
             finally:

@@ -2,3 +2,7 @@ from fraud_detection_tpu.models.linear import LogisticRegression
 from fraud_detection_tpu.models.pipeline import ServingPipeline
 
 __all__ = ["LogisticRegression", "ServingPipeline"]
+
+# Trainers import lazily where used (models.train_linear / train_trees /
+# train_llm) — importing them here would pull optax into every serve-path
+# process.

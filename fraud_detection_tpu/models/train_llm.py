@@ -1,0 +1,254 @@
+"""On-pod LLM training: resumable AdamW fine-tuning over a (data x model) mesh.
+
+The reference delegates all LLM capability to external services (DeepSeek
+HTTPS — /root/reference/utils/agent_api.py:36 — or a local LM Studio server,
+deepseek_chat_ui.py:9); it cannot train or adapt the explanation model at
+all. This trainer closes that gap for the on-pod path (BASELINE config 5):
+fine-tune the JAX decoder (models/llm.py) on explanation transcripts, on the
+same pod that serves it.
+
+TPU-first shape:
+
+  * One jitted ``train_step`` — loss, grad, AdamW update under a single jit.
+    Batches shard over the mesh "data" axis, parameters keep their Megatron
+    tensor-parallel layout over "model" (models/llm.py ``param_shardings``);
+    GSPMD inserts the gradient all-reduces over ICI.
+  * Optional rematerialization (``remat=True``) wraps the forward in
+    ``jax.checkpoint`` — recompute activations in backward instead of storing
+    them, the standard HBM-for-FLOPs trade for long-sequence fine-tunes.
+  * Document stream -> fixed-shape (B, T+1) windows drawn deterministically
+    per step, so every compiled program has one shape and a resumed run sees
+    the exact batches the uninterrupted run would have seen.
+  * Resume via checkpoint/train_state.py: params + optimizer state + step are
+    snapshotted atomically on a cadence; resuming replays nothing and
+    continues bit-identically (tests assert array equality).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fraud_detection_tpu.models import llm as llm_mod
+from fraud_detection_tpu.models.llm import (
+    ByteTokenizer, LanguageModel, Params, TransformerConfig, forward,
+    init_params, param_shardings)
+
+DATA_AXIS = "data"
+
+
+@dataclass(frozen=True)
+class LLMTrainConfig:
+    steps: int = 200
+    batch_size: int = 8           # global batch (split over the data axis)
+    seq_len: int = 128            # tokens per example (T; windows are T+1)
+    learning_rate: float = 3e-4
+    warmup_steps: int = 20
+    decay_steps: Optional[int] = None  # cosine horizon; defaults to `steps`.
+                                  # Set it explicitly when a run may be
+                                  # extended: the schedule (not `steps`) is
+                                  # what resume must hold fixed, so `steps`
+                                  # stays OUT of the snapshot fingerprint.
+    weight_decay: float = 0.01
+    grad_clip: float = 1.0
+    seed: int = 0
+    remat: bool = False           # jax.checkpoint the forward (HBM for FLOPs)
+
+    def resolved_decay_steps(self) -> int:
+        return self.decay_steps if self.decay_steps is not None else max(
+            self.steps, self.warmup_steps + 1)
+
+
+# ---------------------------------------------------------------------------
+# Data: document stream -> deterministic fixed-shape windows
+# ---------------------------------------------------------------------------
+
+def pack_corpus(texts: Sequence[str], cfg: TransformerConfig) -> np.ndarray:
+    """Byte-tokenize and concatenate the corpus into one token stream with
+    BOS/EOS document boundaries (the usual packed-LM layout: no padding, every
+    position trains)."""
+    tok = ByteTokenizer(cfg)
+    parts: List[np.ndarray] = []
+    for t in texts:
+        ids = tok.encode(t)  # already BOS-prefixed
+        parts.append(np.concatenate([ids, [cfg.EOS]]).astype(np.int32))
+    stream = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+    if stream.size < 2:
+        raise ValueError("corpus too small to train on")
+    return stream
+
+
+def batch_for_step(stream: np.ndarray, step: int, tcfg: LLMTrainConfig) -> np.ndarray:
+    """(B, T+1) window batch for a step — a pure function of (stream, step,
+    seed), so resumed runs draw the exact batches the original would have."""
+    rng = np.random.default_rng(np.random.SeedSequence([tcfg.seed, step]))
+    span = tcfg.seq_len + 1
+    if stream.size < span:
+        raise ValueError(
+            f"corpus stream ({stream.size} tokens) is smaller than one "
+            f"(seq_len + 1 = {span})-token window; shrink seq_len or add data")
+    # +1: the last valid start is stream.size - span (inclusive) — dropping it
+    # would systematically under-train the corpus tail.
+    starts = rng.integers(0, stream.size - span + 1, size=tcfg.batch_size)
+    return np.stack([stream[s : s + span] for s in starts])
+
+
+# ---------------------------------------------------------------------------
+# The jitted step
+# ---------------------------------------------------------------------------
+
+def _loss_fn(params: Params, windows: jax.Array, cfg: TransformerConfig,
+             remat: bool) -> jax.Array:
+    """Mean next-token cross-entropy over (B, T+1) windows."""
+    fwd = jax.checkpoint(forward, static_argnums=(2,)) if remat else forward
+    logits, _ = fwd(params, windows[:, :-1], cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    tgt = windows[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def make_optimizer(tcfg: LLMTrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=tcfg.learning_rate,
+        warmup_steps=tcfg.warmup_steps,
+        decay_steps=tcfg.resolved_decay_steps())
+    return optax.chain(
+        optax.clip_by_global_norm(tcfg.grad_clip),
+        optax.adamw(schedule, weight_decay=tcfg.weight_decay))
+
+
+@partial(jax.jit, static_argnames=("cfg", "tcfg", "opt"))
+def _train_step(params: Params, opt_state, windows: jax.Array,
+                cfg: TransformerConfig, tcfg: LLMTrainConfig,
+                opt: optax.GradientTransformation):
+    loss, grads = jax.value_and_grad(_loss_fn)(params, windows, cfg, tcfg.remat)
+    updates, opt_state = opt.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint plumbing: pytree <-> flat npz arrays
+# ---------------------------------------------------------------------------
+
+def _flatten_state(params: Params, opt_state) -> Dict[str, np.ndarray]:
+    arrays = {f"params.{k}": np.asarray(v) for k, v in params.items()}
+    leaves = jax.tree_util.tree_leaves(opt_state)
+    for i, leaf in enumerate(leaves):
+        arrays[f"opt.{i:04d}"] = np.asarray(leaf)
+    return arrays
+
+
+def _unflatten_state(arrays: Dict[str, np.ndarray], params_like: Params,
+                     opt_state_like) -> Tuple[Params, object]:
+    params = {k: jnp.asarray(arrays[f"params.{k}"]).astype(v.dtype)
+              for k, v in params_like.items()}
+    treedef = jax.tree_util.tree_structure(opt_state_like)
+    n = len(jax.tree_util.tree_leaves(opt_state_like))
+    leaves = [jnp.asarray(arrays[f"opt.{i:04d}"]) for i in range(n)]
+    return params, jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Public trainer
+# ---------------------------------------------------------------------------
+
+def fit_language_model(
+    texts: Sequence[str],
+    cfg: Optional[TransformerConfig] = None,
+    tcfg: Optional[LLMTrainConfig] = None,
+    *,
+    mesh: Optional[Mesh] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 50,
+    log_every: int = 0,
+) -> Tuple[LanguageModel, List[float]]:
+    """Fine-tune the byte-level decoder on a text corpus.
+
+    With ``mesh`` (axes ``("data",)`` or ``("data", "model")``), batches shard
+    over "data" and parameters tensor-parallel over "model" — the dp x tp
+    layout an on-pod explanation model trains with. Returns the trained
+    ``LanguageModel`` and the per-step loss history of THIS invocation.
+    """
+    cfg = cfg or TransformerConfig()
+    tcfg = tcfg or LLMTrainConfig()
+    if checkpoint_dir is not None and checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    stream = pack_corpus(texts, cfg)
+    opt = make_optimizer(tcfg)
+
+    params = init_params(jax.random.PRNGKey(tcfg.seed), cfg)
+    if mesh is not None and llm_mod.MODEL_AXIS in mesh.axis_names:
+        sh = param_shardings(cfg, mesh)
+        params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    opt_state = jax.jit(opt.init)(params)
+
+    fingerprint = None
+    start_step = 0
+    if checkpoint_dir is not None:
+        from fraud_detection_tpu.checkpoint import train_state as ts
+
+        import hashlib
+
+        # `steps` is the run length, not the setup: extending a run must
+        # resume, so it stays out. The RESOLVED schedule horizon is what must
+        # match (an uninterrupted long run and a resumed one see the same LR
+        # at every step index).
+        tc = {k: (int(v) if isinstance(v, bool) else v)
+              for k, v in sorted(tcfg.__dict__.items())
+              if k not in ("steps", "decay_steps")}
+        tc["resolved_decay_steps"] = tcfg.resolved_decay_steps()
+        fingerprint = {
+            "config": {k: str(v) for k, v in sorted(cfg.__dict__.items())},
+            "train_config": tc,
+            "stream_sha256": hashlib.sha256(stream.tobytes()).hexdigest(),
+        }
+        snap = ts.load_for(checkpoint_dir, "language_model", fingerprint)
+        if snap is not None:
+            start_step, arrays = snap
+            start_step = min(start_step, tcfg.steps)
+            loaded_params, loaded_opt = _unflatten_state(arrays, params, opt_state)
+            # Re-place BOTH trees with the shardings of their freshly
+            # initialized counterparts (params TP-sharded, AdamW moments
+            # following them): host-loaded arrays fed unplaced into the jit
+            # would recompile and, on multi-host meshes, fail outright.
+            params = jax.tree_util.tree_map(
+                lambda loaded, like: jax.device_put(loaded, like.sharding),
+                loaded_params, params)
+            opt_state = jax.tree_util.tree_map(
+                lambda loaded, like: jax.device_put(loaded, like.sharding),
+                loaded_opt, opt_state)
+
+    batch_sharding = None
+    if mesh is not None and DATA_AXIS in mesh.axis_names:
+        if tcfg.batch_size % mesh.shape[DATA_AXIS] != 0:
+            raise ValueError(
+                f"batch_size {tcfg.batch_size} not divisible by data axis "
+                f"size {mesh.shape[DATA_AXIS]}")
+        batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    losses: List[float] = []
+    for step in range(start_step, tcfg.steps):
+        windows = jnp.asarray(batch_for_step(stream, step, tcfg))
+        if batch_sharding is not None:
+            windows = jax.device_put(windows, batch_sharding)
+        params, opt_state, loss = _train_step(
+            params, opt_state, windows, cfg, tcfg, opt)
+        losses.append(float(loss))
+        if log_every and (step + 1) % log_every == 0:
+            print(f"step {step + 1}/{tcfg.steps} loss {losses[-1]:.4f}")
+        if checkpoint_dir is not None and (
+                (step + 1) % checkpoint_every == 0 or step + 1 == tcfg.steps):
+            ts.save_train_state(
+                checkpoint_dir, "language_model", step + 1, fingerprint,
+                _flatten_state(params, opt_state))
+
+    return LanguageModel(cfg, params), losses
