@@ -62,6 +62,22 @@ def data_fingerprint(cfg_fields: Dict, edges: np.ndarray, n_rows: int,
     return fp
 
 
+def mesh_extra(mesh) -> Dict:
+    """Fingerprint fields for the device topology, merge-style:
+    ``extra.update(mesh_extra(mesh))``. Returns {} off-mesh so the key is
+    absent (not None) and snapshots written before this field existed still
+    resume off-mesh; an on-mesh vs off-mesh mismatch then shows up as
+    key-present vs key-absent drift. Both axis sizes AND the device grid are
+    captured: cross-device psum reduction order depends on the full topology
+    (a same-shape mesh over permuted devices reduces in a different order),
+    so resuming on a different mesh would quietly break bit-identical
+    resume."""
+    if mesh is None:
+        return {}
+    return {"mesh": {"shape": {str(k): int(v) for k, v in mesh.shape.items()},
+                     "device_ids": [int(d.id) for d in mesh.devices.flat]}}
+
+
 def save_train_state(path: str, kind: str, progress: int,
                      fingerprint: Dict, arrays: Dict[str, np.ndarray]) -> None:
     """Atomically write a snapshot: <path>.tmp is fully built then renamed

@@ -211,10 +211,19 @@ def fit_language_model(
             "train_config": tc,
             "stream_sha256": hashlib.sha256(stream.tobytes()).hexdigest(),
         }
+        fingerprint.update(ts.mesh_extra(mesh))
         snap = ts.load_for(checkpoint_dir, "language_model", fingerprint)
         if snap is not None:
             start_step, arrays = snap
-            start_step = min(start_step, tcfg.steps)
+            if start_step > tcfg.steps:
+                # Unlike boosting (where extra trees can be truncated), AdamW
+                # state cannot be rolled back; clamping would silently return
+                # an over-trained model for a shorter request.
+                raise ValueError(
+                    f"snapshot in {checkpoint_dir} has already trained "
+                    f"{start_step} steps but steps={tcfg.steps} was requested; "
+                    "raise steps to extend the run or delete the snapshot to "
+                    "retrain from scratch")
             loaded_params, loaded_opt = _unflatten_state(arrays, params, opt_state)
             # Re-place BOTH trees with the shardings of their freshly
             # initialized counterparts (params TP-sharded, AdamW moments

@@ -324,10 +324,11 @@ def fit_random_forest(
 
         if checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        extra = {"seed": seed, "tree_chunk": tree_chunk,
+                 "feature_subset": feature_subset, "num_classes": num_classes,
+                 **ts.mesh_extra(mesh)}
         fingerprint = ts.data_fingerprint(
-            cfg.__dict__, edges, n, y=np.asarray(y),
-            extra={"seed": seed, "tree_chunk": tree_chunk,
-                   "feature_subset": feature_subset, "num_classes": num_classes})
+            cfg.__dict__, edges, n, y=np.asarray(y), extra=extra)
 
     feats, sbins, lefts, rights, all_stats = [], [], [], [], []
     trees_done = 0
@@ -422,9 +423,9 @@ def fit_gradient_boosting(
 
         if checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        extra = {"base_score": base_score, **ts.mesh_extra(mesh)}
         fingerprint = ts.data_fingerprint(
-            cfg.__dict__, edges, n, y=np.asarray(y),
-            extra={"base_score": base_score})
+            cfg.__dict__, edges, n, y=np.asarray(y), extra=extra)
 
     @jax.jit
     def grad_hess(margin):

@@ -506,6 +506,12 @@ int parse_json_message(const Featurizer* f, const unsigned char* base, int len,
       sc.ws();
       int ks, ke;
       if (!sc.scan_string(&ks, &ke)) return 0;
+      // Keys are matched on raw bytes; an escape-written key (e.g. "text")
+      // decodes to a byte string this comparison can't see, so a duplicate of
+      // the text field could win under json.loads last-duplicate-wins while we
+      // match the literal spelling. Any escaped key disqualifies the message
+      // to the exact-semantics (json.loads) slow path.
+      if (std::memchr(base + ks, '\\', size_t(ke - ks)) != nullptr) return 0;
       bool is_key = size_t(ke - ks) == key.size() &&
                     std::memcmp(base + ks, key.data(), key.size()) == 0;
       sc.ws();

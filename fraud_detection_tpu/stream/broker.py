@@ -38,6 +38,10 @@ class Message:
     partition: int = 0
     offset: int = -1
     timestamp: float = 0.0
+    # Broker-global produce sequence. Timestamps are batch-shared (one
+    # time.time() per append_batch), so they cannot order a batch's round-robin
+    # messages across partitions; this can.
+    seq: int = 0
 
 
 class Consumer(Protocol):
@@ -77,6 +81,7 @@ class InProcessBroker:
         self._group_offsets: Dict[tuple, int] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
+        self._seq = itertools.count()
 
     def _partitions(self, topic: str) -> List[List[Message]]:
         with self._lock:
@@ -93,7 +98,8 @@ class InProcessBroker:
         with self._lock:
             part = parts[idx]
             part.append(Message(topic=topic, value=value, key=key, partition=idx,
-                                offset=len(part), timestamp=time.time()))
+                                offset=len(part), timestamp=time.time(),
+                                seq=next(self._seq)))
 
     def append_batch(self, topic: str,
                      items: Iterable[tuple]) -> None:
@@ -108,7 +114,7 @@ class InProcessBroker:
                 part = parts[idx]
                 part.append(Message(topic=topic, value=value, key=key,
                                     partition=idx, offset=len(part),
-                                    timestamp=now))
+                                    timestamp=now, seq=next(self._seq)))
 
     def topic_size(self, topic: str) -> int:
         parts = self._partitions(topic)
@@ -119,7 +125,7 @@ class InProcessBroker:
         parts = self._partitions(topic)
         with self._lock:
             out = [m for p in parts for m in p]
-        return sorted(out, key=lambda m: (m.timestamp, m.partition, m.offset))
+        return sorted(out, key=lambda m: m.seq)
 
     def consumer(self, topics: Sequence[str], group_id: str = "default") -> "InProcessConsumer":
         return InProcessConsumer(self, list(topics), group_id)

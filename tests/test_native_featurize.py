@@ -224,6 +224,11 @@ def test_json_path_stricter_cases_fall_to_python():
     feat = HashingTfIdfFeaturizer(num_features=4096)
     stricter = [
         b'{"te\\u0078t": "escaped key"}',     # json.loads sees key "text"
+        # Escape-written DUPLICATE of the text field: raw-byte matching sees
+        # only the literal spelling, but json.loads last-duplicate-wins yields
+        # "b" — any escaped key must disqualify the whole message.
+        b'{"text": "a", "\\u0074ext": "b"}',
+        b'{"\\u0074ext": "b", "text": "a"}',
         b"[" * 600 + b"]" * 600,              # beyond the native depth cap
     ]
     out = feat.encode_json(stricter, "text", batch_size=len(stricter))

@@ -355,3 +355,15 @@ def test_produce_batch_and_poll_batch_equivalent():
     for m in got:
         by_key.setdefault(m.key, set()).add(m.partition)
     assert all(len(parts) == 1 for parts in by_key.values())
+
+
+def test_messages_listing_is_produce_order():
+    """broker.messages() must report produce order even for a batch append,
+    whose messages share one timestamp (keyless round-robin spreads them
+    across partitions, so timestamp+partition sorting would interleave)."""
+    broker = InProcessBroker(num_partitions=3)
+    p = broker.producer()
+    p.produce_batch("t", [(f"b{i}".encode(), None) for i in range(9)])
+    broker.append("t", b"single")
+    assert [m.value for m in broker.messages("t")] == \
+        [f"b{i}".encode() for i in range(9)] + [b"single"]

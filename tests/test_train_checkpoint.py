@@ -112,6 +112,31 @@ def test_mismatched_setup_refuses_resume(data, tmp_path):
                           checkpoint_dir=ckpt)
 
 
+def test_mismatched_mesh_refuses_resume(data, tmp_path):
+    """Snapshot from an off-mesh run must not resume on a mesh (and vice
+    versa): psum reduction order differs with topology, which would quietly
+    break the bit-identical-resume guarantee."""
+    from fraud_detection_tpu.parallel.mesh import make_mesh
+
+    X, y = data
+    cfg = TreeTrainConfig(max_depth=2, criterion="xgb")
+
+    ckpt = str(tmp_path / "mesh_fp")
+    fit_gradient_boosting(X, y, n_rounds=4, config=cfg,
+                          checkpoint_dir=ckpt, checkpoint_every=2)
+    with pytest.raises(ValueError, match="different setup"):
+        fit_gradient_boosting(X, y, n_rounds=6, config=cfg, mesh=make_mesh(),
+                              checkpoint_dir=ckpt)
+
+    ckpt_rf = str(tmp_path / "mesh_fp_rf")
+    fit_random_forest(X, y, n_trees=4, config=TreeTrainConfig(max_depth=2),
+                      tree_chunk=2, seed=3, checkpoint_dir=ckpt_rf)
+    with pytest.raises(ValueError, match="different setup"):
+        fit_random_forest(X, y, n_trees=6, config=TreeTrainConfig(max_depth=2),
+                          tree_chunk=2, seed=3, mesh=make_mesh(),
+                          checkpoint_dir=ckpt_rf)
+
+
 def test_snapshot_write_is_atomic(data, tmp_path):
     """A snapshot overwrite leaves either the old or the new state — never a
     torn directory (save builds <dir>.tmp then renames)."""

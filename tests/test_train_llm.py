@@ -102,6 +102,39 @@ def test_resume_refuses_different_corpus(tmp_path):
                            checkpoint_dir=ckpt)
 
 
+def test_resume_refuses_overtrained_snapshot(tmp_path):
+    """Requesting FEWER steps than the snapshot has trained must raise, not
+    silently return the over-trained model (AdamW state can't be rolled
+    back, unlike boosting rounds)."""
+    tcfg = LLMTrainConfig(steps=6, batch_size=2, seq_len=16, decay_steps=6,
+                          warmup_steps=2, seed=7)
+    ckpt = str(tmp_path / "lm3")
+    fit_language_model(CORPUS, TINY, tcfg, checkpoint_dir=ckpt,
+                       checkpoint_every=3)
+    with pytest.raises(ValueError, match="already trained"):
+        fit_language_model(CORPUS, TINY,
+                           LLMTrainConfig(**{**tcfg.__dict__, "steps": 3}),
+                           checkpoint_dir=ckpt)
+
+
+def test_resume_refuses_different_mesh(tmp_path):
+    """An off-mesh snapshot must not resume on a mesh: data-parallel gradient
+    psum reduction order depends on topology (same guard as the tree
+    trainers)."""
+    from fraud_detection_tpu.parallel.mesh import make_mesh
+
+    tcfg = LLMTrainConfig(steps=4, batch_size=2, seq_len=16, decay_steps=4,
+                          warmup_steps=1, seed=8)
+    ckpt = str(tmp_path / "lm4")
+    fit_language_model(CORPUS, TINY, tcfg, checkpoint_dir=ckpt,
+                       checkpoint_every=2)
+    with pytest.raises(ValueError, match="different setup"):
+        fit_language_model(CORPUS, TINY,
+                           LLMTrainConfig(**{**tcfg.__dict__, "steps": 8}),
+                           mesh=make_mesh(n_devices=2),
+                           checkpoint_dir=ckpt)
+
+
 def test_too_small_corpus_raises():
     with pytest.raises(ValueError, match="smaller than one"):
         fit_language_model(["hi"], TINY,
