@@ -15,14 +15,21 @@ with Rabit allreduce) — fraud_detection_spark.py:56-91 — with one engine:
   * Split criteria are pluggable over the same histograms: weighted-gini
     impurity decrease (Spark DT/RF semantics) and second-order logloss gain
     (XGBoost semantics: G^2/(H+lambda) with leaf value -G/(H+lambda)).
-  * Random forest = the same builder vmapped over Poisson(1) bootstrap row
-    weights with per-node Bernoulli feature masks (expected size sqrt(F),
-    approximating Spark's exact sqrt subset - documented deviation).
+  * Random forest = the same builder looped per chunk inside one program
+    over Poisson(1) bootstrap row weights with per-node Bernoulli feature
+    masks (expected size sqrt(F), approximating Spark's exact sqrt subset -
+    documented deviation).
   * Boosting = the builder called per round on (grad, hess) stats.
+
+On single-TPU runs the per-level histogram and gain scan default to the
+Pallas MXU kernels (ops/histogram.py); trainer loops keep per-round state on
+device so wall-clock is not dominated by host round-trips.
 
 Distribution: with inputs sharded over the mesh "data" axis, the per-level
 segment-sums reduce across chips (XLA inserts the psum) — exactly the
-gradient-histogram allreduce XGBoost does over Rabit, riding ICI instead.
+gradient-histogram allreduce XGBoost does over Rabit, riding ICI instead
+(the Pallas path is forced off under a mesh: pallas_call has no SPMD
+partitioning rule — see _resolve_cfg).
 """
 
 from __future__ import annotations
@@ -57,11 +64,16 @@ def quantile_bin_edges(X: np.ndarray, n_bins: int = 32) -> np.ndarray:
 def apply_bins(X: jax.Array, edges: jax.Array) -> jax.Array:
     """(N, F) values -> (N, F) int32 bin ids; bin = #(edges < x) so that
     ``x <= edges[b]  <=>  bin(x) <= b`` (keeps serve-time ``x <= threshold``
-    traversal bit-consistent with train-time binning)."""
-    return jax.vmap(
-        lambda col, e: jnp.searchsorted(e, col, side="left"),
-        in_axes=(1, 0), out_axes=1,
-    )(X, edges).astype(jnp.int32)
+    traversal bit-consistent with train-time binning).
+
+    Computed as an unrolled compare-accumulate over the (static, <= 31) edge
+    columns rather than a binary search: ``searchsorted``'s data-dependent
+    gathers are hostile to the VPU (seconds at 100k x 2048 on TPU), while
+    the compares fuse into one elementwise HBM sweep."""
+    bins = jnp.zeros(X.shape, jnp.int32)
+    for j in range(edges.shape[1]):
+        bins = bins + (X > edges[None, :, j]).astype(jnp.int32)
+    return bins
 
 
 # ---------------------------------------------------------------------------
@@ -117,8 +129,17 @@ class TreeTrainConfig:
     reg_lambda: float = 1.0       # xgb: L2 on leaf values and split gain
     min_child_weight: float = 1e-6
     learning_rate: float = 0.3    # xgb: leaf-value shrinkage (eta)
-    use_pallas: bool = False      # Pallas histogram + gain-scan kernels (ops/)
-                                  # for the no-feature-mask path (DT/boosting)
+    # Pallas histogram + gain-scan kernels (ops/histogram.py) for the
+    # no-feature-mask path (DT/boosting). None = auto: compiled kernels on
+    # TPU, XLA segment-sum elsewhere (interpret-mode Pallas is only for
+    # tests). Resolved to a concrete bool at construction so jit static
+    # hashing and resume fingerprints see a deterministic value.
+    use_pallas: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.use_pallas is None:
+            object.__setattr__(self, "use_pallas",
+                               jax.default_backend() == "tpu")
 
 
 def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfig):
@@ -157,8 +178,10 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
         totals = jax.ops.segment_sum(stats, seg_node, num_segments=width + 1)[:-1]
         node_stats = node_stats.at[offset : offset + width].set(totals)
 
-        use_pallas = cfg.use_pallas and feature_mask_keys is None
-        if use_pallas:
+        if cfg.use_pallas:
+            # The Pallas MXU histogram serves every trainer — feature masks
+            # only affect SPLIT SELECTION, not the statistics, so the forest
+            # path reuses the same kernel and applies its mask on the gains.
             from fraud_detection_tpu.ops.histogram import (
                 auto_interpret, best_splits, node_feature_bin_histogram)
 
@@ -175,7 +198,7 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
         if level == depth:
             break  # deepest level: leaves only
 
-        if use_pallas:
+        if cfg.use_pallas and feature_mask_keys is None:
             best_f, best_b, best_gain = best_splits(
                 hist, totals, criterion=cfg.criterion, n_bins=nb,
                 reg_lambda=cfg.reg_lambda, min_child_weight=cfg.min_child_weight,
@@ -234,6 +257,22 @@ def _build_tree_jit(bins, stats, row_weights, mask_keys, cfg: TreeTrainConfig,
     return _build_tree(bins, stats, row_weights, keys, cfg)
 
 
+@partial(jax.jit, static_argnames=("cfg", "use_feature_mask"))
+def _build_tree_chunk(bins, stats, row_weights, mask_keys,
+                      cfg: TreeTrainConfig, use_feature_mask: bool):
+    """A chunk of independent trees in ONE program, looped (not vmapped):
+    vmapping the histogram over trees multiplies its working set by the
+    chunk size — the vmapped segment-sum path OOMs HBM at bench scale — and
+    under vmap a pallas_call needs an extra batched grid dim. Per-tree PRNG
+    keys come from the caller, so chunking strategy never changes results."""
+    outs = [
+        _build_tree(bins, stats, row_weights[i],
+                    mask_keys[i] if use_feature_mask else None, cfg)
+        for i in range(row_weights.shape[0])
+    ]
+    return tuple(jnp.stack(parts) for parts in zip(*outs))
+
+
 def _edges_to_thresholds(edges: np.ndarray, feature: np.ndarray, split_bin: np.ndarray):
     """Map (feature, bin) splits to serve-time thresholds: edges[f][b]."""
     thr = np.zeros(feature.shape, np.float32)
@@ -246,6 +285,27 @@ def _edges_to_thresholds(edges: np.ndarray, feature: np.ndarray, split_bin: np.n
 # Public trainers
 # ---------------------------------------------------------------------------
 
+def _resolve_cfg(config: Optional[TreeTrainConfig], mesh,
+                 **defaults) -> TreeTrainConfig:
+    """Trainer-entry config resolution. With a mesh, the Pallas path is
+    forced OFF: pallas_call has no SPMD partitioning rule, so GSPMD would
+    either fail to lower or gather the full row set onto every chip — the
+    distributed histogram design is the segment-sum whose psum XLA inserts."""
+    cfg = config or TreeTrainConfig(**defaults)
+    if mesh is not None and cfg.use_pallas:
+        cfg = TreeTrainConfig(**{**cfg.__dict__, "use_pallas": False})
+    return cfg
+
+
+def _drain_lists_to_host(lists, n_host: int) -> int:
+    """device_get the tail (>= n_host) of each accumulator list in one
+    transfer; returns the new host watermark."""
+    pulled = jax.device_get([lst[n_host:] for lst in lists])
+    for lst, new in zip(lists, pulled):
+        lst[n_host:] = new
+    return len(lists[0])
+
+
 def _prepare_inputs(X, y, num_classes, cfg, edges, mesh):
     """Shared prep: binning, per-row class stats, activity weights.
 
@@ -256,9 +316,13 @@ def _prepare_inputs(X, y, num_classes, cfg, edges, mesh):
     """
     from fraud_detection_tpu.parallel import mesh as mesh_lib
 
-    X = np.asarray(X, np.float32)
-    y = np.asarray(y)
+    if not hasattr(X, "shape"):  # plain sequences stay accepted
+        X = np.asarray(X, np.float32)
     n = X.shape[0]
+    if edges is None or mesh is not None:
+        # Quantiles are host-side; the mesh path shards from host rows.
+        X = np.asarray(X, np.float32)
+    y = np.asarray(y)
     if edges is None:
         edges = quantile_bin_edges(X, cfg.n_bins)
     if mesh is not None:
@@ -266,7 +330,9 @@ def _prepare_inputs(X, y, num_classes, cfg, edges, mesh):
         yd = mesh_lib.shard_rows(np.asarray(y, np.float32), mesh)
         weights = mesh_lib.shard_rows(np.ones(n, np.float32), mesh)
     else:
-        Xd = jnp.asarray(X)
+        # No host round-trip when the caller already staged X on device with
+        # precomputed edges (transfer can dwarf training on a remote host).
+        Xd = jnp.asarray(X, dtype=jnp.float32)
         yd = jnp.asarray(np.asarray(y, np.float32))
         weights = jnp.ones((n,), jnp.float32)
     bins = apply_bins(Xd, jnp.asarray(edges))
@@ -279,7 +345,7 @@ def fit_decision_tree(
     edges: Optional[np.ndarray] = None, mesh=None,
 ) -> TreeEnsemble:
     """Gini decision tree (Spark DecisionTreeClassifier semantics, maxBins binning)."""
-    cfg = config or TreeTrainConfig()
+    cfg = _resolve_cfg(config, mesh)
     edges, bins, _, stats, weights, _ = _prepare_inputs(X, y, num_classes, cfg, edges, mesh)
     dummy_keys = jax.random.split(jax.random.PRNGKey(0), cfg.max_depth + 1)
     feat, sbin, left, right, node_stats = _build_tree_jit(
@@ -310,13 +376,13 @@ def fit_random_forest(
     ``fold_in(root, start)`` — a pure function of (seed, start) — so resumed
     forests are bit-identical to uninterrupted ones.
     """
-    cfg = config or TreeTrainConfig()
+    cfg = _resolve_cfg(config, mesh)
     edges, bins, _, stats, base_weights, n = _prepare_inputs(
         X, y, num_classes, cfg, edges, mesh)
     n_padded = bins.shape[0]
 
     root = jax.random.PRNGKey(seed)
-    build = jax.vmap(_build_tree_jit, in_axes=(None, None, 0, 0, None, None))
+    build = _build_tree_chunk
 
     fingerprint = None
     if checkpoint_dir is not None:
@@ -350,6 +416,16 @@ def fit_random_forest(
             rights.append(arrays["right"][:trees_done])
             all_stats.append(arrays["node_stats"][:trees_done])
 
+    # Chunk outputs stay ON DEVICE until a snapshot or the end — a host
+    # round-trip per chunk would dominate wall-clock when the host is far
+    # from the device (the per-chunk arrays are a few KB).
+    n_host = len(feats)  # chunks already on host (resume load)
+    acc_lists = [feats, sbins, lefts, rights, all_stats]
+
+    def drain_to_host() -> None:
+        nonlocal n_host
+        n_host = _drain_lists_to_host(acc_lists, n_host)
+
     last_saved = trees_done
     for start in range(trees_done, n_trees, tree_chunk):
         chunk = min(tree_chunk, n_trees - start)
@@ -361,21 +437,23 @@ def fit_random_forest(
         mask_keys = jax.random.split(mkey, chunk * (cfg.max_depth + 1)).reshape(
             chunk, cfg.max_depth + 1, -1)
         f_, b_, l_, r_, s_ = build(bins, stats, weights, mask_keys, cfg, feature_subset)
-        feats.append(np.asarray(f_)); sbins.append(np.asarray(b_))
-        lefts.append(np.asarray(l_)); rights.append(np.asarray(r_))
-        all_stats.append(np.asarray(s_))
+        feats.append(f_); sbins.append(b_)
+        lefts.append(l_); rights.append(r_)
+        all_stats.append(s_)
         done = start + chunk
         # Snapshot on the cadence (each save rewrites the full accumulated
         # state, so per-chunk saves would cost O(n_trees^2) bytes) and at
         # completion (the seed for extending the forest later).
         if checkpoint_dir is not None and (
                 done - last_saved >= checkpoint_every or done == n_trees):
+            drain_to_host()
             ts.save_train_state(
                 checkpoint_dir, "random_forest", done, fingerprint,
                 {"feature": np.concatenate(feats), "split_bin": np.concatenate(sbins),
                  "left": np.concatenate(lefts), "right": np.concatenate(rights),
                  "node_stats": np.concatenate(all_stats)})
             last_saved = done
+    drain_to_host()
     cat = lambda xs: list(np.concatenate(xs, axis=0))
     return _assemble(cat(feats), cat(sbins), cat(lefts), cat(rights), cat(all_stats),
                      edges, np.ones(n_trees), "random_forest", cfg)
@@ -402,7 +480,7 @@ def fit_gradient_boosting(
     equals an uninterrupted run's. A snapshot taken under a different
     config/data refuses to load.
     """
-    cfg = config or TreeTrainConfig(criterion="xgb")
+    cfg = _resolve_cfg(config, mesh, criterion="xgb")
     if cfg.criterion != "xgb":
         cfg = TreeTrainConfig(**{**cfg.__dict__, "criterion": "xgb"})
     if base_score is None:
@@ -427,20 +505,6 @@ def fit_gradient_boosting(
         fingerprint = ts.data_fingerprint(
             cfg.__dict__, edges, n, y=np.asarray(y), extra=extra)
 
-    @jax.jit
-    def grad_hess(margin):
-        p = jax.nn.sigmoid(margin)
-        return p - yf, p * (1.0 - p)
-
-    @partial(jax.jit, static_argnames=())
-    def leaf_values(node_stats):
-        g, h = node_stats[:, 0], node_stats[:, 1]
-        return -g / (h + cfg.reg_lambda) * cfg.learning_rate
-
-    @jax.jit
-    def update_margin(margin, row_node, values):
-        return margin + values[row_node]
-
     start_round = 0
     if checkpoint_dir is not None:
         snap = ts.load_for(checkpoint_dir, "gradient_boosting", fingerprint)
@@ -461,7 +525,7 @@ def fit_gradient_boosting(
                 row_leaf = _row_leaves(bins, jnp.asarray(f_), jnp.asarray(b_),
                                        jnp.asarray(l_), jnp.asarray(r__),
                                        cfg.max_depth)
-                margin = update_margin(margin, row_leaf, jnp.asarray(v_))
+                margin = _update_margin(margin, row_leaf, jnp.asarray(v_))
             start_round = progress
 
     def snapshot(rounds_done: int) -> None:
@@ -471,24 +535,59 @@ def fit_gradient_boosting(
              "left": np.stack(lefts), "right": np.stack(rights),
              "leaf_values": np.stack([v[:, 0] for v in leaf_vals])})
 
+    # One fused program per round, and per-tree arrays stay ON DEVICE until a
+    # snapshot or the end: a host round-trip per round would dominate
+    # wall-clock (the tiny (63,) tree arrays cost more in sync latency than
+    # the whole histogram pass costs in compute).
+    n_host = len(feats)  # rounds already materialized on host (resume replay)
+    acc_lists = [feats, sbins, lefts, rights, leaf_vals]
+
+    def drain_to_host() -> None:
+        nonlocal n_host
+        n_host = _drain_lists_to_host(acc_lists, n_host)
+
     for r in range(start_round, n_rounds):
-        g, h = grad_hess(margin)
-        stats = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
-        f_, b_, l_, r_, s_ = _build_tree_jit(bins, stats, weights, dummy_keys, cfg, False)
-        values = leaf_values(s_)
-        row_leaf = _row_leaves(bins, f_, b_, l_, r_, cfg.max_depth)
-        margin = update_margin(margin, row_leaf, values)
-        feats.append(np.asarray(f_)); sbins.append(np.asarray(b_))
-        lefts.append(np.asarray(l_)); rights.append(np.asarray(r_))
-        leaf_vals.append(np.asarray(values)[:, None])
+        f_, b_, l_, r_, values, values2, row_leaf = _boost_round(
+            margin, bins, yf, weights, dummy_keys, cfg)
+        # The update runs as the SAME separate program the resume replay
+        # uses: fusing it into _boost_round lets XLA contract the gather-add
+        # differently (fma) and break bit-identical resume.
+        margin = _update_margin(margin, row_leaf, values)
+        feats.append(f_); sbins.append(b_)
+        lefts.append(l_); rights.append(r_)
+        leaf_vals.append(values2)
         # Snapshot on the cadence AND at completion (a finished run's snapshot
         # is the seed for extending training to more rounds later).
         if checkpoint_dir is not None and (
                 (r + 1) % checkpoint_every == 0 or r + 1 == n_rounds):
+            drain_to_host()
             snapshot(r + 1)
 
+    drain_to_host()
     return _assemble(feats, sbins, lefts, rights, leaf_vals,
                      edges, np.ones(n_rounds), "xgboost", cfg, bias=base_score)
+
+
+@jax.jit
+def _update_margin(margin, row_node, values):
+    return margin + values[row_node]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _boost_round(margin, bins, yf, weights, dummy_keys, cfg: TreeTrainConfig):
+    """One boosting round as a single program: gradients, tree build, leaf
+    values, row routing. Fusing these keeps dispatches per round to two
+    (this + ``_update_margin``) — per-launch overhead is material when the
+    host is far from the device."""
+    p = jax.nn.sigmoid(margin)
+    g, h = p - yf, p * (1.0 - p)
+    stats = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+    f_, b_, l_, r_, s_ = _build_tree_jit(bins, stats, weights, dummy_keys, cfg, False)
+    values = -s_[:, 0] / (s_[:, 1] + cfg.reg_lambda) * cfg.learning_rate
+    row_leaf = _row_leaves(bins, f_, b_, l_, r_, cfg.max_depth)
+    # values twice: flat for the margin update, (M, 1) for the snapshot
+    # accumulator — shaping in-program avoids a per-round dispatch.
+    return f_, b_, l_, r_, values, values[:, None], row_leaf
 
 
 @partial(jax.jit, static_argnames=("max_depth",))

@@ -45,9 +45,29 @@ def _round_up(n: int, m: int) -> int:
 # histogram kernel
 # ---------------------------------------------------------------------------
 
-def _hist_kernel(bins_ref, local_ref, stats_ref, out_ref, *, n_bins: int,
-                 n_nodes: int, k: int):
-    """One (feature-tile, row-tile) cell: out += multihot^T @ (node (x) stats)."""
+def _hist_kernel(bins_ref, b_of_c_ref, local_ref, stats_ref, out_ref, *,
+                 n_bins: int, n_nodes: int, k: int):
+    """One (feature-tile, row-tile) cell: out += multihot^T @ (node (x) stats).
+
+    Mosaic constraints + MXU economics shape this kernel:
+
+    * No minor-dim reshape exists, so the flat bucket axis uses the
+      (bin, feature-in-tile) order that ``pltpu.repeat`` (tile-concat
+      semantics) produces directly — column c <-> (b = c // Ft, f = c % Ft) —
+      and the khatri-rao node (x) stats matrix is built by lane-axis
+      concatenation instead of a 3D reshape. The host wrapper untangles.
+    * ``b_of_c`` (the bin id of each flat column — identical for every tile)
+      arrives as a (1, C) input instead of a per-cell iota+divide.
+    * The dot runs TRANSPOSED — (K*L, R) @ (R, C) — so the 4096-wide bucket
+      axis lands on lanes: the MXUs parallelize over lanes, and K*L (<= 96)
+      on lanes would leave all but one idle. One fused dot with the K
+      statistics concatenated beats K narrow dots for the same reason.
+    * The f32 stats are split hi/lo into two bf16 passes (~16 mantissa bits,
+      accumulated in f32): single-pass bf16 rounds to 8 bits — enough error
+      (~1e-2 relative) to flip split argmaxes vs the XLA path — while
+      HIGHEST costs 6 passes for precision the argmax doesn't need. The 0/1
+      multihot is exact in bf16.
+    """
     r_idx = pl.program_id(1)
 
     @pl.when(r_idx == 0)
@@ -55,22 +75,25 @@ def _hist_kernel(bins_ref, local_ref, stats_ref, out_ref, *, n_bins: int,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     bins = bins_ref[:]                         # (R, Ft) int32
-    local = local_ref[:, 0]                    # (R,) int32; >= n_nodes -> inactive
-    stats = stats_ref[:]                       # (R, K) f32
+    local = local_ref[:]                       # (1, R) int32; >= n_nodes -> inactive
+    stats = stats_ref[:]                       # (K, R) f32
 
     R, Ft = bins.shape
-    # multi-hot over the flattened (feature-in-tile, bin) axis
-    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (R, Ft, n_bins), 2)
-    multihot = (bin_iota == bins[:, :, None]).reshape(R, Ft * n_bins)
-    # node-onehot (x) stats -> (R, L*K); inactive rows are all-zero
-    node_iota = jax.lax.broadcasted_iota(jnp.int32, (R, n_nodes), 1)
-    node_onehot = (node_iota == local[:, None]).astype(stats.dtype)
-    ns = (node_onehot[:, :, None] * stats[:, None, :]).reshape(R, n_nodes * k)
-
-    out_ref[:] += jax.lax.dot_general(
-        multihot.astype(stats.dtype), ns,
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    bins_rep = pltpu.repeat(bins, n_bins, axis=1)                  # (R, C)
+    multihot = (bins_rep == b_of_c_ref[:]).astype(jnp.bfloat16)
+    # transposed node-onehot; inactive rows (local >= n_nodes) are all-zero
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, R), 0)
+    node_onehot = (node_iota == local).astype(jnp.float32)         # (L, R)
+    ns = jnp.concatenate(
+        [node_onehot * stats[kk : kk + 1, :] for kk in range(k)], axis=0)
+    ns_hi = ns.astype(jnp.bfloat16)                                # (K*L, R)
+    ns_lo = (ns - ns_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    dims = (((1,), (0,)), ((), ()))                                # contract R
+    acc = jax.lax.dot_general(ns_hi, multihot, dims,
+                              preferred_element_type=jnp.float32)
+    acc = acc + jax.lax.dot_general(ns_lo, multihot, dims,
+                                    preferred_element_type=jnp.float32)
+    out_ref[:] += acc                                              # (K*L, C)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "row_tile",
@@ -82,8 +105,8 @@ def node_feature_bin_histogram(
     *,
     n_nodes: int,
     n_bins: int,
-    row_tile: int = 512,
-    feature_tile: int = 32,
+    row_tile: int = 256,
+    feature_tile: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
     """(n_nodes, F, n_bins, K) statistics histogram via the Pallas kernel."""
@@ -93,8 +116,10 @@ def node_feature_bin_histogram(
     f_pad = _round_up(max(f, 1), feature_tile)
     bins_p = jnp.zeros((n_pad, f_pad), jnp.int32)
     bins_p = bins_p.at[:n, :f].set(bins)
-    local_p = jnp.full((n_pad, 1), n_nodes, jnp.int32).at[:n, 0].set(local)
-    stats_p = jnp.zeros((n_pad, k), stats.dtype).at[:n].set(stats)
+    local_p = jnp.full((1, n_pad), n_nodes, jnp.int32).at[0, :n].set(local)
+    stats_p = jnp.zeros((k, n_pad), stats.dtype).at[:, :n].set(stats.T)
+    b_of_c = (jnp.arange(feature_tile * n_bins, dtype=jnp.int32)
+              // feature_tile)[None, :]
 
     grid = (f_pad // feature_tile, n_pad // row_tile)
     out = pl.pallas_call(
@@ -103,20 +128,26 @@ def node_feature_bin_histogram(
         in_specs=[
             pl.BlockSpec((row_tile, feature_tile), lambda fi, ri: (ri, fi),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((row_tile, 1), lambda fi, ri: (ri, 0),
+            pl.BlockSpec((1, feature_tile * n_bins), lambda fi, ri: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((row_tile, k), lambda fi, ri: (ri, 0),
+            pl.BlockSpec((1, row_tile), lambda fi, ri: (0, ri),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, row_tile), lambda fi, ri: (0, ri),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((feature_tile * n_bins, n_nodes * k),
-                               lambda fi, ri: (fi, 0),
+        out_specs=pl.BlockSpec((k * n_nodes, feature_tile * n_bins),
+                               lambda fi, ri: (0, fi),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((f_pad * n_bins, n_nodes * k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((k * n_nodes, f_pad * n_bins), jnp.float32),
         interpret=interpret,
-    )(bins_p, local_p, stats_p)
+    )(bins_p, b_of_c, local_p, stats_p)
 
-    hist = out.reshape(f_pad, n_bins, n_nodes, k)[:f]
-    return hist.transpose(2, 0, 1, 3)  # (L, F, NB, K)
+    # Untangle the kernel's layout: row = kk*L + l,
+    # col = tile*(NB*Ft) + b*Ft + f_in  ->  (L, F, NB, K).
+    n_tiles = f_pad // feature_tile
+    hist = out.reshape(k, n_nodes, n_tiles, n_bins, feature_tile)
+    hist = hist.transpose(1, 2, 4, 3, 0).reshape(n_nodes, f_pad, n_bins, k)
+    return hist[:, :f]
 
 
 def histogram_reference(bins, local, stats, *, n_nodes: int, n_bins: int) -> jax.Array:
@@ -138,40 +169,71 @@ def histogram_reference(bins, local, stats, *, n_nodes: int, n_bins: int) -> jax
 # ---------------------------------------------------------------------------
 
 def _gain_kernel(hist_ref, total_ref, best_idx_ref, best_gain_ref, *,
-                 n_bins: int, criterion: str, reg_lambda: float,
+                 n_bins: int, n_stats: int, criterion: str, reg_lambda: float,
                  min_child_weight: float):
-    """One node: cumsum over bins, impurity gain, argmax over (F, NB-1)."""
-    hist = hist_ref[0].astype(jnp.float32)        # block (1, F, NB*K) -> (F, NB*K)
-    F = hist.shape[0]
-    k = hist.shape[1] // n_bins
-    hist = hist.reshape(F, n_bins, k)
-    total = total_ref[0].astype(jnp.float32)      # (K,)
+    """One node: cumulative-left stats, impurity gain, argmax over (F, NB-1).
 
-    left = jnp.cumsum(hist, axis=1)               # (F, NB, K)
-    right = total[None, None, :] - left
+    All intermediates are 2D (F, NB) per statistic — Mosaic has no minor-dim
+    reshape, so the K statistics arrive pre-sliced on a leading axis and the
+    bin-cumsum is an upper-triangular matmul (MXU work; exact for the 0/1 and
+    small-count magnitudes involved). Totals ride in SMEM as scalars. The
+    flat argmax is recovered as min(position where gain == max), matching
+    XLA's first-occurrence argmax tie rule in (F, NB-1) row-major order.
+    """
+    nb = n_bins
+    # inclusive prefix over bins: left = hist @ upper_tri  (NB, NB)
+    tri_r = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+    tri_c = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+    tri = (tri_r <= tri_c).astype(jnp.float32)
+
+    left = []
+    total = []
+    for kk in range(n_stats):
+        h = hist_ref[0, kk].astype(jnp.float32)          # (F, NB)
+        left.append(jax.lax.dot_general(
+            h, tri, dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32))
+        total.append(total_ref[0, 0, kk])                # SMEM scalar
+    right = [t - l for l, t in zip(left, total)]
+
     if criterion == "gini":
-        def gini_sum(s):
-            cnt = jnp.sum(s, axis=-1)
-            sq = jnp.sum(s * s, axis=-1)
+        def gini_sum(stats_2d):
+            cnt = stats_2d[0]
+            sq = stats_2d[0] * stats_2d[0]
+            for s in stats_2d[1:]:
+                cnt = cnt + s
+                sq = sq + s * s
             return cnt - sq / jnp.maximum(cnt, 1e-12), cnt
-        (g_l, n_l) = gini_sum(left)
-        (g_r, n_r) = gini_sum(right)
-        (g_p, n_p) = gini_sum(total[None, None, :])
-        gain = (g_p - g_l - g_r) / jnp.maximum(n_p, 1e-12)
+        g_l, n_l = gini_sum(left)
+        g_r, n_r = gini_sum(right)
+        cnt_p = total[0]
+        sq_p = total[0] * total[0]
+        for t in total[1:]:
+            cnt_p = cnt_p + t
+            sq_p = sq_p + t * t
+        g_p = cnt_p - sq_p / jnp.maximum(cnt_p, 1e-12)
+        gain = (g_p - g_l - g_r) / jnp.maximum(cnt_p, 1e-12)
         valid = (n_l > 0) & (n_r > 0)
     else:  # xgb second-order gain; stats layout (grad, hess, count)
-        gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
-        gr, hr, cr = right[..., 0], right[..., 1], right[..., 2]
+        gl, hl, cl = left[0], left[1], left[2]
+        gr, hr, cr = right[0], right[1], right[2]
         gp, hp = total[0], total[1]
         score = lambda g, h: (g * g) / (h + reg_lambda)
         gain = 0.5 * (score(gl, hl) + score(gr, hr) - score(gp, hp))
         valid = (hl >= min_child_weight) & (hr >= min_child_weight) & \
                 (cl > 0) & (cr > 0)
-    gain = jnp.where(valid, gain, -jnp.inf)[:, : n_bins - 1]   # last bin: no right
-    flat = gain.reshape(-1)
-    best = jnp.argmax(flat)
-    best_idx_ref[0, 0] = best.astype(jnp.int32)
-    best_gain_ref[0, 0] = flat[best]
+
+    f = gain.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (f, nb), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (f, nb), 0)
+    in_range = col < nb - 1                              # last bin: no right side
+    gain = jnp.where(valid & in_range, gain, -jnp.inf)
+    best = jnp.max(gain)
+    pos = row * (nb - 1) + col
+    pos = jnp.where((gain == best) & in_range, pos, jnp.int32(2**30))
+    best_idx_ref[0, 0, 0] = jnp.min(pos)
+    best_gain_ref[0, 0, 0] = best
 
 
 @partial(jax.jit, static_argnames=("criterion", "n_bins", "reg_lambda",
@@ -188,25 +250,27 @@ def best_splits(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Per node: (best_feature, best_bin, best_gain) fused on the VPU."""
     L, F, NB, K = hist.shape
-    flat_hist = hist.reshape(L, F, NB * K)
+    hist_k = hist.transpose(0, 3, 1, 2)                  # (L, K, F, NB)
+    totals3 = totals.reshape(L, 1, K)
     idx, gain = pl.pallas_call(
-        partial(_gain_kernel, n_bins=NB, criterion=criterion,
+        partial(_gain_kernel, n_bins=NB, n_stats=K, criterion=criterion,
                 reg_lambda=reg_lambda, min_child_weight=min_child_weight),
         grid=(L,),
         in_specs=[
-            pl.BlockSpec((1, F, NB * K), lambda l: (l, 0, 0),
+            pl.BlockSpec((1, K, F, NB), lambda l: (l, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, K), lambda l: (l, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, K), lambda l: (l, 0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1), lambda l: (l, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda l: (l, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1), lambda l: (l, 0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1), lambda l: (l, 0, 0), memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((L, 1), jnp.int32),
-            jax.ShapeDtypeStruct((L, 1), jnp.float32),
+            jax.ShapeDtypeStruct((L, 1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((L, 1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(flat_hist, totals)
-    idx = idx[:, 0]
-    return (idx // (NB - 1)).astype(jnp.int32), (idx % (NB - 1)).astype(jnp.int32), gain[:, 0]
+    )(hist_k, totals3)
+    idx = idx[:, 0, 0]
+    return (idx // (NB - 1)).astype(jnp.int32), (idx % (NB - 1)).astype(jnp.int32), gain[:, 0, 0]

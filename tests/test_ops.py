@@ -1,6 +1,8 @@
 """Pallas kernel tests (interpret mode on the CPU mesh): histogram and
-gain-scan kernels must agree exactly with the XLA formulations, and trees
-built through the Pallas path must match trees built through the XLA path."""
+gain-scan kernels must agree with the XLA formulations to the kernel's
+designed precision (the histogram accumulates f32 stats split into hi/lo
+bf16 MXU passes — ~16 mantissa bits per term), and trees built through the
+Pallas path must match trees built through the XLA path."""
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +32,11 @@ def test_histogram_kernel_matches_reference(hist_case):
                                      row_tile=64, feature_tile=16, interpret=True)
     want = histogram_reference(bins, local, stats, n_nodes=L, n_bins=nb)
     assert got.shape == want.shape
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+    # hi/lo bf16 split: ~2^-16 relative per term; cancelling sums can show a
+    # larger RELATIVE error on near-zero cells, so tolerance is scale-based.
+    scale = float(np.abs(np.asarray(want)).max())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3 * scale)
 
 
 def test_histogram_kernel_ragged_sizes():
