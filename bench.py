@@ -39,30 +39,34 @@ import numpy as np
 NORTH_STAR = 10_000.0  # dialogues/sec, BASELINE.json
 
 
-def build_pipeline(batch_size: int):
+def build_pipeline(batch_size: int, model: str = "lr"):
     from fraud_detection_tpu.models.pipeline import ServingPipeline
 
     artifact = "/root/reference/dialogue_classification_model"
-    if os.path.isdir(artifact):
+    if model == "lr" and os.path.isdir(artifact):
         from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
 
         return ServingPipeline.from_spark_artifact(
             load_spark_pipeline(artifact), batch_size=batch_size)
-    # Fallback: train on synthetic data so the bench runs anywhere.
+    # Tree families (BENCH_MODEL=dt|rf|xgb — the reference's primary trained
+    # models) and the no-artifact fallback train on synthetic data.
     from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
 
-    return synthetic_demo_pipeline(batch_size)
+    return synthetic_demo_pipeline(batch_size, model=model)
 
 
 def pallas_parity_check() -> float:
-    """Pallas vs XLA histogram agreement on the REAL backend (compiled on
-    TPU, interpret elsewhere). Returns the max abs difference; raises if the
-    kernels disagree — the training bench must measure a correct path."""
+    """Pallas vs XLA agreement for BOTH kernels on the REAL backend
+    (compiled on TPU, interpret elsewhere) — the training bench must measure
+    a verified-correct path. Returns the histogram max abs difference;
+    raises if either kernel disagrees."""
     import jax
     import jax.numpy as jnp
 
+    from fraud_detection_tpu.models.train_trees import _xgb_gain
     from fraud_detection_tpu.ops.histogram import (
-        auto_interpret, histogram_reference, node_feature_bin_histogram)
+        auto_interpret, best_splits, histogram_reference,
+        node_feature_bin_histogram)
 
     rng = np.random.default_rng(0)
     n, f, nb, l, k = 4096, 256, 32, 8, 3
@@ -77,6 +81,20 @@ def pallas_parity_check() -> float:
     if diff > 1e-3 * max(scale, 1.0):
         raise AssertionError(
             f"Pallas histogram disagrees with XLA reference: max|diff|={diff}")
+
+    # Compiled gain-scan kernel vs the XLA formulation on the same stats
+    # (hessians made positive so xgb validity masks behave).
+    hist = jnp.abs(want) + 0.01
+    totals = hist[:, 0].sum(axis=1)
+    bf, bb, _ = best_splits(hist, totals, criterion="xgb", n_bins=nb,
+                            feature_tile=128, interpret=auto_interpret())
+    cum = jnp.cumsum(hist, axis=2)
+    gain = _xgb_gain(cum, totals[:, None, None, :], 1.0, 1e-6)[:, :, : nb - 1]
+    flat = np.asarray(gain.reshape(l, -1))
+    ref = flat.argmax(axis=1)
+    if not (np.asarray(bf) == ref // (nb - 1)).all() or \
+       not (np.asarray(bb) == ref % (nb - 1)).all():
+        raise AssertionError("Pallas gain scan disagrees with XLA reference")
     return diff
 
 
@@ -160,11 +178,12 @@ def main() -> None:
     n_msgs = int(os.environ.get("BENCH_MSGS", "20000"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
     depth = int(os.environ.get("BENCH_DEPTH", "4"))
+    model = os.environ.get("BENCH_MODEL", "lr")
 
     corpus = generate_corpus(n=2000, seed=123)
     texts = [d.text for d in corpus]
 
-    pipe = build_pipeline(batch_size)
+    pipe = build_pipeline(batch_size, model=model)
     # Warm-up: trigger compilation for the steady-state shapes.
     pipe.predict([texts[i % len(texts)] for i in range(batch_size * 2)])
 
@@ -191,6 +210,8 @@ def main() -> None:
         "unit": "dialogues/sec",
         "vs_baseline": round(best / NORTH_STAR, 4),
     }
+    if model != "lr":
+        line["metric"] += f"_{model}"
     if os.environ.get("BENCH_TRAIN", "1") != "0":
         line["training"] = training_bench()
     print(json.dumps(line))

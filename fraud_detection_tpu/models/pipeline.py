@@ -11,14 +11,20 @@ materialized (gather/segment-sum fast path, models/linear.py).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from fraud_detection_tpu.checkpoint.spark_artifact import SparkPipelineArtifact
 from fraud_detection_tpu.featurize.text import StopWordFilter
-from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer
+from fraud_detection_tpu.featurize.tfidf import (
+    HashingTfIdfFeaturizer,
+    VocabTfIdfFeaturizer,
+    tfidf_dense,
+)
 from fraud_detection_tpu.models import linear as linear_mod
 from fraud_detection_tpu.models import trees as trees_mod
 from fraud_detection_tpu.models.linear import LogisticRegression
@@ -85,6 +91,7 @@ class ServingPipeline:
             # Trees branch on absolute feature values: needs the dense TF-IDF
             # matrix (one scatter + traversal, still one device program).
             self._fused_model = None
+        self._tree_idf = None  # device IDF cache for the tree fast path
 
     @property
     def fused_model(self) -> LogisticRegression:
@@ -155,15 +162,18 @@ class ServingPipeline:
         Returns ``(pending, status, span_start, span_len)`` where the pending
         prediction covers ALL rows positionally (row i = values[i]; status 0
         rows are all-padding and score as garbage the caller must discard),
-        or None when unavailable (no native library, vocabulary featurizer,
-        or tree model — trees need the dense matrix built from decoded text).
-        The spans locate each message's raw string literal for zero-copy
-        output framing (stream/engine.py)."""
-        if self._fused_model is None:
-            return None
+        or None when unavailable (no native library or vocabulary
+        featurizer). Tree models ride the same native encode: the hashed
+        sparse rows scatter to dense TF-IDF and traverse the ensemble in one
+        device program (matching the reference's primary trained family,
+        fraud_detection_spark.py:56-91 / Q1). The spans locate each
+        message's raw string literal for zero-copy output framing
+        (stream/engine.py)."""
         encode_json = getattr(self.featurizer, "encode_json", None)
         if encode_json is None:
             return None
+        is_tree = self._fused_model is None
+        tree_binary = is_tree and self._tree_is_binary()
         parts: List[Tuple[object, int]] = []
         stats: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         for start in range(0, len(values), self.batch_size):
@@ -172,9 +182,15 @@ class ServingPipeline:
             if out is None:
                 return None
             enc, status, span_start, span_len = out
-            parts.append((self._dispatch_fused(enc), len(chunk)))
+            if is_tree:
+                parts.append((self._dispatch_tree(enc, tree_binary), len(chunk)))
+            else:
+                parts.append((self._dispatch_fused(enc), len(chunk)))
             stats.append((status, span_start, span_len))
-        pending = PendingPrediction(parts, threshold=self._fused_model.threshold)
+        pending = PendingPrediction(
+            parts,
+            threshold=0.5 if is_tree else self._fused_model.threshold,
+            argmax=is_tree and not tree_binary)
         if not stats:
             empty = np.empty(0, np.int32)
             return pending, empty, empty, empty
@@ -183,10 +199,32 @@ class ServingPipeline:
                 np.concatenate([s[1] for s in stats]),
                 np.concatenate([s[2] for s in stats]))
 
+    def _tree_is_binary(self) -> bool:
+        """Binary trees: p(class=1) > 0.5 equals argmax over the normalized
+        proba (ties -> class 0 both ways), so a 1-D fetch is exact."""
+        return isinstance(self.model, TreeEnsemble) and (
+            self.model.kind in ("gbt", "xgboost")  # boosted margins are binary
+            or self.model.leaf.shape[-1] == 2)
+
     def _dispatch_fused(self, enc) -> object:
         """Launch fused sparse LR scoring for one encoded chunk and start the
         async device->host fetch; shared by both predict paths."""
         p = linear_mod.prob_encoded(self._fused_model, enc)
+        copy_async = getattr(p, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()  # start the device->host fetch behind the dispatch
+        return p
+
+    def _dispatch_tree(self, enc, binary: bool) -> object:
+        """Launch fused scatter-to-dense + ensemble traversal for one encoded
+        chunk and start the async device->host fetch."""
+        if self._tree_idf is None:
+            # One upload, reused every chunk (idf_array() re-transfers
+            # host->device per call — poison on the latency-critical path).
+            self._tree_idf = self.featurizer.idf_array()
+        p = _tree_prob_encoded(self.model, jnp.asarray(enc.ids),
+                               jnp.asarray(enc.counts),
+                               self._tree_idf, binary)
         copy_async = getattr(p, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()  # start the device->host fetch behind the dispatch
@@ -203,13 +241,9 @@ class ServingPipeline:
         parts: List[Tuple[object, int]] = []
         threshold = 0.5
         argmax = False
-        # Binary trees: p(class=1) > 0.5 equals argmax over the normalized
-        # proba (ties -> class 0 both ways), so the 1-D fast path is exact.
         # Multiclass trees need the full (B, C) proba + host argmax — still
         # a single device->host fetch per chunk.
-        tree_binary = isinstance(self.model, TreeEnsemble) and (
-            self.model.kind in ("gbt", "xgboost")  # boosted margins are binary
-            or self.model.leaf.shape[-1] == 2)
+        tree_binary = self._tree_is_binary()
         for start in range(0, len(texts), self.batch_size):
             chunk = list(texts[start : start + self.batch_size])
             n = len(chunk)
@@ -238,17 +272,38 @@ class ServingPipeline:
         return int(batch.labels[0]), float(batch.probabilities[0])
 
 
+@partial(jax.jit, static_argnames=("binary",))
+def _tree_prob_encoded(ensemble: TreeEnsemble, ids, counts, idf, binary: bool):
+    """Hashed sparse rows -> dense TF-IDF -> ensemble traversal, one program
+    (the tree analogue of linear.prob_encoded, for the raw-JSON fast path)."""
+    proba = trees_mod.predict_proba(ensemble, tfidf_dense(ids, counts, idf))
+    return proba[:, 1] if binary else proba
+
+
 def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 7,
-                            num_features: int = 10000) -> ServingPipeline:
-    """Train a quick LR on the synthetic corpus — the shared demo/bench
-    fallback pipeline (one recipe, used by bench.py and app/serve.py)."""
+                            num_features: int = 10000,
+                            model: str = "lr") -> ServingPipeline:
+    """Train a quick model on the synthetic corpus — the shared demo/bench
+    fallback pipeline (one recipe, used by bench.py and app/serve.py).
+    ``model``: "lr" (default) | "dt" | "rf" | "xgb"."""
     from fraud_detection_tpu.data import generate_corpus
     from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+    from fraud_detection_tpu.models.train_trees import (
+        fit_decision_tree, fit_gradient_boosting, fit_random_forest)
 
     corpus = generate_corpus(n=n, seed=seed)
     feat = HashingTfIdfFeaturizer(num_features=num_features)
     feat.fit_idf([d.text for d in corpus])
     X = np.asarray(feat.featurize_dense([d.text for d in corpus]))
     y = np.asarray([d.label for d in corpus], np.float32)
-    model = fit_logistic_regression(X, y, max_iter=50)
-    return ServingPipeline(feat, model, batch_size=batch_size)
+    if model == "lr":
+        clf = fit_logistic_regression(X, y, max_iter=50)
+    elif model == "dt":
+        clf = fit_decision_tree(X, y)
+    elif model == "rf":
+        clf = fit_random_forest(X, y, n_trees=20)
+    elif model == "xgb":
+        clf = fit_gradient_boosting(X, y, n_rounds=20)
+    else:
+        raise ValueError(f"unknown demo model {model!r}")
+    return ServingPipeline(feat, clf, batch_size=batch_size)

@@ -171,15 +171,20 @@ def histogram_reference(bins, local, stats, *, n_nodes: int, n_bins: int) -> jax
 def _gain_kernel(hist_ref, total_ref, best_idx_ref, best_gain_ref, *,
                  n_bins: int, n_stats: int, criterion: str, reg_lambda: float,
                  min_child_weight: float):
-    """One node: cumulative-left stats, impurity gain, argmax over (F, NB-1).
+    """One (node, feature-tile) cell: cumulative-left stats, impurity gain,
+    argmax over the tile's (Ft, NB-1) candidates.
 
-    All intermediates are 2D (F, NB) per statistic — Mosaic has no minor-dim
-    reshape, so the K statistics arrive pre-sliced on a leading axis and the
-    bin-cumsum is an upper-triangular matmul (MXU work; exact for the 0/1 and
-    small-count magnitudes involved). Totals ride in SMEM as scalars. The
-    flat argmax is recovered as min(position where gain == max), matching
-    XLA's first-occurrence argmax tie rule in (F, NB-1) row-major order.
+    All intermediates are 2D (Ft, NB) per statistic — Mosaic has no
+    minor-dim reshape, so the K statistics arrive pre-sliced on a leading
+    axis and the bin-cumsum is an upper-triangular matmul (MXU work; exact
+    for the 0/1 and small-count magnitudes involved). Totals ride in SMEM as
+    scalars. The per-tile argmax is recovered as min(position where gain ==
+    max), matching XLA's first-occurrence argmax tie rule in row-major
+    order; the host wrapper reduces across tiles (features are tiled so huge
+    F doesn't overflow VMEM — the whole (F, NB, K) slab at F=10000 needs
+    >30MB of intermediates).
     """
+    f_idx = pl.program_id(1)
     nb = n_bins
     # inclusive prefix over bins: left = hist @ upper_tri  (NB, NB)
     tri_r = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
@@ -230,14 +235,15 @@ def _gain_kernel(hist_ref, total_ref, best_idx_ref, best_gain_ref, *,
     in_range = col < nb - 1                              # last bin: no right side
     gain = jnp.where(valid & in_range, gain, -jnp.inf)
     best = jnp.max(gain)
-    pos = row * (nb - 1) + col
+    pos = row * (nb - 1) + col                           # tile-local position
     pos = jnp.where((gain == best) & in_range, pos, jnp.int32(2**30))
-    best_idx_ref[0, 0, 0] = jnp.min(pos)
-    best_gain_ref[0, 0, 0] = best
+    best_idx_ref[0, 0, f_idx] = jnp.min(pos)
+    best_gain_ref[0, 0, f_idx] = best
 
 
 @partial(jax.jit, static_argnames=("criterion", "n_bins", "reg_lambda",
-                                   "min_child_weight", "interpret"))
+                                   "min_child_weight", "feature_tile",
+                                   "interpret"))
 def best_splits(
     hist: jax.Array,       # (L, F, NB, K)
     totals: jax.Array,     # (L, K)
@@ -246,31 +252,53 @@ def best_splits(
     n_bins: int = 32,
     reg_lambda: float = 1.0,
     min_child_weight: float = 1e-6,
+    feature_tile: int = 1024,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Per node: (best_feature, best_bin, best_gain) fused on the VPU."""
+    """Per node: (best_feature, best_bin, best_gain) fused on the VPU.
+
+    Features are processed in tiles of ``feature_tile``; each grid cell emits
+    its tile's (first-occurrence) best, and a cheap XLA reduction combines
+    tiles — argmax over tile bests picks the lowest tile on ties, which
+    together with the in-tile min-position rule reproduces XLA's flat
+    row-major first-occurrence argmax exactly.
+    """
     L, F, NB, K = hist.shape
+    ft = min(feature_tile, F)
+    f_pad = _round_up(F, ft)
     hist_k = hist.transpose(0, 3, 1, 2)                  # (L, K, F, NB)
+    if f_pad != F:
+        # Padded features carry all-zero stats: empty children/hessians make
+        # every candidate invalid (-inf), so padding never wins.
+        hist_k = jnp.pad(hist_k, ((0, 0), (0, 0), (0, f_pad - F), (0, 0)))
+    n_tiles = f_pad // ft
     totals3 = totals.reshape(L, 1, K)
-    idx, gain = pl.pallas_call(
+    idx_t, gain_t = pl.pallas_call(
         partial(_gain_kernel, n_bins=NB, n_stats=K, criterion=criterion,
                 reg_lambda=reg_lambda, min_child_weight=min_child_weight),
-        grid=(L,),
+        grid=(L, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, K, F, NB), lambda l: (l, 0, 0, 0),
+            pl.BlockSpec((1, K, ft, NB), lambda l, fi: (l, 0, fi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, K), lambda l: (l, 0, 0),
+            pl.BlockSpec((1, 1, K), lambda l, fi: (l, 0, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, 1), lambda l: (l, 0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, 1), lambda l: (l, 0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, n_tiles), lambda l, fi: (l, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, n_tiles), lambda l, fi: (l, 0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((L, 1, 1), jnp.int32),
-            jax.ShapeDtypeStruct((L, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((L, 1, n_tiles), jnp.int32),
+            jax.ShapeDtypeStruct((L, 1, n_tiles), jnp.float32),
         ],
         interpret=interpret,
     )(hist_k, totals3)
-    idx = idx[:, 0, 0]
-    return (idx // (NB - 1)).astype(jnp.int32), (idx % (NB - 1)).astype(jnp.int32), gain[:, 0, 0]
+    idx_t = idx_t[:, 0, :]                               # (L, T) tile-local pos
+    gain_t = gain_t[:, 0, :]                             # (L, T)
+    t_star = jnp.argmax(gain_t, axis=1)                  # ties -> lowest tile
+    best_gain = jnp.take_along_axis(gain_t, t_star[:, None], 1)[:, 0]
+    idx = jnp.take_along_axis(idx_t, t_star[:, None], 1)[:, 0]
+    best_f = t_star.astype(jnp.int32) * ft + (idx // (NB - 1)).astype(jnp.int32)
+    return best_f, (idx % (NB - 1)).astype(jnp.int32), best_gain

@@ -141,8 +141,9 @@ class StreamingClassifier:
         self._running = False
         self._flush_failed = False
         # Raw-JSON fast path: None = untried, False = unavailable (no native
-        # library / tree model / vocab featurizer), True = in use. The explain
-        # hook needs decoded text, so it forces the slow path.
+        # library / vocab featurizer), True = in use (LR and tree models
+        # both ride it). The explain hook needs decoded text, so it forces
+        # the slow path.
         self._json_fast: Optional[bool] = None if explain_fn is None else False
         # The engine is single-driver by contract: stats, consumer position,
         # and in-flight state all assume one thread runs the loop. stop() is

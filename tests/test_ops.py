@@ -88,6 +88,31 @@ def test_gain_scan_matches_xla(criterion):
     np.testing.assert_allclose(np.asarray(bg), want_gain, rtol=1e-5, atol=1e-6)
 
 
+def test_gain_scan_tiled_features_matches_flat():
+    """feature_tile < F (with ragged padding) must reproduce the flat
+    first-occurrence argmax exactly — the two-stage tile reduction is the
+    VMEM guard for 10k-feature pipelines."""
+    from fraud_detection_tpu.models.train_trees import _xgb_gain
+
+    rng = np.random.default_rng(5)
+    L, F, NB = 3, 50, 8
+    hist = jnp.asarray(np.concatenate(
+        [rng.normal(size=(L, F, NB, 1)),
+         rng.uniform(0.1, 1, (L, F, NB, 1)),
+         rng.integers(1, 5, (L, F, NB, 1))], axis=-1).astype(np.float32))
+    totals = hist[:, 0].sum(axis=1)
+    bf, bb, bg = best_splits(hist, totals, criterion="xgb", n_bins=NB,
+                             feature_tile=16, interpret=True)  # 4 tiles, ragged
+    cum = jnp.cumsum(hist, axis=2)
+    gain = _xgb_gain(cum, totals[:, None, None, :], 1.0, 1e-6)[:, :, : NB - 1]
+    flat = np.asarray(gain.reshape(L, -1))
+    want = flat.argmax(axis=1)
+    np.testing.assert_array_equal(np.asarray(bf), want // (NB - 1))
+    np.testing.assert_array_equal(np.asarray(bb), want % (NB - 1))
+    np.testing.assert_allclose(np.asarray(bg), flat[np.arange(L), want],
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_tree_built_with_pallas_matches_xla_path():
     from fraud_detection_tpu.models import trees as trees_mod
     from fraud_detection_tpu.models.train_trees import TreeTrainConfig, fit_decision_tree

@@ -310,6 +310,37 @@ def test_raw_json_fast_path_matches_slow_path(pipeline):
             assert abs(f["confidence"] - s["confidence"]) < 1e-6, k
 
 
+@pytest.mark.parametrize("model", ["dt", "xgb"])
+def test_raw_json_fast_path_matches_slow_path_trees(model):
+    """Tree ensembles ride the raw-JSON path too (native encode -> on-device
+    scatter to dense -> traversal): outputs must match the json.loads slow
+    path exactly, same as the LR pipeline."""
+    from fraud_detection_tpu.data import generate_corpus
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    pipe = synthetic_demo_pipeline(batch_size=32, n=200, seed=11,
+                                   num_features=2048, model=model)
+    corpus = generate_corpus(n=40, seed=31)
+    values = [json.dumps({"text": d.text, "id": i}).encode()
+              for i, d in enumerate(corpus)]
+    values[5] = b'broken'
+
+    fast_engine, fast_stats, fast = _run_engine(pipe, values)
+    if fast_engine._json_fast is not True:
+        pytest.skip("native JSON path unavailable in this environment")
+    slow_engine, slow_stats, slow = _run_engine(pipe, values, force_slow=True)
+
+    assert fast_stats.processed == slow_stats.processed == 40
+    assert fast_stats.malformed == slow_stats.malformed == 1
+    assert fast.keys() == slow.keys()
+    for k in fast:
+        f, s = fast[k], slow[k]
+        assert f.get("prediction") == s.get("prediction"), k
+        assert f.get("original_text") == s.get("original_text"), k
+        if f.get("prediction") is not None:
+            assert abs(f["confidence"] - s["confidence"]) < 1e-6, k
+
+
 def test_raw_json_fast_path_strict_rejection_falls_back(pipeline):
     """A message the native scanner rejects but json.loads accepts (escaped
     key) must still be scored — the engine falls back to the slow path for
