@@ -2,6 +2,7 @@ from fraud_detection_tpu.checkpoint.spark_artifact import (
     SparkPipelineArtifact,
     load_spark_pipeline,
 )
+from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
 from fraud_detection_tpu.checkpoint.spark_writer import save_spark_pipeline
 from fraud_detection_tpu.checkpoint.train_state import (
     load_train_state,
@@ -9,4 +10,4 @@ from fraud_detection_tpu.checkpoint.train_state import (
 )
 
 __all__ = ["SparkPipelineArtifact", "load_spark_pipeline", "save_spark_pipeline",
-           "load_train_state", "save_train_state"]
+           "load_train_state", "save_train_state", "load_hf_checkpoint"]

@@ -49,3 +49,14 @@ class OnPodBackend(_GenerateMixin):
                                     max_new_tokens=max_tokens, mesh=mesh)
 
         return cls(generate_fn)
+
+    @classmethod
+    def from_hf_checkpoint(cls, ckpt_dir: str, *, mesh=None,
+                           max_seq: int = 4096) -> "OnPodBackend":
+        """Serve a locally downloaded HF checkpoint directory on-pod — the
+        zero-egress replacement for the reference's hosted DeepSeek call
+        (utils/agent_api.py:36; converter: checkpoint/hf_convert.py)."""
+        from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
+
+        lm = load_hf_checkpoint(ckpt_dir, max_seq=max_seq, mesh=mesh)
+        return cls.from_model(lm, mesh=mesh)

@@ -18,9 +18,13 @@ pure-functional JAX over a params pytree so the same forward runs
     traffic fully overlapped block math.
 
 The byte-level tokenizer keeps the model self-contained (no vocab downloads,
-zero egress); real pretrained weights can be converted into the same pytree
-layout offline.  ``LanguageModel.generate_text`` plugs into the explanation
-layer through ``explain.onpod.OnPodBackend.from_model``.
+zero egress); real pretrained weights convert into this exact pytree layout
+via ``checkpoint/hf_convert.py`` (HF safetensors -> Params, incl. GQA/MQA,
+untied heads, and Gemma's norm/scale/GeGLU quirks — verified against an
+independent numpy forward in tests/test_hf_convert.py).
+``LanguageModel.generate_text`` plugs into the explanation layer through
+``explain.onpod.OnPodBackend.from_model`` /
+``OnPodBackend.from_hf_checkpoint``.
 """
 
 from __future__ import annotations
@@ -51,10 +55,22 @@ class TransformerConfig:
     max_seq: int = 2048
     rope_theta: float = 10000.0
     dtype: jnp.dtype = jnp.float32  # bfloat16 on real TPU runs
+    # --- pretrained-checkpoint surface (checkpoint/hf_convert.py) ---
+    n_kv_heads: Optional[int] = None   # < n_heads = GQA; 1 = MQA (Gemma-2B)
+    head_dim_override: Optional[int] = None  # Gemma: head_dim != D/H
+    activation: str = "silu"           # "silu" | "gelu" (Gemma's GeGLU tanh)
+    embed_scale: float = 1.0           # Gemma scales embeddings by sqrt(D)
+    tie_embeddings: bool = True        # False = separate "lm_head" param
+    rms_eps: float = 1e-6
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return (self.head_dim_override if self.head_dim_override is not None
+                else self.d_model // self.n_heads)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
 
     BOS: int = field(default=256, init=False)
     EOS: int = field(default=257, init=False)
@@ -66,19 +82,23 @@ class TransformerConfig:
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
     """Random-init parameter pytree. Layout (per layer l):
-    wq/wk/wv (D, H, d), wo (H, d, D), w_gate/w_up (D, F), w_down (F, D),
-    ln1/ln2 (D,), plus embed (V, D) and ln_f (D,). Output head ties embed."""
-    keys = jax.random.split(rng, cfg.n_layers * 7 + 1)
+    wq (D, H, d), wk/wv (D, Hkv, d), wo (H, d, D), w_gate/w_up (D, F),
+    w_down (F, D), ln1/ln2 (D,), plus embed (V, D) and ln_f (D,). The output
+    head ties embed unless cfg.tie_embeddings=False adds "lm_head" (V, D)."""
+    keys = jax.random.split(rng, cfg.n_layers * 7 + 2)
     scale = 1.0 / math.sqrt(cfg.d_model)
     p: Params = {
         "embed": (jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model)) * scale
                   ).astype(cfg.dtype)}
-    h, d = cfg.n_heads, cfg.head_dim
+    if not cfg.tie_embeddings:
+        p["lm_head"] = (jax.random.normal(
+            keys[-1], (cfg.vocab_size, cfg.d_model)) * scale).astype(cfg.dtype)
+    h, hkv, d = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     for l in range(cfg.n_layers):
         k = keys[1 + l * 7 : 1 + (l + 1) * 7]
         p[f"l{l}.wq"] = (jax.random.normal(k[0], (cfg.d_model, h, d)) * scale).astype(cfg.dtype)
-        p[f"l{l}.wk"] = (jax.random.normal(k[1], (cfg.d_model, h, d)) * scale).astype(cfg.dtype)
-        p[f"l{l}.wv"] = (jax.random.normal(k[2], (cfg.d_model, h, d)) * scale).astype(cfg.dtype)
+        p[f"l{l}.wk"] = (jax.random.normal(k[1], (cfg.d_model, hkv, d)) * scale).astype(cfg.dtype)
+        p[f"l{l}.wv"] = (jax.random.normal(k[2], (cfg.d_model, hkv, d)) * scale).astype(cfg.dtype)
         p[f"l{l}.wo"] = (jax.random.normal(k[3], (h, d, cfg.d_model)) * scale).astype(cfg.dtype)
         p[f"l{l}.w_gate"] = (jax.random.normal(k[4], (cfg.d_model, cfg.d_ff)) * scale).astype(cfg.dtype)
         p[f"l{l}.w_up"] = (jax.random.normal(k[5], (cfg.d_model, cfg.d_ff)) * scale).astype(cfg.dtype)
@@ -97,10 +117,16 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, NamedShardi
     rep = NamedSharding(mesh, P())
     for name in ("embed", "ln_f"):
         s[name] = rep
+    if not cfg.tie_embeddings:
+        s["lm_head"] = rep
+    # GQA: when the kv-head count doesn't divide over the model axis (MQA has
+    # a single kv head), replicate k/v — the Megatron convention.
+    kv_spec = (P(None, MODEL_AXIS, None)
+               if cfg.kv_heads % mesh.shape[MODEL_AXIS] == 0 else P())
     for l in range(cfg.n_layers):
         s[f"l{l}.wq"] = NamedSharding(mesh, P(None, MODEL_AXIS, None))
-        s[f"l{l}.wk"] = NamedSharding(mesh, P(None, MODEL_AXIS, None))
-        s[f"l{l}.wv"] = NamedSharding(mesh, P(None, MODEL_AXIS, None))
+        s[f"l{l}.wk"] = NamedSharding(mesh, kv_spec)
+        s[f"l{l}.wv"] = NamedSharding(mesh, kv_spec)
         s[f"l{l}.wo"] = NamedSharding(mesh, P(MODEL_AXIS, None, None))
         s[f"l{l}.w_gate"] = NamedSharding(mesh, P(None, MODEL_AXIS))
         s[f"l{l}.w_up"] = NamedSharding(mesh, P(None, MODEL_AXIS))
@@ -120,6 +146,9 @@ def shard_params(params: Params, cfg: TransformerConfig, mesh: Mesh) -> Params:
 # ---------------------------------------------------------------------------
 
 def rms_norm(x: jax.Array, gamma: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """Plain RMSNorm. Gemma's (1 + w) convention is folded into gamma at
+    checkpoint-conversion time (checkpoint/hf_convert.py), not special-cased
+    here."""
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * gamma
 
@@ -235,10 +264,18 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale != 1.0:  # Gemma scales embeddings by sqrt(D)
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
     new_cache: Optional[Dict[str, jax.Array]] = {} if kv_cache is not None else None
+    act = jax.nn.silu if cfg.activation == "silu" else partial(
+        jax.nn.gelu, approximate=True)
+    rep = cfg.n_heads // cfg.kv_heads  # GQA: queries per kv head
+
+    def expand_kv(t):
+        return t if rep == 1 else jnp.repeat(t, rep, axis=2)
 
     for l in range(cfg.n_layers):
-        h = rms_norm(x, params[f"l{l}.ln1"])
+        h = rms_norm(x, params[f"l{l}.ln1"], cfg.rms_eps)
         q = jnp.einsum("btD,Dhd->bthd", h, params[f"l{l}.wq"])
         k = jnp.einsum("btD,Dhd->bthd", h, params[f"l{l}.wk"])
         v = jnp.einsum("btD,Dhd->bthd", h, params[f"l{l}.wv"])
@@ -247,6 +284,8 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
         if kv_cache is not None:
             # decode: append this step's k/v at cache_len, attend over prefix
+            # (cache stays at Hkv width — the GQA memory win — and expands
+            # only for the score einsum)
             ck = jax.lax.dynamic_update_slice(
                 kv_cache[f"l{l}.k"], k, (0, cache_len, 0, 0))
             cv = jax.lax.dynamic_update_slice(
@@ -255,25 +294,26 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             S = ck.shape[1]
             # causal within the appended block: row t sees keys <= cache_len+t
             valid = jnp.arange(S)[None, :] <= (cache_len + jnp.arange(T))[:, None]
-            attn = _attend(q, ck, cv, valid)
+            attn = _attend(q, expand_kv(ck), expand_kv(cv), valid)
         elif seq_mesh is not None:
-            attn = ring_attention(q, k, v, seq_mesh)
+            attn = ring_attention(q, expand_kv(k), expand_kv(v), seq_mesh)
         else:
             causal = jnp.tril(jnp.ones((T, T), bool))
-            attn = _attend(q, k, v, causal)
+            attn = _attend(q, expand_kv(k), expand_kv(v), causal)
 
         x = x + jnp.einsum("bthd,hdD->btD", attn, params[f"l{l}.wo"])
-        h2 = rms_norm(x, params[f"l{l}.ln2"])
-        gate = jax.nn.silu(h2 @ params[f"l{l}.w_gate"])
+        h2 = rms_norm(x, params[f"l{l}.ln2"], cfg.rms_eps)
+        gate = act(h2 @ params[f"l{l}.w_gate"])
         x = x + (gate * (h2 @ params[f"l{l}.w_up"])) @ params[f"l{l}.w_down"]
 
-    x = rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("btD,VD->btV", x, params["embed"]).astype(jnp.float32)
+    x = rms_norm(x, params["ln_f"], cfg.rms_eps)
+    head = params["lm_head"] if not cfg.tie_embeddings else params["embed"]
+    logits = jnp.einsum("btD,VD->btV", x, head).astype(jnp.float32)
     return logits, new_cache
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict[str, jax.Array]:
-    return {f"l{l}.{t}": jnp.zeros((batch, max_len, cfg.n_heads, cfg.head_dim), cfg.dtype)
+    return {f"l{l}.{t}": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
             for l in range(cfg.n_layers) for t in ("k", "v")}
 
 
