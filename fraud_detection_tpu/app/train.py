@@ -224,7 +224,7 @@ def main(argv=None) -> int:
 
         import jax
 
-        from fraud_detection_tpu.models.train_trees import _resolve_cfg
+        from fraud_detection_tpu.models.train_trees import resolve_config
 
         def de_nan(v):
             # Undefined metrics (single-class AUC) must serialize as null:
@@ -240,10 +240,12 @@ def main(argv=None) -> int:
                        "test": len(test)},
             "backend": jax.default_backend(),
             "mesh": dict(mesh.shape) if mesh is not None else None,
-            # the EFFECTIVE kernel path (a mesh forces the XLA path)
-            "use_pallas": bool(_resolve_cfg(cfg, mesh).use_pallas),
             "train_seconds": timings,
         }
+        if any(m in chosen for m in ("dt", "rf", "xgb")):
+            # the EFFECTIVE tree-kernel path (a mesh forces the XLA path);
+            # meaningless — and omitted — for LR-only runs
+            meta["use_pallas"] = bool(resolve_config(cfg, mesh).use_pallas)
         if args.featurizer == "count":
             meta["vocab_size"] = args.vocab_size
         else:

@@ -29,7 +29,7 @@ Distribution: with inputs sharded over the mesh "data" axis, the per-level
 segment-sums reduce across chips (XLA inserts the psum) — exactly the
 gradient-histogram allreduce XGBoost does over Rabit, riding ICI instead
 (the Pallas path is forced off under a mesh: pallas_call has no SPMD
-partitioning rule — see _resolve_cfg).
+partitioning rule — see resolve_config).
 """
 
 from __future__ import annotations
@@ -285,7 +285,7 @@ def _edges_to_thresholds(edges: np.ndarray, feature: np.ndarray, split_bin: np.n
 # Public trainers
 # ---------------------------------------------------------------------------
 
-def _resolve_cfg(config: Optional[TreeTrainConfig], mesh,
+def resolve_config(config: Optional[TreeTrainConfig], mesh,
                  **defaults) -> TreeTrainConfig:
     """Trainer-entry config resolution. With a mesh, the Pallas path is
     forced OFF: pallas_call has no SPMD partitioning rule, so GSPMD would
@@ -345,7 +345,7 @@ def fit_decision_tree(
     edges: Optional[np.ndarray] = None, mesh=None,
 ) -> TreeEnsemble:
     """Gini decision tree (Spark DecisionTreeClassifier semantics, maxBins binning)."""
-    cfg = _resolve_cfg(config, mesh)
+    cfg = resolve_config(config, mesh)
     edges, bins, _, stats, weights, _ = _prepare_inputs(X, y, num_classes, cfg, edges, mesh)
     dummy_keys = jax.random.split(jax.random.PRNGKey(0), cfg.max_depth + 1)
     feat, sbin, left, right, node_stats = _build_tree_jit(
@@ -376,7 +376,7 @@ def fit_random_forest(
     ``fold_in(root, start)`` — a pure function of (seed, start) — so resumed
     forests are bit-identical to uninterrupted ones.
     """
-    cfg = _resolve_cfg(config, mesh)
+    cfg = resolve_config(config, mesh)
     edges, bins, _, stats, base_weights, n = _prepare_inputs(
         X, y, num_classes, cfg, edges, mesh)
     n_padded = bins.shape[0]
@@ -480,7 +480,7 @@ def fit_gradient_boosting(
     equals an uninterrupted run's. A snapshot taken under a different
     config/data refuses to load.
     """
-    cfg = _resolve_cfg(config, mesh, criterion="xgb")
+    cfg = resolve_config(config, mesh, criterion="xgb")
     if cfg.criterion != "xgb":
         cfg = TreeTrainConfig(**{**cfg.__dict__, "criterion": "xgb"})
     if base_score is None:

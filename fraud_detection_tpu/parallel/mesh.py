@@ -123,24 +123,45 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
 
 
 def make_hybrid_mesh(feature_parallel: int = 1) -> Mesh:
-    """DCN×ICI-aware (data, feature) mesh for multi-host pods.
+    """DCN×ICI-aware (data, feature) mesh that works on every topology.
 
-    Layout follows the standard scaling recipe: the data axis spans hosts
-    (its psums tolerate DCN latency — one small histogram/gradient reduction
-    per step), while feature parallelism stays inside a host so its tighter
-    collectives ride ICI. Single-process jobs fall back to ``make_mesh``.
+    Layout follows the standard scaling recipe: the data axis spans the
+    slowest link (its psums tolerate latency — one small histogram/gradient
+    reduction per step), while feature parallelism stays inside a granule so
+    its tighter collectives ride ICI.
+
+    The DCN granularity is the number of SLICES, not processes: a
+    single-slice multi-host pod is all-ICI (and CPU test meshes report one
+    granule), so only a genuinely multi-slice/multi-granule job takes the
+    ``create_hybrid_device_mesh`` path — sizing it by ``process_count`` (the
+    obvious mistake) breaks both single-slice pods and multi-process CPU
+    testing, which is exactly what the 2-process regression test checks.
     """
-    if jax.process_count() == 1:
-        return make_mesh(feature_parallel=feature_parallel)
+    devs = jax.devices()
+    granules: dict = {}
+    for d in devs:
+        granules.setdefault(
+            getattr(d, "slice_index", d.process_index), []).append(d)
+    if len(granules) == 1:
+        # one granule: plain global mesh (jax.devices() is process-major, so
+        # the data axis still spans hosts in a multi-host single-slice pod)
+        return make_mesh(feature_parallel=feature_parallel, devices=devs)
+    sizes = {len(v) for v in granules.values()}
+    if len(sizes) != 1:
+        raise ValueError(f"uneven device granules: {sorted(sizes)}")
     from jax.experimental import mesh_utils
 
-    local = jax.local_device_count()
+    local = sizes.pop()
     if local % feature_parallel:
         raise ValueError(
-            f"{local} local devices not divisible by feature_parallel={feature_parallel}")
+            f"{local} per-granule devices not divisible by "
+            f"feature_parallel={feature_parallel}")
     grid = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=(local // feature_parallel, feature_parallel),
-        dcn_mesh_shape=(jax.process_count(), 1))
+        dcn_mesh_shape=(len(granules), 1),
+        # our granule fallback keys by process_index when slice_index is
+        # absent; tell mesh_utils the same, or it raises on such platforms
+        process_is_granule=not hasattr(devs[0], "slice_index"))
     return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
 
 

@@ -167,18 +167,4 @@ def test_device_trace_noop_without_dir(monkeypatch):
         pass  # must not require jax import or profiler state
 
 
-def test_mesh_multi_host_helpers():
-    """Single-process fallbacks for the multi-host (DCN) mesh API."""
-    import numpy as np
-
-    from fraud_detection_tpu.parallel import (
-        global_batch_from_local, initialize_distributed, make_hybrid_mesh)
-
-    # No coordinator configured -> no-op, single process.
-    assert initialize_distributed() is False
-    mesh = make_hybrid_mesh()
-    assert mesh.shape["data"] >= 1
-    x = np.arange(mesh.shape["data"] * 3, dtype=np.float32).reshape(-1, 3)
-    g = global_batch_from_local(x, mesh)
-    assert g.shape == x.shape
-    np.testing.assert_allclose(np.asarray(g), x)
+# multi-host (DCN) mesh helper coverage lives in tests/test_mesh_multihost.py
