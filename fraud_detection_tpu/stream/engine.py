@@ -25,6 +25,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+import numpy as np
+
 from fraud_detection_tpu.explain.prompts import label_name
 from fraud_detection_tpu.models.pipeline import ServingPipeline
 from fraud_detection_tpu.stream.broker import Consumer, Message, Producer
@@ -199,14 +201,18 @@ class StreamingClassifier:
         self._json_fast = True
         pending, status, span_start, span_len = fast
         literals: List[Optional[bytes]] = [None] * len(msgs)
-        valid_idx: List[int] = []
-        for i, ok in enumerate(status):
-            if ok:
-                valid_idx.append(i)
-                s = span_start[i]
-                literals[i] = msgs[i].value[s : s + span_len[i]]
-            elif self._decode(msgs[i]) is not None:
-                return None  # stricter-than-json.loads rejection: slow path
+        # Bulk numpy->python conversion: per-element numpy indexing costs
+        # ~0.1us each and this loop runs per message at 50k+/sec.
+        valid_idx = np.flatnonzero(status).tolist()
+        if len(valid_idx) != len(msgs):
+            for i in np.flatnonzero(status == 0).tolist():
+                if self._decode(msgs[i]) is not None:
+                    return None  # stricter-than-json.loads: slow path
+        starts = span_start.tolist()
+        lens = span_len.tolist()
+        for i in valid_idx:
+            s = starts[i]
+            literals[i] = msgs[i].value[s : s + lens[i]]
         return _InFlight(msgs, literals, valid_idx, pending, offsets,
                          time.perf_counter() - t0, raw=True)
 
@@ -218,13 +224,20 @@ class StreamingClassifier:
         preds = inflight.pending.resolve() if inflight.pending is not None else None
 
         results: List[Optional[tuple]] = [None] * len(msgs)
-        if inflight.raw:
-            # Raw-JSON mode: predictions cover all rows positionally.
-            for i in inflight.valid_idx:
-                results[i] = (int(preds.labels[i]), float(preds.probabilities[i]))
-        else:
-            for j, i in enumerate(inflight.valid_idx):
-                results[i] = (int(preds.labels[j]), float(preds.probabilities[j]))
+        if preds is not None:
+            # Bulk numpy->python conversion (tolist) and vectorized
+            # confidence, not per-element int()/float()/branching: this is
+            # the per-message hot loop.
+            labels = preds.labels.tolist()
+            confs = np.where(preds.labels == 1, preds.probabilities,
+                             1.0 - preds.probabilities).tolist()
+            if inflight.raw:
+                # Raw-JSON mode: predictions cover all rows positionally.
+                for i in inflight.valid_idx:
+                    results[i] = (labels[i], confs[i])
+            else:
+                for j, i in enumerate(inflight.valid_idx):
+                    results[i] = (labels[j], confs[j])
 
         wires: List[tuple] = []
         for msg, text, res in zip(msgs, texts, results):
@@ -234,8 +247,7 @@ class StreamingClassifier:
                        "original": msg.value.decode("utf-8", "replace")[:500]}
                 wire = json.dumps(out).encode()
             else:
-                label, p1 = res
-                confidence = p1 if label == 1 else 1.0 - p1
+                label, confidence = res  # confidence precomputed vectorized
                 # Same field semantics as FraudAnalysisAgent.predict_and_get_label:
                 # prediction = int class, label = display name.
                 if inflight.raw:
