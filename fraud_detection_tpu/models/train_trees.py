@@ -116,6 +116,66 @@ def _xgb_gain(left: jax.Array, total: jax.Array, lam: float, min_child_weight: f
     return jnp.where(valid, gain, -jnp.inf)
 
 
+
+
+def _feature_mask(mask_keys_level, width: int, f: int):
+    """Per-node Bernoulli feature subsets (expected size sqrt(F)), batched
+    over a leading tree axis: mask_keys_level (T, key) -> (T, width, f)."""
+    p_keep = jnp.sqrt(jnp.float32(f)) / f
+    mask = jax.vmap(
+        lambda key: jax.random.bernoulli(key, p_keep, (width, f))
+    )(mask_keys_level)
+    # Bias-free fallback: a node that drew an empty subset (probability
+    # ~(1-p)^F, astronomically rare) considers all features.
+    empty = ~mask.any(axis=2)
+    return mask | empty[:, :, None]
+
+
+def _select_splits(hist, totals, mask, cfg: TreeTrainConfig):
+    """XLA split selection for one level, batched over a leading tree axis.
+
+    hist (T, L, F, NB, K) statistics; totals (T, L, K); mask (T, L, F) bool
+    feature subsets or None. Returns (best_f, best_b, best_gain), each
+    (T, L) — flat first-occurrence argmax over (F, NB-1) per node.
+    """
+    nb = cfg.n_bins
+    cum = jnp.cumsum(hist, axis=3)                        # left stats per bin
+    total_b = totals[:, :, None, None, :]
+    if cfg.criterion == "gini":
+        gain = _gini_gain(cum, total_b)                   # (T, L, F, NB)
+    else:
+        gain = _xgb_gain(cum, total_b, cfg.reg_lambda, cfg.min_child_weight)
+    gain = gain[..., : nb - 1]                            # last bin: no right side
+    if mask is not None:
+        gain = jnp.where(mask[:, :, :, None], gain, -jnp.inf)
+    t, width = gain.shape[:2]
+    flat = gain.reshape(t, width, -1)
+    best = jnp.argmax(flat, axis=2)
+    best_gain = jnp.take_along_axis(flat, best[:, :, None], axis=2)[:, :, 0]
+    return ((best // (nb - 1)).astype(jnp.int32),
+            (best % (nb - 1)).astype(jnp.int32), best_gain)
+
+
+def _route_rows(bins, local, seg_valid, node, best_f, best_b, do_split,
+                width: int):
+    """Row re-routing for one level, batched over a leading tree axis:
+    gather each row's node's chosen split, compare bin ids, descend.
+    Rows whose node became a leaf stop descending and drop out of deeper
+    histograms (their prediction lives at the marked leaf).
+    local/seg_valid/node (T, N); best_f/best_b/do_split (T, L).
+    Returns (node, active), each (T, N)."""
+    row_local = jnp.clip(local, 0, width - 1)
+    row_f = jnp.take_along_axis(best_f, row_local, axis=1)
+    row_b = jnp.take_along_axis(best_b, row_local, axis=1)
+    row_split = jnp.take_along_axis(do_split, row_local, axis=1)
+    row_bin = jax.vmap(
+        lambda rf: jnp.take_along_axis(bins, rf[:, None], axis=1)[:, 0])(row_f)
+    go_left = row_bin <= row_b
+    new_node = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+    node = jnp.where(seg_valid & row_split, new_node, node)
+    return node, seg_valid & row_split
+
+
 # ---------------------------------------------------------------------------
 # Single-tree level-wise builder (jit-unrolled over levels)
 # ---------------------------------------------------------------------------
@@ -204,28 +264,10 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
                 reg_lambda=cfg.reg_lambda, min_child_weight=cfg.min_child_weight,
                 interpret=auto_interpret())
         else:
-            cum = jnp.cumsum(hist, axis=2)                           # left stats per bin
-            total_b = totals[:, None, None, :]
-            if cfg.criterion == "gini":
-                gain = _gini_gain(cum, total_b)                      # (L, F, NB)
-            else:
-                gain = _xgb_gain(cum, total_b, cfg.reg_lambda, cfg.min_child_weight)
-            gain = gain[:, :, : nb - 1]                              # last bin: no right side
-
-            if feature_mask_keys is not None:
-                p_keep = jnp.sqrt(jnp.float32(f)) / f
-                mask = jax.random.bernoulli(feature_mask_keys[level], p_keep, (width, f))
-                # Bias-free fallback: a node that drew an empty subset (probability
-                # ~(1-p)^F, astronomically rare) considers all features.
-                empty = ~mask.any(axis=1)
-                mask = mask | empty[:, None]
-                gain = jnp.where(mask[:, :, None], gain, -jnp.inf)
-
-            flat = gain.reshape(width, -1)
-            best = jnp.argmax(flat, axis=1)
-            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-            best_f = (best // (nb - 1)).astype(jnp.int32)
-            best_b = (best % (nb - 1)).astype(jnp.int32)
+            mask = (None if feature_mask_keys is None
+                    else _feature_mask(feature_mask_keys[level][None], width, f))
+            bf, bb, bg = _select_splits(hist[None], totals[None], mask, cfg)
+            best_f, best_b, best_gain = bf[0], bb[0], bg[0]
         do_split = best_gain > cfg.min_info_gain
 
         pos = offset + jnp.arange(width)
@@ -234,18 +276,10 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
         left_child = left_child.at[pos].set(jnp.where(do_split, 2 * pos + 1, -1))
         right_child = right_child.at[pos].set(jnp.where(do_split, 2 * pos + 2, -1))
 
-        # Route rows: gather their node's chosen split, compare bin ids.
-        row_local = jnp.clip(local, 0, width - 1)
-        row_f = best_f[row_local]
-        row_b = best_b[row_local]
-        row_split = do_split[row_local]
-        row_bin = jnp.take_along_axis(bins, row_f[:, None], axis=1)[:, 0]
-        go_left = row_bin <= row_b
-        new_node = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
-        node = jnp.where(seg_valid & row_split, new_node, node)
-        # Rows whose node became a leaf stop descending and drop out of
-        # deeper histograms (their prediction lives at the marked leaf).
-        active = seg_valid & row_split
+        node1, active1 = _route_rows(
+            bins, local[None], seg_valid[None], node[None],
+            best_f[None], best_b[None], do_split[None], width)
+        node, active = node1[0], active1[0]
 
     return feature, split_bin, left_child, right_child, node_stats
 
@@ -260,17 +294,95 @@ def _build_tree_jit(bins, stats, row_weights, mask_keys, cfg: TreeTrainConfig,
 @partial(jax.jit, static_argnames=("cfg", "use_feature_mask"))
 def _build_tree_chunk(bins, stats, row_weights, mask_keys,
                       cfg: TreeTrainConfig, use_feature_mask: bool):
-    """A chunk of independent trees in ONE program, looped (not vmapped):
-    vmapping the histogram over trees multiplies its working set by the
-    chunk size — the vmapped segment-sum path OOMs HBM at bench scale — and
-    under vmap a pallas_call needs an extra batched grid dim. Per-tree PRNG
-    keys come from the caller, so chunking strategy never changes results."""
+    """A chunk of independent trees in ONE program.
+
+    Pallas path: all trees per level go through ONE fused multi-tree
+    histogram kernel — the trees share ``bins``, so the kernel's dominant
+    cost (the multihot build) is paid once per cell instead of per tree, and
+    the fused dot fills MXU lanes a single tree leaves idle.
+
+    XLA path: looped (not vmapped) single-tree builds — vmapping the
+    segment-sum histogram multiplies its working set by the chunk size and
+    OOMs HBM at bench scale.
+
+    Per-tree PRNG keys come from the caller, so the chunking strategy never
+    changes results."""
+    if cfg.use_pallas:
+        return _build_forest_chunk_pallas(
+            bins, stats, row_weights,
+            mask_keys if use_feature_mask else None, cfg)
     outs = [
         _build_tree(bins, stats, row_weights[i],
                     mask_keys[i] if use_feature_mask else None, cfg)
         for i in range(row_weights.shape[0])
     ]
     return tuple(jnp.stack(parts) for parts in zip(*outs))
+
+
+def _build_forest_chunk_pallas(bins, stats, row_weights, mask_keys,
+                               cfg: TreeTrainConfig):
+    """Batched level-wise builder: every per-row/per-node array carries a
+    leading tree axis, and the per-level histogram is one
+    ``node_feature_bin_histogram_multi`` call for the whole chunk. Math is
+    identical to looping ``_build_tree`` per tree (same per-element f32
+    products, same hi/lo bf16 rounding, same masked-gain argmaxes) — the
+    interpret-mode parity test asserts structural equality."""
+    from fraud_detection_tpu.ops.histogram import (
+        auto_interpret, node_feature_bin_histogram_multi)
+
+    t, n = row_weights.shape
+    f = bins.shape[1]
+    k = stats.shape[-1]
+    nb = cfg.n_bins
+    depth = cfg.max_depth
+    m = 2 ** (depth + 1) - 1
+
+    feature = jnp.full((t, m), -1, jnp.int32)
+    split_bin = jnp.zeros((t, m), jnp.int32)
+    left_child = jnp.full((t, m), -1, jnp.int32)
+    right_child = jnp.full((t, m), -1, jnp.int32)
+    node_stats = jnp.zeros((t, m, k), stats.dtype)
+
+    node = jnp.zeros((t, n), jnp.int32)
+    active = row_weights > 0
+
+    for level in range(depth + 1):
+        offset = 2 ** level - 1
+        width = 2 ** level
+        local = node - offset                                   # (T, N)
+        seg_valid = active & (local >= 0) & (local < width)
+        locals_masked = jnp.where(seg_valid, local, width)
+        # exact per-tree totals (cheap per-node scatter, same as _build_tree)
+        totals = jax.vmap(
+            lambda loc, w: jax.ops.segment_sum(
+                stats * w[:, None], loc, num_segments=width + 1)[:-1]
+        )(locals_masked, row_weights)                           # (T, L, K)
+        node_stats = node_stats.at[:, offset : offset + width].set(totals)
+
+        hist = node_feature_bin_histogram_multi(
+            bins, locals_masked, row_weights, stats,
+            n_nodes=width, n_bins=nb, interpret=auto_interpret())
+
+        if level == depth:
+            break
+
+        mask = (None if mask_keys is None
+                else _feature_mask(mask_keys[:, level], width, f))
+        best_f, best_b, best_gain = _select_splits(hist, totals, mask, cfg)
+        do_split = best_gain > cfg.min_info_gain
+
+        pos = offset + jnp.arange(width)
+        feature = feature.at[:, pos].set(jnp.where(do_split, best_f, -1))
+        split_bin = split_bin.at[:, pos].set(best_b)
+        left_child = left_child.at[:, pos].set(
+            jnp.where(do_split, 2 * pos + 1, -1))
+        right_child = right_child.at[:, pos].set(
+            jnp.where(do_split, 2 * pos + 2, -1))
+
+        node, active = _route_rows(bins, local, seg_valid, node,
+                                   best_f, best_b, do_split, width)
+
+    return feature, split_bin, left_child, right_child, node_stats
 
 
 def _edges_to_thresholds(edges: np.ndarray, feature: np.ndarray, split_bin: np.ndarray):
@@ -358,7 +470,7 @@ def fit_decision_tree(
 
 def fit_random_forest(
     X, y, *, n_trees: int = 100, num_classes: int = 2, seed: int = 42,
-    config: Optional[TreeTrainConfig] = None, tree_chunk: int = 4,
+    config: Optional[TreeTrainConfig] = None, tree_chunk: Optional[int] = None,
     feature_subset: bool = True, edges: Optional[np.ndarray] = None, mesh=None,
     checkpoint_dir: Optional[str] = None, checkpoint_every: int = 10,
 ) -> TreeEnsemble:
@@ -375,8 +487,19 @@ def fit_random_forest(
     (checkpoint/train_state.py). Per-chunk PRNG keys are
     ``fold_in(root, start)`` — a pure function of (seed, start) — so resumed
     forests are bit-identical to uninterrupted ones.
+
+    ``tree_chunk`` defaults per path: 16/num_classes on the fused Pallas
+    builder (bigger fusions amortize the shared multihot, but the kernel's
+    VMEM accumulator scales with chunk * classes), 4 on the XLA loop
+    (compile time grows with the unroll). The chunk size shapes the
+    bootstrap PRNG draw, so it is part of the resume fingerprint.
     """
     cfg = resolve_config(config, mesh)
+    if tree_chunk is None:
+        # Fused-kernel VMEM: the accumulator block rows scale as
+        # chunk * num_classes * 2^depth, so the chunk shrinks with the
+        # class count (8 * 2 measured as the budget at depth 5).
+        tree_chunk = max(1, 16 // num_classes) if cfg.use_pallas else 4
     edges, bins, _, stats, base_weights, n = _prepare_inputs(
         X, y, num_classes, cfg, edges, mesh)
     n_padded = bins.shape[0]
@@ -428,19 +551,26 @@ def fit_random_forest(
 
     last_saved = trees_done
     for start in range(trees_done, n_trees, tree_chunk):
-        chunk = min(tree_chunk, n_trees - start)
+        need = min(tree_chunk, n_trees - start)
         key = jax.random.fold_in(root, start)
         wkey, mkey = jax.random.split(key)
+        # Always draw/build the FULL chunk: a ragged tail would compile a
+        # second program shape (which costs far more than the few discarded
+        # trees); extras are sliced away. Same rule on resume, so resumed
+        # forests stay bit-identical to uninterrupted ones.
         weights = jax.random.poisson(
-            wkey, 1.0, (chunk, n_padded)).astype(jnp.float32)
+            wkey, 1.0, (tree_chunk, n_padded)).astype(jnp.float32)
         weights = weights * base_weights[None, :]  # zero out mesh padding rows
-        mask_keys = jax.random.split(mkey, chunk * (cfg.max_depth + 1)).reshape(
-            chunk, cfg.max_depth + 1, -1)
+        mask_keys = jax.random.split(mkey, tree_chunk * (cfg.max_depth + 1)).reshape(
+            tree_chunk, cfg.max_depth + 1, -1)
         f_, b_, l_, r_, s_ = build(bins, stats, weights, mask_keys, cfg, feature_subset)
+        if need != tree_chunk:
+            f_, b_, l_, r_, s_ = (f_[:need], b_[:need], l_[:need],
+                                  r_[:need], s_[:need])
         feats.append(f_); sbins.append(b_)
         lefts.append(l_); rights.append(r_)
         all_stats.append(s_)
-        done = start + chunk
+        done = start + need
         # Snapshot on the cadence (each save rewrites the full accumulated
         # state, so per-chunk saves would cost O(n_trees^2) bytes) and at
         # completion (the seed for extending the forest later).

@@ -11,6 +11,7 @@ from fraud_detection_tpu.ops.histogram import (
     best_splits,
     histogram_reference,
     node_feature_bin_histogram,
+    node_feature_bin_histogram_multi,
 )
 
 __all__ = [
@@ -18,4 +19,5 @@ __all__ = [
     "best_splits",
     "histogram_reference",
     "node_feature_bin_histogram",
+    "node_feature_bin_histogram_multi",
 ]

@@ -45,23 +45,49 @@ def _round_up(n: int, m: int) -> int:
 # histogram kernel
 # ---------------------------------------------------------------------------
 
-def _hist_kernel(bins_ref, b_of_c_ref, local_ref, stats_ref, out_ref, *,
-                 n_bins: int, n_nodes: int, k: int):
-    """One (feature-tile, row-tile) cell: out += multihot^T @ (node (x) stats).
+def node_feature_bin_histogram(
+    bins: jax.Array,      # (N, F) int32 bin ids
+    local: jax.Array,     # (N,) int32 node position within the level; >= n_nodes = skip
+    stats: jax.Array,     # (N, K) f32 per-row statistics (weights folded in)
+    *,
+    n_nodes: int,
+    n_bins: int,
+    row_tile: int = 256,
+    feature_tile: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """(n_nodes, F, n_bins, K) statistics histogram via the Pallas kernel —
+    the T=1 case of ``node_feature_bin_histogram_multi`` (unit weights are
+    exact, so delegating costs one multiply by 1.0 and keeps a single
+    kernel to maintain)."""
+    hist = node_feature_bin_histogram_multi(
+        bins, local[None, :], jnp.ones((1, local.shape[0]), jnp.float32),
+        stats, n_nodes=n_nodes, n_bins=n_bins, row_tile=row_tile,
+        feature_tile=feature_tile, interpret=interpret)
+    return hist[0]
+
+
+def _hist_kernel_multi(bins_ref, b_of_c_ref, locals_ref, weights_ref,
+                       stats_ref, out_ref, *, n_bins: int, n_nodes: int,
+                       k: int, n_trees: int):
+    """One (feature-tile, row-tile) cell for T trees sharing ``bins``:
+    out += [node (x) stats (x) weights]^T @ multihot.
 
     Mosaic constraints + MXU economics shape this kernel:
 
     * No minor-dim reshape exists, so the flat bucket axis uses the
       (bin, feature-in-tile) order that ``pltpu.repeat`` (tile-concat
-      semantics) produces directly — column c <-> (b = c // Ft, f = c % Ft) —
-      and the khatri-rao node (x) stats matrix is built by lane-axis
+      semantics) produces directly — column c <-> (b = c // Ft, f = c % Ft)
+      — and the khatri-rao node (x) stats matrix is built by sublane-axis
       concatenation instead of a 3D reshape. The host wrapper untangles.
     * ``b_of_c`` (the bin id of each flat column — identical for every tile)
       arrives as a (1, C) input instead of a per-cell iota+divide.
-    * The dot runs TRANSPOSED — (K*L, R) @ (R, C) — so the 4096-wide bucket
-      axis lands on lanes: the MXUs parallelize over lanes, and K*L (<= 96)
-      on lanes would leave all but one idle. One fused dot with the K
-      statistics concatenated beats K narrow dots for the same reason.
+    * The dot runs TRANSPOSED — (T*K*L, R) @ (R, C) — so the 4096-wide
+      bucket axis lands on lanes: the MXUs parallelize over lanes, and
+      T*K*L on lanes would leave most idle. Fusing T trees builds the
+      expensive multihot (the kernel's dominant cost) ONCE per cell instead
+      of per tree, and fills MXU lanes a single tree leaves idle at shallow
+      levels. Output rows: t*(K*L) + kk*L + l.
     * The f32 stats are split hi/lo into two bf16 passes (~16 mantissa bits,
       accumulated in f32): single-pass bf16 rounds to 8 bits — enough error
       (~1e-2 relative) to flip split argmaxes vs the XLA path — while
@@ -75,33 +101,35 @@ def _hist_kernel(bins_ref, b_of_c_ref, local_ref, stats_ref, out_ref, *,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     bins = bins_ref[:]                         # (R, Ft) int32
-    local = local_ref[:]                       # (1, R) int32; >= n_nodes -> inactive
-    stats = stats_ref[:]                       # (K, R) f32
-
     R, Ft = bins.shape
     bins_rep = pltpu.repeat(bins, n_bins, axis=1)                  # (R, C)
     multihot = (bins_rep == b_of_c_ref[:]).astype(jnp.bfloat16)
-    # transposed node-onehot; inactive rows (local >= n_nodes) are all-zero
     node_iota = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, R), 0)
-    node_onehot = (node_iota == local).astype(jnp.float32)         # (L, R)
-    ns = jnp.concatenate(
-        [node_onehot * stats[kk : kk + 1, :] for kk in range(k)], axis=0)
-    ns_hi = ns.astype(jnp.bfloat16)                                # (K*L, R)
+    parts = []
+    for t in range(n_trees):
+        local_t = locals_ref[t : t + 1, :]                         # (1, R)
+        w_t = weights_ref[t : t + 1, :]                            # (1, R)
+        onehot_t = (node_iota == local_t).astype(jnp.float32)      # (L, R)
+        for kk in range(k):
+            parts.append(onehot_t * (stats_ref[kk : kk + 1, :] * w_t))
+    ns = jnp.concatenate(parts, axis=0)                            # (T*K*L, R)
+    ns_hi = ns.astype(jnp.bfloat16)
     ns_lo = (ns - ns_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    dims = (((1,), (0,)), ((), ()))                                # contract R
+    dims = (((1,), (0,)), ((), ()))
     acc = jax.lax.dot_general(ns_hi, multihot, dims,
                               preferred_element_type=jnp.float32)
     acc = acc + jax.lax.dot_general(ns_lo, multihot, dims,
                                     preferred_element_type=jnp.float32)
-    out_ref[:] += acc                                              # (K*L, C)
+    out_ref[:] += acc
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins", "row_tile",
                                    "feature_tile", "interpret"))
-def node_feature_bin_histogram(
-    bins: jax.Array,      # (N, F) int32 bin ids
-    local: jax.Array,     # (N,) int32 node position within the level; >= n_nodes = skip
-    stats: jax.Array,     # (N, K) f32 per-row statistics (weights folded in)
+def node_feature_bin_histogram_multi(
+    bins: jax.Array,      # (N, F) int32 bin ids, SHARED by all trees
+    locals_: jax.Array,   # (T, N) int32 per-tree node position; >= n_nodes = skip
+    weights: jax.Array,   # (T, N) f32 per-tree bootstrap weights
+    stats: jax.Array,     # (N, K) f32 per-row statistics (weights NOT folded)
     *,
     n_nodes: int,
     n_bins: int,
@@ -109,45 +137,52 @@ def node_feature_bin_histogram(
     feature_tile: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
-    """(n_nodes, F, n_bins, K) statistics histogram via the Pallas kernel."""
+    """(T, n_nodes, F, n_bins, K) histograms for a chunk of trees sharing
+    one binned matrix — the forest trainer's per-level hot op."""
     n, f = bins.shape
-    k = stats.shape[-1]
+    t, k = locals_.shape[0], stats.shape[-1]
     n_pad = _round_up(max(n, 1), row_tile)
     f_pad = _round_up(max(f, 1), feature_tile)
     bins_p = jnp.zeros((n_pad, f_pad), jnp.int32)
     bins_p = bins_p.at[:n, :f].set(bins)
-    local_p = jnp.full((1, n_pad), n_nodes, jnp.int32).at[0, :n].set(local)
+    locals_p = jnp.full((t, n_pad), n_nodes, jnp.int32).at[:, :n].set(locals_)
+    weights_p = jnp.zeros((t, n_pad), jnp.float32).at[:, :n].set(weights)
     stats_p = jnp.zeros((k, n_pad), stats.dtype).at[:, :n].set(stats.T)
     b_of_c = (jnp.arange(feature_tile * n_bins, dtype=jnp.int32)
               // feature_tile)[None, :]
 
     grid = (f_pad // feature_tile, n_pad // row_tile)
     out = pl.pallas_call(
-        partial(_hist_kernel, n_bins=n_bins, n_nodes=n_nodes, k=k),
+        partial(_hist_kernel_multi, n_bins=n_bins, n_nodes=n_nodes, k=k,
+                n_trees=t),
         grid=grid,
         in_specs=[
             pl.BlockSpec((row_tile, feature_tile), lambda fi, ri: (ri, fi),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, feature_tile * n_bins), lambda fi, ri: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, row_tile), lambda fi, ri: (0, ri),
+            pl.BlockSpec((t, row_tile), lambda fi, ri: (0, ri),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, row_tile), lambda fi, ri: (0, ri),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((k, row_tile), lambda fi, ri: (0, ri),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((k * n_nodes, feature_tile * n_bins),
+        out_specs=pl.BlockSpec((t * k * n_nodes, feature_tile * n_bins),
                                lambda fi, ri: (0, fi),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((k * n_nodes, f_pad * n_bins), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((t * k * n_nodes, f_pad * n_bins),
+                                       jnp.float32),
         interpret=interpret,
-    )(bins_p, b_of_c, local_p, stats_p)
+    )(bins_p, b_of_c, locals_p, weights_p, stats_p)
 
-    # Untangle the kernel's layout: row = kk*L + l,
-    # col = tile*(NB*Ft) + b*Ft + f_in  ->  (L, F, NB, K).
+    # Untangle: row = t*(K*L) + kk*L + l, col = tile*(NB*Ft) + b*Ft + f_in
+    # -> (T, L, F, NB, K).
     n_tiles = f_pad // feature_tile
-    hist = out.reshape(k, n_nodes, n_tiles, n_bins, feature_tile)
-    hist = hist.transpose(1, 2, 4, 3, 0).reshape(n_nodes, f_pad, n_bins, k)
-    return hist[:, :f]
+    hist = out.reshape(t, k, n_nodes, n_tiles, n_bins, feature_tile)
+    hist = hist.transpose(0, 2, 3, 5, 4, 1).reshape(
+        t, n_nodes, f_pad, n_bins, k)
+    return hist[:, :, :f]
 
 
 def histogram_reference(bins, local, stats, *, n_nodes: int, n_bins: int) -> jax.Array:

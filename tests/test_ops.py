@@ -151,3 +151,49 @@ def test_boosting_with_pallas_matches_xla_path():
     p_pall = trees_mod.predict(pall, jnp.asarray(X))[1]
     np.testing.assert_allclose(np.asarray(p_base), np.asarray(p_pall),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_multi_tree_histogram_matches_single():
+    """The fused multi-tree kernel must equal per-tree single calls (same
+    math, multihot built once) — weights folded in-kernel."""
+    from fraud_detection_tpu.ops import node_feature_bin_histogram_multi
+
+    rng = np.random.default_rng(8)
+    n, f, nb, L, k, T = 300, 40, 8, 4, 2, 3
+    bins = jnp.asarray(rng.integers(0, nb, (n, f)), jnp.int32)
+    locals_ = jnp.asarray(rng.integers(0, L + 1, (T, n)), jnp.int32)
+    weights = jnp.asarray(rng.poisson(1.0, (T, n)).astype(np.float32))
+    stats = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+    multi = node_feature_bin_histogram_multi(
+        bins, locals_, weights, stats, n_nodes=L, n_bins=nb,
+        row_tile=64, feature_tile=16, interpret=True)
+    assert multi.shape == (T, L, f, nb, k)
+    for t in range(T):
+        single = node_feature_bin_histogram(
+            bins, locals_[t], stats * weights[t][:, None], n_nodes=L,
+            n_bins=nb, row_tile=64, feature_tile=16, interpret=True)
+        np.testing.assert_array_equal(np.asarray(multi[t]), np.asarray(single),
+                                      err_msg=f"tree {t}")
+
+
+def test_forest_chunk_pallas_matches_per_tree_loop():
+    """fit_random_forest through the fused Pallas chunk builder must produce
+    the same forest as the XLA per-tree loop (same PRNG stream; argmaxes on
+    well-separated gains survive the kernel's bf16-split precision)."""
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.train_trees import (
+        TreeTrainConfig, fit_random_forest)
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(500, 24)).astype(np.float32)
+    y = ((X[:, 2] > 0.1) ^ (X[:, 11] < -0.2)).astype(np.int32)
+    kw = dict(n_trees=6, tree_chunk=3, seed=9)
+    base = fit_random_forest(X, y, config=TreeTrainConfig(max_depth=4), **kw)
+    pall = fit_random_forest(
+        X, y, config=TreeTrainConfig(max_depth=4, use_pallas=True), **kw)
+    np.testing.assert_array_equal(np.asarray(base.feature), np.asarray(pall.feature))
+    np.testing.assert_array_equal(np.asarray(base.left), np.asarray(pall.left))
+    p_base = trees_mod.predict(base, jnp.asarray(X))[1]
+    p_pall = trees_mod.predict(pall, jnp.asarray(X))[1]
+    np.testing.assert_allclose(np.asarray(p_base), np.asarray(p_pall),
+                               rtol=1e-4, atol=1e-5)
