@@ -94,6 +94,10 @@ def main(argv=None) -> int:
     ap.add_argument("--max-depth", type=int, default=5)
     ap.add_argument("--n-trees", type=int, default=100)
     ap.add_argument("--n-rounds", type=int, default=100)
+    ap.add_argument("--tree-chunk", type=int, default=None,
+                    help="forest trees built per program (default: auto per "
+                         "backend); pass the original value when resuming a "
+                         "checkpoint taken under a different default")
     ap.add_argument("--save", action="append", default=[],
                     help="model=dir pairs, e.g. dt=./fraud_model_dt (repeatable); "
                          "model=spark:<dir> exports the Spark PipelineModel "
@@ -181,6 +185,7 @@ def main(argv=None) -> int:
         elif name == "rf":
             trained[name] = fit_random_forest(
                 Xtr, ytr, n_trees=args.n_trees, seed=args.seed, config=cfg, mesh=mesh,
+                tree_chunk=args.tree_chunk,
                 checkpoint_dir=_ckpt_subdir(args, name),
                 checkpoint_every=args.checkpoint_every)
         elif name == "xgb":

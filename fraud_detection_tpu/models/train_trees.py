@@ -488,18 +488,23 @@ def fit_random_forest(
     ``fold_in(root, start)`` — a pure function of (seed, start) — so resumed
     forests are bit-identical to uninterrupted ones.
 
-    ``tree_chunk`` defaults per path: 16/num_classes on the fused Pallas
+    ``tree_chunk`` defaults per path: VMEM-bounded on the fused Pallas
     builder (bigger fusions amortize the shared multihot, but the kernel's
-    VMEM accumulator scales with chunk * classes), 4 on the XLA loop
+    accumulator scales with chunk * classes * 2^depth), 4 on the XLA loop
     (compile time grows with the unroll). The chunk size shapes the
-    bootstrap PRNG draw, so it is part of the resume fingerprint.
+    bootstrap PRNG draw, so it is part of the resume fingerprint — resuming
+    a snapshot taken under a different default requires passing that
+    ``tree_chunk`` explicitly (the train CLI exposes ``--tree-chunk``).
     """
     cfg = resolve_config(config, mesh)
     if tree_chunk is None:
-        # Fused-kernel VMEM: the accumulator block rows scale as
-        # chunk * num_classes * 2^depth, so the chunk shrinks with the
-        # class count (8 * 2 measured as the budget at depth 5).
-        tree_chunk = max(1, 16 // num_classes) if cfg.use_pallas else 4
+        # Fused-kernel VMEM: the accumulator block is
+        # (chunk * num_classes * 2^depth) rows x (feature_tile * n_bins)
+        # lanes of f32; 512 rows (= 8 trees * 2 classes * depth-5 leaves,
+        # the measured budget) is the ceiling, so the chunk shrinks with
+        # class count and depth.
+        tree_chunk = (max(1, 512 // (num_classes * 2 ** cfg.max_depth))
+                      if cfg.use_pallas else 4)
     edges, bins, _, stats, base_weights, n = _prepare_inputs(
         X, y, num_classes, cfg, edges, mesh)
     n_padded = bins.shape[0]
