@@ -176,7 +176,10 @@ def main() -> None:
 
     batch_size = int(os.environ.get("BENCH_BATCH", "4096"))
     n_msgs = int(os.environ.get("BENCH_MSGS", "20000"))
-    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    # Best-of-N: the bench host and the TPU tunnel are shared, with multi-
+    # second contention windows that can halve a single run's number; six
+    # short runs make the best-of a stable estimate of the uncontended rate.
+    runs = int(os.environ.get("BENCH_RUNS", "6"))
     depth = int(os.environ.get("BENCH_DEPTH", "4"))
     model = os.environ.get("BENCH_MODEL", "lr")
 

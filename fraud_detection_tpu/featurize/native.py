@@ -35,7 +35,7 @@ def _build() -> Optional[str]:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_LIB))
         os.close(fd)
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp],
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)
         return _LIB
@@ -103,6 +103,22 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib._has_fill16 = True
         except AttributeError:
             lib._has_fill16 = False
+        try:  # batch output-frame assembly (stateless)
+            lib.ftok_build_frames.restype = ctypes.c_longlong
+            lib.ftok_build_frames.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.POINTER(ctypes.c_char_p),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_longlong,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+            lib._has_frames = True
+        except AttributeError:
+            lib._has_frames = False
         _lib = lib
         return _lib
 
@@ -195,14 +211,19 @@ class NativeFeaturizer:
     def encode_json(self, values: Sequence[bytes], key: bytes, rows: int,
                     max_tokens: Optional[int], pad_len,
                     want16: bool = False) -> Tuple[
-                        np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+                        np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+                        np.ndarray, object]:
         """Raw-JSON batch encode: one native pass extracts the string field
         ``key`` from each JSON message, cleans+tokenizes+hashes it.
 
-        Returns (ids, counts, status, span_start, span_len): padded (rows, L)
-        arrays where malformed messages (status 0) are all-padding rows, plus
-        the raw string literal's byte span (including quotes) inside each
-        message for zero-copy splicing into output frames. Explicit lengths
+        Returns (ids, counts, status, span_start, span_len, splice_ctx):
+        padded (rows, L) arrays where malformed messages (status 0) are
+        all-padding rows, plus the raw string literal's byte span (including
+        quotes) inside each message for zero-copy splicing into output
+        frames. ``splice_ctx`` is the marshalled ``char*[n]`` message array —
+        hand it (with the spans) to ``build_frames`` to assemble output
+        frames without re-marshalling the batch; pointers stay valid only
+        while the caller keeps the message bytes alive. Explicit lengths
         are passed, so embedded NULs in message bytes are handled exactly
         (json.loads would reject them inside strings as raw control chars)."""
         if not getattr(self._lib, "_has_json", False):
@@ -222,7 +243,45 @@ class NativeFeaturizer:
                 ids, counts = self._fill(rows, length, want16)
             finally:
                 self._pair_check.finish()
-        return ids, counts, status, span_start, span_len
+        return ids, counts, status, span_start, span_len, arr
+
+
+def frames_available() -> bool:
+    lib = load_library()
+    return bool(lib is not None and getattr(lib, "_has_frames", False))
+
+
+def build_frames(msgs_arr, span_start: np.ndarray, span_len: np.ndarray,
+                 labels: np.ndarray, confs: np.ndarray,
+                 label_jsons: Sequence[bytes]) -> Tuple[bytes, np.ndarray]:
+    """Assemble the engine's classified-output wire frames in one native pass.
+
+    ``msgs_arr`` is the SAME ctypes ``char*[n]`` array a prior
+    ``encode_json`` marshalled (returned as its splice context — so this
+    call does zero per-message Python->C conversion); ``span_start`` /
+    ``span_len`` locate each message's raw string literal (with quotes) to
+    splice. ``labels`` (n,) int32 — rows whose label falls outside
+    ``[0, len(label_jsons))`` (e.g. -1 for malformed) come back as EMPTY
+    frames for the caller's Python fallback; ``confs`` (n,) float64.
+    Returns ``(blob, ends)``: frame i is ``blob[ends[i-1]:ends[i]]``.
+    The message bytes the array points into must still be alive (the engine
+    holds them via its in-flight batch).
+    """
+    lib = load_library()
+    n = len(span_start)
+    ljs = (ctypes.c_char_p * len(label_jsons))(*label_jsons)
+    ljlens = np.fromiter((len(s) for s in label_jsons), np.int32,
+                         len(label_jsons))
+    ends = np.empty(n, np.int64)
+    # Mirrors the C++ per-row bound: 96 fixed + label json + text literal.
+    cap = int(span_len.sum()) + n * (96 + int(ljlens.max(initial=0)))
+    buf = ctypes.create_string_buffer(cap)
+    total = lib.ftok_build_frames(msgs_arr, span_start, span_len, labels,
+                                  confs, ljs, ljlens, len(label_jsons),
+                                  n, buf, cap, ends)
+    if total < 0:  # cannot happen while cap mirrors the C++ bound
+        raise RuntimeError("frame buffer overflow")
+    return ctypes.string_at(buf, total), ends
 
 
 def available() -> bool:

@@ -175,7 +175,8 @@ class HashingTfIdfFeaturizer:
 
     def encode_json(self, values: Sequence[bytes], text_field: str = "text",
                     batch_size: Optional[int] = None,
-                    max_tokens: Optional[int] = None) -> Optional[Tuple[
+                    max_tokens: Optional[int] = None,
+                    keep_splice_ctx: bool = False) -> Optional[Tuple[
                         "EncodedBatch", np.ndarray, np.ndarray, np.ndarray]]:
         """Raw-JSON fast path: encode Kafka message bytes WITHOUT Python-side
         json.loads — one native pass extracts ``text_field``, cleans,
@@ -185,21 +186,34 @@ class HashingTfIdfFeaturizer:
         batch corresponds to values[i] (status 0 rows are all-padding and
         score as garbage to be discarded by the caller), and the spans locate
         each message's raw string literal (quotes included) for zero-copy
-        splicing into output JSON. Returns None when the native path is
-        unavailable (no toolchain, or a vocabulary featurizer) — callers fall
-        back to json.loads + ``encode``."""
+        splicing into output JSON. With ``keep_splice_ctx`` the marshalled
+        message array is parked in ``pop_json_splice_ctx()`` for native
+        output-frame assembly (same thread, immediately after this call);
+        without it nothing is retained — callers that never pop must not pin
+        the batch's message bytes. Returns None when the native path is
+        unavailable (no toolchain, or a vocabulary featurizer) — callers
+        fall back to json.loads + ``encode``."""
         native = self._native_featurizer()
         if native is None or not native.supports_json():
             return None
         b = batch_size if batch_size is not None else len(values)
         if len(values) > b:
             raise ValueError(f"{len(values)} values > batch_size {b}")
-        ids, counts, status, span_start, span_len = native.encode_json(
+        ids, counts, status, span_start, span_len, ctx = native.encode_json(
             values, text_field.encode("utf-8"), b, max_tokens, _pad_len,
             want16=self._ids_dtype() is np.int16)
+        self._json_splice_ctx = ctx if keep_splice_ctx else None
         if ids.dtype != np.int16:
             ids, counts = self._narrow(ids, counts)
         return EncodedBatch(ids=ids, counts=counts), status, span_start, span_len
+
+    def pop_json_splice_ctx(self):
+        """Take the last ``encode_json`` call's marshalled message array
+        (``featurize/native.py build_frames`` splice context); cleared on
+        read. Single-driver contract, same as the engine's."""
+        ctx = getattr(self, "_json_splice_ctx", None)
+        self._json_splice_ctx = None
+        return ctx
 
     def _ids_dtype(self):
         return np.int16 if self.num_features <= np.iinfo(np.int16).max else np.int32

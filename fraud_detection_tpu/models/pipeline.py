@@ -159,29 +159,40 @@ class ServingPipeline:
         json.loads (featurize/tfidf.py ``encode_json`` — one native pass from
         message bytes to hashed sparse rows).
 
-        Returns ``(pending, status, span_start, span_len)`` where the pending
-        prediction covers ALL rows positionally (row i = values[i]; status 0
-        rows are all-padding and score as garbage the caller must discard),
-        or None when unavailable (no native library or vocabulary
-        featurizer). Tree models ride the same native encode: the hashed
-        sparse rows scatter to dense TF-IDF and traverse the ensemble in one
-        device program (matching the reference's primary trained family,
-        fraud_detection_spark.py:56-91 / Q1). The spans locate each
+        Returns ``(pending, status, span_start, span_len, splice_ctxs)``
+        where the pending prediction covers ALL rows positionally (row i =
+        values[i]; status 0 rows are all-padding and score as garbage the
+        caller must discard), or None when unavailable (no native library or
+        vocabulary featurizer). Tree models ride the same native encode: the
+        hashed sparse rows scatter to dense TF-IDF and traverse the ensemble
+        in one device program (matching the reference's primary trained
+        family, fraud_detection_spark.py:56-91 / Q1). The spans locate each
         message's raw string literal for zero-copy output framing
-        (stream/engine.py)."""
+        (stream/engine.py); ``splice_ctxs`` is a list of per-chunk
+        ``(marshalled char*[] array, chunk_len)`` for native frame assembly
+        (``featurize/native.py build_frames``), or None when any chunk's
+        context is unavailable."""
         encode_json = getattr(self.featurizer, "encode_json", None)
         if encode_json is None:
             return None
+        pop_ctx = getattr(self.featurizer, "pop_json_splice_ctx", lambda: None)
         is_tree = self._fused_model is None
         tree_binary = is_tree and self._tree_is_binary()
         parts: List[Tuple[object, int]] = []
         stats: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        ctxs: Optional[List[Tuple[object, int]]] = []
         for start in range(0, len(values), self.batch_size):
             chunk = values[start : start + self.batch_size]
-            out = encode_json(chunk, text_field, batch_size=self.batch_size)
+            out = encode_json(chunk, text_field, batch_size=self.batch_size,
+                              keep_splice_ctx=True)
             if out is None:
                 return None
             enc, status, span_start, span_len = out
+            ctx = pop_ctx()
+            if ctx is None:
+                ctxs = None
+            elif ctxs is not None:
+                ctxs.append((ctx, len(chunk)))
             if is_tree:
                 parts.append((self._dispatch_tree(enc, tree_binary), len(chunk)))
             else:
@@ -193,11 +204,12 @@ class ServingPipeline:
             argmax=is_tree and not tree_binary)
         if not stats:
             empty = np.empty(0, np.int32)
-            return pending, empty, empty, empty
+            return pending, empty, empty, empty, ctxs
         return (pending,
                 np.concatenate([s[0] for s in stats]),
                 np.concatenate([s[1] for s in stats]),
-                np.concatenate([s[2] for s in stats]))
+                np.concatenate([s[2] for s in stats]),
+                ctxs)
 
     def _tree_is_binary(self) -> bool:
         """Binary trees: p(class=1) > 0.5 equals argmax over the normalized

@@ -41,6 +41,40 @@ _LABEL_JSON = {0: json.dumps(label_name(0)), 1: json.dumps(label_name(1))}
 _OUT_TEMPLATE_B = _OUT_TEMPLATE.encode()
 _LABEL_JSON_B = {k: v.encode() for k, v in _LABEL_JSON.items()}
 
+# Dense label->JSON table for the native frame assembler (index = label);
+# grown lazily for multiclass tree pipelines. Growth builds a NEW list and
+# swaps the module reference (atomic under the GIL) — never mutates the
+# published list, so concurrent engines can race the swap but each always
+# reads a complete, correct table.
+_LABEL_TABLE = [_LABEL_JSON_B[0], _LABEL_JSON_B[1]]
+
+
+def _label_json_table(max_label: int) -> list:
+    global _LABEL_TABLE
+    table = _LABEL_TABLE
+    if max_label < len(table):
+        return table
+    table = table + [json.dumps(label_name(i)).encode()
+                     for i in range(len(table), max_label + 1)]
+    _LABEL_TABLE = table
+    return table
+
+
+def _confidence_array(preds) -> np.ndarray:
+    """p(predicted class): P for label 1, 1-P otherwise. The ONE definition
+    both output paths (Python template and native frames) must share —
+    their whole contract is byte-identical frames."""
+    return np.where(np.asarray(preds.labels) == 1, preds.probabilities,
+                    1.0 - preds.probabilities)
+
+
+def _malformed_wire(msg: Message) -> bytes:
+    """The error frame for an undecodable message — shared by both output
+    paths for the same byte-parity reason as ``_confidence_array``."""
+    return json.dumps({
+        "error": "malformed message", "prediction": None,
+        "original": msg.value.decode("utf-8", "replace")[:500]}).encode()
+
 
 @dataclass
 class StreamStats:
@@ -147,6 +181,8 @@ class StreamingClassifier:
         # both ride it). The explain hook needs decoded text, so it forces
         # the slow path.
         self._json_fast: Optional[bool] = None if explain_fn is None else False
+        # Native output-frame assembly: None = untried (probed on first use).
+        self._frames_ok: Optional[bool] = None
         # The engine is single-driver by contract: stats, consumer position,
         # and in-flight state all assume one thread runs the loop. stop() is
         # the one cross-thread entry point (a bare flag write). The region
@@ -199,7 +235,7 @@ class StreamingClassifier:
             self._json_fast = False
             return None
         self._json_fast = True
-        pending, status, span_start, span_len = fast
+        pending, status, span_start, span_len, ctxs = fast
         literals: List[Optional[bytes]] = [None] * len(msgs)
         # Bulk numpy->python conversion: per-element numpy indexing costs
         # ~0.1us each and this loop runs per message at 50k+/sec.
@@ -208,6 +244,12 @@ class StreamingClassifier:
             for i in np.flatnonzero(status == 0).tolist():
                 if self._decode(msgs[i]) is not None:
                     return None  # stricter-than-json.loads: slow path
+        if ctxs is not None and self.explain_fn is None and self._native_frames():
+            # Native frame assembly will splice straight from the message
+            # buffers — no per-message literal slices needed at all.
+            return _InFlight(msgs, literals, valid_idx, pending, offsets,
+                             time.perf_counter() - t0, raw=True,
+                             splice=(ctxs, span_start, span_len))
         starts = span_start.tolist()
         lens = span_len.tolist()
         for i in valid_idx:
@@ -223,14 +265,17 @@ class StreamingClassifier:
         msgs, texts = inflight.msgs, inflight.texts
         preds = inflight.pending.resolve() if inflight.pending is not None else None
 
+        if inflight.splice is not None and preds is not None:
+            wires = self._assemble_frames_native(inflight, preds)
+            return self._deliver(inflight, wires, t1)
+
         results: List[Optional[tuple]] = [None] * len(msgs)
         if preds is not None:
             # Bulk numpy->python conversion (tolist) and vectorized
             # confidence, not per-element int()/float()/branching: this is
             # the per-message hot loop.
             labels = preds.labels.tolist()
-            confs = np.where(preds.labels == 1, preds.probabilities,
-                             1.0 - preds.probabilities).tolist()
+            confs = _confidence_array(preds).tolist()
             if inflight.raw:
                 # Raw-JSON mode: predictions cover all rows positionally.
                 for i in inflight.valid_idx:
@@ -243,9 +288,7 @@ class StreamingClassifier:
         for msg, text, res in zip(msgs, texts, results):
             if res is None:
                 self.stats.malformed += 1
-                out = {"error": "malformed message", "prediction": None,
-                       "original": msg.value.decode("utf-8", "replace")[:500]}
-                wire = json.dumps(out).encode()
+                wire = _malformed_wire(msg)
             else:
                 label, confidence = res  # confidence precomputed vectorized
                 # Same field semantics as FraudAnalysisAgent.predict_and_get_label:
@@ -277,7 +320,59 @@ class StreamingClassifier:
                         out["analysis"] = analysis
                     wire = json.dumps(out).encode()
             wires.append((wire, msg.key))
+        return self._deliver(inflight, wires, t1)
 
+    def _native_frames(self) -> bool:
+        """Native output-frame assembly available? (cached after first ask)"""
+        ok = self._frames_ok
+        if ok is None:
+            from fraud_detection_tpu.featurize import native as native_mod
+
+            ok = self._frames_ok = native_mod.frames_available()
+        return ok
+
+    def _assemble_frames_native(self, inflight: "_InFlight",
+                                preds) -> List[tuple]:
+        """Build every output frame for a raw-mode batch in ONE C++ pass per
+        chunk (format ints/floats + splice text literals straight from the
+        message buffers via the encode-time spans — no per-message
+        marshalling), leaving Python with a blob-slice per message.
+        Byte-identical to the template path — enforced by
+        tests/test_stream.py frame-parity tests."""
+        msgs = inflight.msgs
+        ctxs, span_start, span_len = inflight.splice
+        labels = np.asarray(preds.labels, np.int32)
+        confs = _confidence_array(preds).astype(np.float64)
+        table = _label_json_table(int(labels.max()) if labels.size else 0)
+        if len(inflight.valid_idx) != len(msgs):
+            labels = labels.copy()
+            mask = np.ones(len(msgs), bool)
+            mask[inflight.valid_idx] = False
+            labels[mask] = -1  # malformed: empty frame -> Python fallback
+        from fraud_detection_tpu.featurize.native import build_frames
+
+        wires: List[tuple] = []
+        off = 0
+        for arr, n_chunk in ctxs:
+            hi = off + n_chunk
+            blob, ends = build_frames(arr, span_start[off:hi],
+                                      span_len[off:hi], labels[off:hi],
+                                      confs[off:hi], table)
+            start = 0
+            for j, end in enumerate(ends.tolist()):
+                msg = msgs[off + j]
+                if end == start:  # malformed (valid frames are never empty)
+                    self.stats.malformed += 1
+                    wires.append((_malformed_wire(msg), msg.key))
+                else:
+                    wires.append((blob[start:end], msg.key))
+                    start = end
+            off = hi
+        return wires
+
+    def _deliver(self, inflight: "_InFlight", wires: List[tuple],
+                 t1: float) -> int:
+        msgs = inflight.msgs
         produce_batch = getattr(self.producer, "produce_batch", None)
         if produce_batch is not None:
             produce_batch(self.output_topic, wires)
@@ -403,6 +498,9 @@ class _InFlight:
     dispatch_time: float        # host seconds spent in _dispatch
     raw: bool = False           # raw-JSON mode: pending covers ALL rows
                                 # positionally; texts[i] is the string literal
+    # Native frame-assembly context (raw mode): per-chunk marshalled message
+    # arrays + the batch's span arrays; texts may then be lazily-unbuilt.
+    splice: Optional[tuple] = None  # (ctxs, span_start, span_len)
 
 
 def run_supervised(make_engine: Callable[[], StreamingClassifier], *,

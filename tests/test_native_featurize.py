@@ -269,3 +269,52 @@ def test_json_path_embedded_nul_rejected():
     _, status, _, _ = out
     assert status[0] == 0
     assert _py_reference(b'{"text": "nul \x00 here"}') is None
+
+
+def test_fuzz_parity_nasty_alphabet():
+    """Randomized differential fuzz aimed at the span/segment fast paths:
+    mixed-case letter runs, tokens assembled across stripped chars, empty
+    tokens from space runs, and the two special codepoints — native encode
+    must stay byte-identical to the pure-Python featurizer on all of them."""
+    import random
+
+    alphabet = list("abcXYZ  '.-09\t") + ["İ", "K", "é", "🎉", "ß"]
+    rng = random.Random(1234)
+    texts = ["".join(rng.choices(alphabet, k=rng.randint(0, 60)))
+             for _ in range(400)]
+    for remove_stopwords in (True, False):
+        feat = HashingTfIdfFeaturizer(num_features=1000,
+                                      remove_stopwords=remove_stopwords)
+        twin = _python_twin(feat)
+        got = feat.encode(texts, batch_size=512)
+        want = twin.encode(texts, batch_size=512)
+        np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
+        np.testing.assert_array_equal(np.asarray(got.counts),
+                                      np.asarray(want.counts))
+
+
+def test_fuzz_json_path_parity():
+    """Same fuzz through the raw-JSON path: escapes interleave with letter
+    runs, so span tokens must correctly materialize across escape boundaries
+    (e.g. raw "ab\\u0063d" is one token "abcd", never two)."""
+    import random
+
+    rng = random.Random(99)
+    pieces = ["abc", "XYZ", "\\u0063", "\\u0041", "\\n", "\\t", " ", "  ",
+              "don't", "q.r", "\\u0130", "\\u212a", "0", "é"]
+    feat = HashingTfIdfFeaturizer(num_features=1000)
+    msgs = []
+    for _ in range(300):
+        text = "".join(rng.choices(pieces, k=rng.randint(0, 20)))
+        msgs.append(('{"text": "%s", "id": 1}' % text).encode())
+    out = feat.encode_json(msgs, "text", batch_size=len(msgs))
+    assert out is not None
+    batch, status, _, _ = out
+    assert status.all()  # every message above is valid JSON
+    decoded = [json.loads(m)["text"] for m in msgs]
+    twin = _python_twin(feat)
+    want = twin.encode(decoded, batch_size=len(msgs),
+                       max_tokens=batch.ids.shape[1])
+    np.testing.assert_array_equal(np.asarray(batch.ids), np.asarray(want.ids))
+    np.testing.assert_array_equal(np.asarray(batch.counts),
+                                  np.asarray(want.counts))

@@ -398,3 +398,81 @@ def test_messages_listing_is_produce_order():
     broker.append("t", b"single")
     assert [m.value for m in broker.messages("t")] == \
         [f"b{i}".encode() for i in range(9)] + [b"single"]
+
+
+def _run_engine_raw(pipeline, values, disable_native_frames=False):
+    """Like _run_engine but returns raw output BYTES (byte-parity checks)."""
+    broker = InProcessBroker(num_partitions=3)
+    producer = broker.producer()
+    for i, v in enumerate(values):
+        producer.produce("in", v, key=str(i).encode())
+    consumer = broker.consumer(["in"], "grp")
+    engine = StreamingClassifier(pipeline, consumer, broker.producer(), "out",
+                                 batch_size=32, max_wait=0.01)
+    if disable_native_frames:
+        engine._frames_ok = False
+    stats = engine.run(max_messages=len(values), idle_timeout=0.3)
+    return engine, stats, {m.key: m.value for m in broker.messages("out")}
+
+
+def test_native_frame_assembly_byte_parity(pipeline):
+    """C++ ftok_build_frames must be byte-identical to the Python template
+    path (%d / %.6f / literal splice) on every message, including routing
+    malformed rows to the Python fallback frame."""
+    from fraud_detection_tpu.featurize import native as native_mod
+
+    if not native_mod.frames_available():
+        pytest.skip("native frame assembly unavailable")
+    from fraud_detection_tpu.data import generate_corpus
+
+    corpus = generate_corpus(n=50, seed=77)
+    values = [json.dumps({"text": d.text, "id": i}).encode()
+              for i, d in enumerate(corpus)]
+    values[3] = b"nope"          # malformed -> fallback frame
+    values[11] = b'{"text": 9}'  # non-string field -> fallback frame
+
+    eng_c, st_c, out_c = _run_engine_raw(pipeline, values)
+    if eng_c._json_fast is not True:
+        pytest.skip("native JSON path unavailable in this environment")
+    assert eng_c._frames_ok is True
+    eng_p, st_p, out_p = _run_engine_raw(pipeline, values,
+                                         disable_native_frames=True)
+    assert st_c.processed == st_p.processed == 50
+    assert st_c.malformed == st_p.malformed == 2
+    assert out_c == out_p
+
+
+def test_build_frames_float_formatting_parity():
+    """snprintf %.6f must round exactly like Python's %-formatting on
+    adversarial doubles (halfway cases, extremes) — a one-ULP divergence
+    here would silently break output byte parity."""
+    from fraud_detection_tpu.featurize import native as native_mod
+
+    if not native_mod.frames_available():
+        pytest.skip("native frame assembly unavailable")
+    import random
+
+    from fraud_detection_tpu.stream.engine import _LABEL_JSON_B, _OUT_TEMPLATE_B
+
+    rng = random.Random(5)
+    n = 500
+    confs = np.array([rng.random() for _ in range(n)], np.float64)
+    confs[:8] = [0.0, 1.0, 0.5, 0.9999995, 0.1234565,
+                 0.1234575, 1e-7, 0.49999999999]
+    labels = np.array([rng.randint(0, 1) for _ in range(n)], np.int32)
+    texts = [('"t%d"' % i).encode() for i in range(n)]
+    import ctypes
+
+    arr = (ctypes.c_char_p * n)(*texts)
+    span_start = np.zeros(n, np.int32)
+    span_len = np.fromiter((len(t) for t in texts), np.int32, n)
+    blob, ends = native_mod.build_frames(
+        arr, span_start, span_len, labels, confs,
+        [_LABEL_JSON_B[0], _LABEL_JSON_B[1]])
+    start = 0
+    for i in range(n):
+        want = _OUT_TEMPLATE_B % (labels[i], _LABEL_JSON_B[int(labels[i])],
+                                  confs[i], texts[i])
+        got = blob[start:ends[i]]
+        start = int(ends[i])
+        assert got == want, (i, got, want)
