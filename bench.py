@@ -191,6 +191,7 @@ def main() -> None:
     pipe.predict([texts[i % len(texts)] for i in range(batch_size * 2)])
 
     best = 0.0
+    best_stats = None
     for _ in range(runs):
         broker = InProcessBroker(num_partitions=3)
         producer = broker.producer()
@@ -205,13 +206,21 @@ def main() -> None:
             batch_size=batch_size, max_wait=0.01, pipeline_depth=depth)
         stats = engine.run(max_messages=n_msgs, idle_timeout=1.0)
         assert stats.processed == n_msgs, stats.as_dict()
-        best = max(best, stats.msgs_per_sec)
+        if stats.msgs_per_sec > best:
+            best, best_stats = stats.msgs_per_sec, stats
 
     line = {
         "metric": "kafka_stream_classification_throughput",
         "value": round(best, 1),
         "unit": "dialogues/sec",
         "vs_baseline": round(best / NORTH_STAR, 4),
+        # Active per-batch processing latency of the best run (dispatch +
+        # finish legs; excludes pipeline queueing) — evidence for the
+        # "sub-second per dialogue" parity claim (report-paper.pdf §III.H).
+        "batch_latency_ms": {
+            "p50": round(best_stats.latency_percentile(50) * 1e3, 2),
+            "p99": round(best_stats.latency_percentile(99) * 1e3, 2),
+        },
     }
     if model != "lr":
         line["metric"] += f"_{model}"

@@ -30,8 +30,8 @@
 
 #include <algorithm>
 #include <atomic>
+#include <charconv>
 #include <cstdint>
-#include <cstdio>
 #include <cstring>
 #include <string>
 #include <string_view>
@@ -876,12 +876,23 @@ long long ftok_build_frames(const char** msgs, const int32_t* span_start,
     long long need = 96 + label_json_lens[lab] + span_len[i];
     if (p + need > lim) return -1;
     std::memcpy(p, kPred, sizeof(kPred) - 1); p += sizeof(kPred) - 1;
-    p += std::snprintf(p, 16, "%d", lab);
+    p = std::to_chars(p, lim, lab).ptr;
     std::memcpy(p, kLabel, sizeof(kLabel) - 1); p += sizeof(kLabel) - 1;
     std::memcpy(p, label_jsons[lab], size_t(label_json_lens[lab]));
     p += label_json_lens[lab];
     std::memcpy(p, kConf, sizeof(kConf) - 1); p += sizeof(kConf) - 1;
-    p += std::snprintf(p, 32, "%.6f", confs[i]);
+    // to_chars, not snprintf: locale-independent (a co-loaded library
+    // calling setlocale must not turn the decimal point into a comma) and
+    // hard-bounded by `lim` even for out-of-[0,1] caller inputs whose fixed
+    // rendering exceeds the 96-byte estimate.
+    {
+      auto cr = std::to_chars(p, lim, confs[i], std::chars_format::fixed, 6);
+      if (cr.ec != std::errc()) return -1;
+      p = cr.ptr;
+    }
+    // Re-check: an out-of-range confidence can out-grow the 14-byte
+    // allowance inside `need` (to_chars above only bounded itself).
+    if (p + (long long)(sizeof(kText) - 1) + span_len[i] + 1 > lim) return -1;
     std::memcpy(p, kText, sizeof(kText) - 1); p += sizeof(kText) - 1;
     std::memcpy(p, msgs[i] + span_start[i], size_t(span_len[i]));
     p += span_len[i];

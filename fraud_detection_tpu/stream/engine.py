@@ -35,11 +35,10 @@ from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
 # Output wire-format fast path: fixed frame, %.6f confidence (same 6-decimal
 # precision as the dict path's round(confidence, 6)).
 _OUT_TEMPLATE = '{"prediction": %d, "label": %s, "confidence": %.6f, "original_text": %s}'
-_LABEL_JSON = {0: json.dumps(label_name(0)), 1: json.dumps(label_name(1))}
 # Raw-JSON mode emits bytes directly, splicing the input's own string literal
 # (no decode/re-encode round trip — the literal is already valid JSON).
 _OUT_TEMPLATE_B = _OUT_TEMPLATE.encode()
-_LABEL_JSON_B = {k: v.encode() for k, v in _LABEL_JSON.items()}
+_LABEL_JSON_B = {k: json.dumps(label_name(k)).encode() for k in (0, 1)}
 
 # Dense label->JSON table for the native frame assembler (index = label);
 # grown lazily for multiclass tree pipelines. Growth builds a NEW list and
@@ -295,17 +294,17 @@ class StreamingClassifier:
                 # prediction = int class, label = display name.
                 if inflight.raw:
                     # Zero-copy text: splice the input's own (already-valid)
-                    # string literal into the fixed byte frame.
-                    # .get fallback: multiclass tree pipelines emit labels >= 2.
-                    label_json = (_LABEL_JSON_B.get(label)
-                                  or json.dumps(label_name(label)).encode())
+                    # string literal into the fixed byte frame. The shared
+                    # table keeps this path byte-identical to the native
+                    # assembler for multiclass labels >= 2 (and amortizes
+                    # their json.dumps across the hot loop).
+                    label_json = _label_json_table(label)[label]
                     wire = _OUT_TEMPLATE_B % (label, label_json, confidence, text)
                 elif self.explain_fn is None:
                     # Fast path: only the text needs JSON escaping; the frame
                     # is a fixed template (json.dumps of the full dict costs
                     # ~2.5x more and this runs per message at 30k+/sec).
-                    label_json = (_LABEL_JSON.get(label)
-                                  or json.dumps(label_name(label)))
+                    label_json = _label_json_table(label)[label].decode()
                     wire = (_OUT_TEMPLATE % (label, label_json,
                                              confidence, json.dumps(text))).encode()
                 else:
