@@ -170,9 +170,47 @@ def training_bench() -> dict:
     }
 
 
+def _stream_run(pipe, texts, batch_size: int, depth: int, n_msgs: int):
+    """One timed streaming run: fresh broker, n_msgs produced, engine drains.
+    The ONE definition of the measured loop — the headline and tree-family
+    sections must not drift apart."""
+    from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+
+    broker = InProcessBroker(num_partitions=3)
+    producer = broker.producer()
+    for i in range(n_msgs):
+        producer.produce(
+            "customer-dialogues-raw",
+            json.dumps({"text": texts[i % len(texts)], "id": i}).encode(),
+            key=str(i).encode())
+    consumer = broker.consumer(["customer-dialogues-raw"], "bench")
+    engine = StreamingClassifier(
+        pipe, consumer, broker.producer(), "dialogues-classified",
+        batch_size=batch_size, max_wait=0.01, pipeline_depth=depth)
+    stats = engine.run(max_messages=n_msgs, idle_timeout=1.0)
+    assert stats.processed == n_msgs, stats.as_dict()
+    return stats
+
+
+def tree_streaming_bench(texts, batch_size: int, depth: int,
+                         n_msgs: int = 10_000) -> dict:
+    """Streaming throughput for the tree families through the raw-JSON path
+    (native JSON encode -> on-device scatter to dense -> traversal), best of
+    two short runs per model: {"dt": msgs/sec, "xgb": msgs/sec}."""
+    out = {}
+    for model in ("dt", "xgb"):
+        pipe = build_pipeline(batch_size, model=model)
+        pipe.predict([texts[i % len(texts)] for i in range(batch_size * 2)])
+        best = 0.0
+        for _ in range(2):
+            best = max(best, _stream_run(pipe, texts, batch_size, depth,
+                                         n_msgs).msgs_per_sec)
+        out[model] = round(best, 1)
+    return out
+
+
 def main() -> None:
     from fraud_detection_tpu.data import generate_corpus
-    from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
 
     batch_size = int(os.environ.get("BENCH_BATCH", "4096"))
     n_msgs = int(os.environ.get("BENCH_MSGS", "20000"))
@@ -192,21 +230,9 @@ def main() -> None:
 
     best = 0.0
     best_stats = None
-    for _ in range(runs):
-        broker = InProcessBroker(num_partitions=3)
-        producer = broker.producer()
-        for i in range(n_msgs):
-            producer.produce(
-                "customer-dialogues-raw",
-                json.dumps({"text": texts[i % len(texts)], "id": i}).encode(),
-                key=str(i).encode())
-        consumer = broker.consumer(["customer-dialogues-raw"], "bench")
-        engine = StreamingClassifier(
-            pipe, consumer, broker.producer(), "dialogues-classified",
-            batch_size=batch_size, max_wait=0.01, pipeline_depth=depth)
-        stats = engine.run(max_messages=n_msgs, idle_timeout=1.0)
-        assert stats.processed == n_msgs, stats.as_dict()
-        if stats.msgs_per_sec > best:
+    for _ in range(max(runs, 1)):
+        stats = _stream_run(pipe, texts, batch_size, depth, n_msgs)
+        if best_stats is None or stats.msgs_per_sec > best:
             best, best_stats = stats.msgs_per_sec, stats
 
     line = {
@@ -224,6 +250,13 @@ def main() -> None:
     }
     if model != "lr":
         line["metric"] += f"_{model}"
+    if model == "lr" and os.environ.get("BENCH_TREES", "1") != "0":
+        # Tree-family streaming rides the same raw-JSON path (the
+        # reference's primary trained family, fraud_detection_spark.py:
+        # 56-91); record it in the same line so the driver's artifact
+        # carries the evidence, not just README prose.
+        line["tree_streaming"] = tree_streaming_bench(
+            texts, batch_size, depth, n_msgs=min(n_msgs, 10_000))
     if os.environ.get("BENCH_TRAIN", "1") != "0":
         line["training"] = training_bench()
     print(json.dumps(line))

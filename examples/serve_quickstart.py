@@ -1,0 +1,50 @@
+"""Score dialogues with the shipped reference model (or a synthetic one).
+
+Run:  python examples/serve_quickstart.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ARTIFACT = "/root/reference/dialogue_classification_model"
+
+SCAM = (
+    "Agent: Congratulations! You have been selected as the winner of our "
+    "grand prize. To process your prize payment immediately we just need "
+    "you to verify your social security number and bank account details. "
+    "This is urgent - the offer expires today. Customer: Oh wow, really? "
+    "Agent: Yes! Please confirm your account number now to claim it."
+) * 3
+BENIGN = (
+    "Agent: Good morning, this is the dental office calling to confirm "
+    "your cleaning appointment on Thursday at two thirty. Customer: Yes, "
+    "that works for me, thank you for the reminder. Agent: Great, we will "
+    "see you then. Have a nice day."
+) * 3
+
+
+def build_pipeline():
+    from fraud_detection_tpu.models import ServingPipeline
+
+    if os.path.isdir(ARTIFACT):
+        from fraud_detection_tpu import load_spark_pipeline
+
+        print("using the shipped Spark artifact (F1-parity weights)")
+        return ServingPipeline.from_spark_artifact(
+            load_spark_pipeline(ARTIFACT), batch_size=16)
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    print("reference artifact not found; training a synthetic demo model")
+    return synthetic_demo_pipeline(batch_size=16)
+
+
+def main():
+    pipe = build_pipeline()
+    for name, text in [("scam-like", SCAM), ("benign", BENIGN)]:
+        label, p = pipe.predict_one(text)
+        print(f"{name:10s} -> prediction={label}  p(scam)={float(p):.6f}")
+
+
+if __name__ == "__main__":
+    main()

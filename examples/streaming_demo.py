@@ -1,0 +1,44 @@
+"""End-to-end streaming classification through the in-process broker.
+
+The same engine drives real Kafka via fraud_detection_tpu.stream.kafka —
+the broker here is the injection seam (SURVEY.md §4 point 3).
+
+Run:  python examples/streaming_demo.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    from fraud_detection_tpu.data import generate_corpus
+    from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+    from examples.serve_quickstart import build_pipeline
+
+    pipe = build_pipeline()
+    broker = InProcessBroker(num_partitions=3)
+    producer = broker.producer()
+    corpus = generate_corpus(n=500, seed=11)
+    for i, d in enumerate(corpus):
+        producer.produce("customer-dialogues-raw",
+                         json.dumps({"text": d.text, "id": i}).encode(),
+                         key=str(i).encode())
+    producer.produce("customer-dialogues-raw", b"not json", key=b"oops")
+
+    consumer = broker.consumer(["customer-dialogues-raw"], "demo-group")
+    engine = StreamingClassifier(
+        pipe, consumer, broker.producer(), "dialogues-classified",
+        batch_size=128, max_wait=0.01, pipeline_depth=2)
+    stats = engine.run(max_messages=501, idle_timeout=2.0)
+
+    outs = broker.messages("dialogues-classified")
+    print(f"processed={stats.processed} malformed={stats.malformed} "
+          f"rate={stats.msgs_per_sec:.0f} msgs/sec "
+          f"p50={stats.latency_percentile(50)*1e3:.0f}ms")
+    print("sample output:", outs[0].value.decode()[:120], "...")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@
 #include <atomic>
 #include <charconv>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <string>
 #include <string_view>
@@ -842,6 +843,28 @@ void ftok_encode_fill16(void* h, int16_t* ids, uint16_t* counts, int n_rows, int
             [](float v) { return uint16_t(v > 65535.0f ? 65535u : uint32_t(v)); });
 }
 
+// %.6f, locale-independent and hard-bounded: a co-loaded library calling
+// setlocale must not turn the decimal point into a comma, and out-of-[0,1]
+// inputs whose fixed rendering exceeds the caller's size estimate must fail
+// cleanly (nullptr) instead of overrunning. Float to_chars needs libstdc++
+// 11+; older C++17 toolchains take the bounded snprintf + comma-patch path
+// so the on-demand build never regresses to import failure.
+static inline char* format_fixed6(char* p, char* lim, double v) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  auto cr = std::to_chars(p, lim, v, std::chars_format::fixed, 6);
+  if (cr.ec != std::errc()) return nullptr;
+  return cr.ptr;
+#else
+  long long rem = lim - p;
+  if (rem <= 1) return nullptr;
+  int n = std::snprintf(p, size_t(rem), "%.6f", v);
+  if (n < 0 || n >= rem) return nullptr;  // truncated: caller returns -1
+  for (char* q = p; q < p + n; ++q)
+    if (*q == ',') *q = '.';  // LC_NUMERIC-proof
+  return p + n;
+#endif
+}
+
 // Assemble the engine's classified-output wire frames for a whole batch in
 // one pass (stateless — no handle). Frame layout must stay byte-identical to
 // the engine's Python template path (stream/engine.py _OUT_TEMPLATE):
@@ -881,15 +904,8 @@ long long ftok_build_frames(const char** msgs, const int32_t* span_start,
     std::memcpy(p, label_jsons[lab], size_t(label_json_lens[lab]));
     p += label_json_lens[lab];
     std::memcpy(p, kConf, sizeof(kConf) - 1); p += sizeof(kConf) - 1;
-    // to_chars, not snprintf: locale-independent (a co-loaded library
-    // calling setlocale must not turn the decimal point into a comma) and
-    // hard-bounded by `lim` even for out-of-[0,1] caller inputs whose fixed
-    // rendering exceeds the 96-byte estimate.
-    {
-      auto cr = std::to_chars(p, lim, confs[i], std::chars_format::fixed, 6);
-      if (cr.ec != std::errc()) return -1;
-      p = cr.ptr;
-    }
+    p = format_fixed6(p, lim, confs[i]);
+    if (p == nullptr) return -1;
     // Re-check: an out-of-range confidence can out-grow the 14-byte
     // allowance inside `need` (to_chars above only bounded itself).
     if (p + (long long)(sizeof(kText) - 1) + span_len[i] + 1 > lim) return -1;

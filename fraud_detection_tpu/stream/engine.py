@@ -46,17 +46,27 @@ _LABEL_JSON_B = {k: json.dumps(label_name(k)).encode() for k in (0, 1)}
 # published list, so concurrent engines can race the swap but each always
 # reads a complete, correct table.
 _LABEL_TABLE = [_LABEL_JSON_B[0], _LABEL_JSON_B[1]]
+_LABEL_TABLE_S = [t.decode() for t in _LABEL_TABLE]  # str twin: no per-use decode
 
 
 def _label_json_table(max_label: int) -> list:
-    global _LABEL_TABLE
+    global _LABEL_TABLE, _LABEL_TABLE_S
     table = _LABEL_TABLE
     if max_label < len(table):
         return table
     table = table + [json.dumps(label_name(i)).encode()
                      for i in range(len(table), max_label + 1)]
     _LABEL_TABLE = table
+    _LABEL_TABLE_S = [t.decode() for t in table]
     return table
+
+
+def _label_json_str(label: int) -> str:
+    table = _LABEL_TABLE_S
+    if label < len(table):
+        return table[label]
+    _label_json_table(label)
+    return _LABEL_TABLE_S[label]
 
 
 def _confidence_array(preds) -> np.ndarray:
@@ -304,7 +314,7 @@ class StreamingClassifier:
                     # Fast path: only the text needs JSON escaping; the frame
                     # is a fixed template (json.dumps of the full dict costs
                     # ~2.5x more and this runs per message at 30k+/sec).
-                    label_json = _label_json_table(label)[label].decode()
+                    label_json = _label_json_str(label)
                     wire = (_OUT_TEMPLATE % (label, label_json,
                                              confidence, json.dumps(text))).encode()
                 else:
