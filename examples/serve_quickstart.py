@@ -24,7 +24,7 @@ BENIGN = (
 ) * 3
 
 
-def build_pipeline():
+def build_pipeline(batch_size: int = 16):
     from fraud_detection_tpu.models import ServingPipeline
 
     if os.path.isdir(ARTIFACT):
@@ -32,11 +32,11 @@ def build_pipeline():
 
         print("using the shipped Spark artifact (F1-parity weights)")
         return ServingPipeline.from_spark_artifact(
-            load_spark_pipeline(ARTIFACT), batch_size=16)
+            load_spark_pipeline(ARTIFACT), batch_size=batch_size)
     from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
 
     print("reference artifact not found; training a synthetic demo model")
-    return synthetic_demo_pipeline(batch_size=16)
+    return synthetic_demo_pipeline(batch_size=batch_size)
 
 
 def main():

@@ -17,7 +17,7 @@ def main():
     from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
     from examples.serve_quickstart import build_pipeline
 
-    pipe = build_pipeline()
+    pipe = build_pipeline(batch_size=128)  # match the engine's micro-batch
     broker = InProcessBroker(num_partitions=3)
     producer = broker.producer()
     corpus = generate_corpus(n=500, seed=11)

@@ -6,7 +6,9 @@ from the same pod as the classifier. This module is that model: a standard
 pre-norm decoder (RMSNorm / RoPE multi-head attention / SwiGLU), written as
 pure-functional JAX over a params pytree so the same forward runs
 
-  * single-chip (tests, small models),
+  * single-chip (tests, small models) — long sequences dispatch to the
+    Pallas flash-attention kernel (``ops/attention.py``: blockwise online
+    softmax, O(T·d) memory, both matmuls on the MXU),
   * tensor-parallel over a mesh "model" axis — head-sharded attention and
     hidden-sharded MLP with GSPMD inserting the all-reduces (the Megatron
     column/row-parallel layout expressed as shardings, not explicit
@@ -174,6 +176,32 @@ def _attend(q, k, v, mask) -> jax.Array:
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+# Below this the materialized-score path is cheaper to compile and its
+# O(T^2) scores are small; above it the flash kernel keeps memory O(T*d).
+_FLASH_MIN_T = 512
+
+
+def causal_attention(q, k, v, use_flash: Optional[bool] = None) -> jax.Array:
+    """Full-sequence causal attention: the Pallas flash kernel
+    (ops/attention.py — blockwise online softmax, scores never
+    materialized) for long sequences, the plain path for short prompts.
+
+    ``use_flash``: None = auto by length. Callers running under
+    model-axis-sharded params (tensor parallelism) must pass False —
+    ``pallas_call`` has no GSPMD partitioning rule, so the flash path would
+    force an all-gather of the head-sharded activations, while ``_attend``'s
+    einsums partition cleanly over heads."""
+    if use_flash is None:
+        use_flash = q.shape[1] >= _FLASH_MIN_T
+    if use_flash:
+        from fraud_detection_tpu.ops.attention import (auto_interpret,
+                                                       flash_attention)
+
+        return flash_attention(q, k, v, interpret=auto_interpret())
+    causal = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
+    return _attend(q, k, v, causal)
+
+
 # ---------------------------------------------------------------------------
 # ring attention (sequence parallelism)
 # ---------------------------------------------------------------------------
@@ -250,11 +278,15 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             *, positions: Optional[jax.Array] = None,
             kv_cache: Optional[Dict[str, jax.Array]] = None,
             cache_len: Optional[jax.Array] = None,
-            seq_mesh: Optional[Mesh] = None) -> Tuple[jax.Array, Optional[Dict]]:
+            seq_mesh: Optional[Mesh] = None,
+            use_flash: Optional[bool] = None) -> Tuple[jax.Array, Optional[Dict]]:
     """Logits for a token batch (B, T) -> (B, T, V).
 
     Three modes:
-      * full-sequence (kv_cache None, seq_mesh None): plain causal attention;
+      * full-sequence (kv_cache None, seq_mesh None): causal attention —
+        the flash kernel for long sequences (``use_flash`` None = auto;
+        pass False when params are model-axis sharded, see
+        ``causal_attention``);
       * ring (seq_mesh given): sequence-parallel exact attention — T sharded
         over the mesh "seq" axis (prefill/scoring of long transcripts);
       * incremental (kv_cache given): T == 1 decode step against the cache;
@@ -298,8 +330,7 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         elif seq_mesh is not None:
             attn = ring_attention(q, expand_kv(k), expand_kv(v), seq_mesh)
         else:
-            causal = jnp.tril(jnp.ones((T, T), bool))
-            attn = _attend(q, expand_kv(k), expand_kv(v), causal)
+            attn = causal_attention(q, expand_kv(k), expand_kv(v), use_flash)
 
         x = x + jnp.einsum("bthd,hdD->btD", attn, params[f"l{l}.wo"])
         h2 = rms_norm(x, params[f"l{l}.ln2"], cfg.rms_eps)

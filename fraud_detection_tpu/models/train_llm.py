@@ -107,7 +107,12 @@ def batch_for_step(stream: np.ndarray, step: int, tcfg: LLMTrainConfig) -> np.nd
 def _loss_fn(params: Params, windows: jax.Array, cfg: TransformerConfig,
              remat: bool) -> jax.Array:
     """Mean next-token cross-entropy over (B, T+1) windows."""
-    fwd = jax.checkpoint(forward, static_argnums=(2,)) if remat else forward
+    # use_flash=False: training runs params model-axis sharded (dp x tp) and
+    # pallas_call has no GSPMD partitioning rule (llm.causal_attention).
+    # Bound via partial so jax.checkpoint never traces the flag.
+    fwd = partial(forward, use_flash=False)
+    if remat:
+        fwd = jax.checkpoint(fwd, static_argnums=(2,))
     logits, _ = fwd(params, windows[:, :-1], cfg)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     tgt = windows[:, 1:]

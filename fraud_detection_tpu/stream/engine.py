@@ -56,8 +56,10 @@ def _label_json_table(max_label: int) -> list:
         return table
     table = table + [json.dumps(label_name(i)).encode()
                      for i in range(len(table), max_label + 1)]
-    _LABEL_TABLE = table
+    # Publish the str twin FIRST: readers gate on len(_LABEL_TABLE), so the
+    # twin must already cover anything the bytes table admits.
     _LABEL_TABLE_S = [t.decode() for t in table]
+    _LABEL_TABLE = table
     return table
 
 
@@ -65,8 +67,9 @@ def _label_json_str(label: int) -> str:
     table = _LABEL_TABLE_S
     if label < len(table):
         return table[label]
-    _label_json_table(label)
-    return _LABEL_TABLE_S[label]
+    # Build from the grown bytes table locally — never index the global twin
+    # after growth (a concurrent grower may republish between the calls).
+    return _label_json_table(label)[label].decode()
 
 
 def _confidence_array(preds) -> np.ndarray:
