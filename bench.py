@@ -55,6 +55,12 @@ def build_pipeline(batch_size: int, model: str = "lr"):
     return synthetic_demo_pipeline(batch_size, model=model)
 
 
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 def pallas_parity_check() -> float:
     """Pallas vs XLA agreement for BOTH kernels on the REAL backend
     (compiled on TPU, interpret elsewhere) — the training bench must measure
@@ -209,6 +215,46 @@ def tree_streaming_bench(texts, batch_size: int, depth: int,
     return out
 
 
+def llm_bench() -> dict:
+    """On-pod explanation LLM evidence: prefill tokens/sec through the
+    flash-attention path at T=2048 and incremental decode tokens/sec
+    against the KV cache (BASELINE config 5 — the zero-egress replacement
+    for the reference's per-message DeepSeek HTTPS round trip,
+    utils/agent_api.py:36,66)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.models import llm
+
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    cfg = llm.TransformerConfig(d_model=256, n_layers=4, n_heads=8,
+                                d_ff=1024, max_seq=4096, dtype=dtype)
+    model = llm.LanguageModel.init_random(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    T = 2048
+    toks = jnp.asarray(rng.integers(0, 256, size=(1, T)), jnp.int32)
+
+    # Jitted, like the decode path's _generate_jit — timing the eager
+    # per-op dispatch instead would swamp this small model's compute.
+    prefill = jax.jit(lambda p, t: llm.forward(p, t, cfg)[0])
+    prefill(model.params, toks).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = prefill(model.params, toks)
+    out.block_until_ready()
+    prefill_tok_s = 3 * T / (time.perf_counter() - t0)
+
+    prompt = rng.integers(0, 256, size=128)
+    n_new = 64
+    model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)  # compile
+    t0 = time.perf_counter()
+    model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
+    decode_tok_s = n_new / (time.perf_counter() - t0)
+    return {"prefill_tok_per_s": round(prefill_tok_s, 1),
+            "decode_tok_per_s": round(decode_tok_s, 1),
+            "prefill_T": T, "dtype": str(dtype.__name__)}
+
+
 def main() -> None:
     from fraud_detection_tpu.data import generate_corpus
 
@@ -259,6 +305,12 @@ def main() -> None:
             texts, batch_size, depth, n_msgs=min(n_msgs, 10_000))
     if os.environ.get("BENCH_TRAIN", "1") != "0":
         line["training"] = training_bench()
+    # LLM leg: default-on only where it's fast (real TPU). Off-TPU the
+    # T=2048 prefill runs the flash kernel in interpret mode — minutes of
+    # per-cell Python — so it must be explicitly requested there.
+    want_llm = os.environ.get("BENCH_LLM")
+    if model == "lr" and (want_llm == "1" or (want_llm is None and _on_tpu())):
+        line["llm"] = llm_bench()
     print(json.dumps(line))
 
 
