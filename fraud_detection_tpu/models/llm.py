@@ -206,43 +206,82 @@ def causal_attention(q, k, v, use_flash: Optional[bool] = None) -> jax.Array:
 # ring attention (sequence parallelism)
 # ---------------------------------------------------------------------------
 
+def _online_softmax_update(qf, k_part, v_part, q_pos, k_pos, m, l, acc,
+                           scale: float):
+    """One online-softmax accumulation against a slice of keys/values —
+    the shared inner math of the ring step and its key-chunked variant."""
+    scores = jnp.einsum("bthd,bshd->bhts", qf, k_part.astype(jnp.float32)) * scale
+    causal = q_pos[:, None] >= k_pos[None, :]                # (T, S_part)
+    scores = jnp.where(causal[None, None], scores, -jnp.inf)
+    blk_max = jnp.max(scores, axis=-1)                       # (B,H,T)
+    m_new = jnp.maximum(m, blk_max)
+    # guard fully-masked rows (no valid key yet in this slice)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    acc_new = (acc * correction[..., None]
+               + jnp.einsum("bhts,bshd->bthd", p, v_part.astype(jnp.float32))
+                 .transpose(0, 2, 1, 3))
+    return m_new, l_new, acc_new
+
+
+# Peak-memory knob for the ring step: scores materialize (B, H, T_loc,
+# chunk) instead of (B, H, T_loc, T_loc) — without it a 4k-per-device shard
+# costs 512MB of f32 scores per head-8 step, defeating the ring's O(T/n)
+# memory promise on exactly the long-transcript workloads it exists for.
+_RING_KEY_CHUNK = 2048
+
+
 def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
-                            scale: float):
+                            scale: float, key_chunk: int = _RING_KEY_CHUNK):
     """Per-shard body (runs under shard_map): exact causal attention with K/V
-    blocks rotating around the ring, flash-style online softmax.
+    blocks rotating around the ring, flash-style online softmax; within a
+    step, keys are processed in ``key_chunk`` slices so score memory stays
+    O(T_loc * key_chunk).
 
     q, k, v: (B, T_loc, H, d) — this device's sequence shard.
     Device r owns global positions [r*T_loc, (r+1)*T_loc).
     """
+    if key_chunk < 1:
+        raise ValueError(f"key_chunk must be >= 1, got {key_chunk}")
     idx = jax.lax.axis_index(axis_name)
     B, T, H, d = q.shape
     qf = q.astype(jnp.float32)
+    # Smallest chunk count that divides T with chunk <= key_chunk (trace-time
+    # search; T is static). Indivisible worst cases degrade gracefully to
+    # more, smaller chunks rather than refusing.
+    n_chunks = 1
+    if T > key_chunk:
+        n_chunks = next(c for c in range(-(-T // key_chunk), T + 1)
+                        if T % c == 0)
+    chunk = T // n_chunks
 
     def step(s, carry):
         k_blk, v_blk, m, l, acc = carry
         # after s rotations device idx holds the block produced by idx - s
         src = (idx - s) % blocks_per_ring
-        scores = jnp.einsum("bthd,bshd->bhts", qf, k_blk.astype(jnp.float32)) * scale
         q_pos = idx * T + jnp.arange(T)
-        k_pos = src * T + jnp.arange(T)
-        causal = q_pos[:, None] >= k_pos[None, :]            # (T, S)
-        scores = jnp.where(causal[None, None], scores, -jnp.inf)
-        blk_max = jnp.max(scores, axis=-1)                   # (B,H,T)
-        m_new = jnp.maximum(m, blk_max)
-        # guard fully-masked rows (no valid key yet in this block)
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(scores - m_safe[..., None])
-        p = jnp.where(jnp.isneginf(scores), 0.0, p)
-        correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-        l_new = l * correction + jnp.sum(p, axis=-1)
-        acc_new = (acc * correction[..., None]
-                   + jnp.einsum("bhts,bshd->bthd", p, v_blk.astype(jnp.float32))
-                     .transpose(0, 2, 1, 3))
+        if n_chunks == 1:
+            k_pos = src * T + jnp.arange(T)
+            m, l, acc = _online_softmax_update(
+                qf, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale)
+        else:
+            def chunk_body(c, inner):
+                mi, li, ai = inner
+                k_c = jax.lax.dynamic_slice_in_dim(k_blk, c * chunk, chunk, 1)
+                v_c = jax.lax.dynamic_slice_in_dim(v_blk, c * chunk, chunk, 1)
+                k_pos = src * T + c * chunk + jnp.arange(chunk)
+                return _online_softmax_update(
+                    qf, k_c, v_c, q_pos, k_pos, mi, li, ai, scale)
+
+            m, l, acc = jax.lax.fori_loop(0, n_chunks, chunk_body, (m, l, acc))
         k_next = jax.lax.ppermute(
             k_blk, axis_name, [(i, (i + 1) % blocks_per_ring) for i in range(blocks_per_ring)])
         v_next = jax.lax.ppermute(
             v_blk, axis_name, [(i, (i + 1) % blocks_per_ring) for i in range(blocks_per_ring)])
-        return k_next, v_next, m_new, l_new, acc_new
+        return k_next, v_next, m, l, acc
 
     # pvary: the accumulators become device-varying on the first iteration, so
     # their carry types must be marked varying over the ring axis up front.
@@ -256,15 +295,17 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   axis_name: str = SEQ_AXIS) -> jax.Array:
+                   axis_name: str = SEQ_AXIS,
+                   key_chunk: int = _RING_KEY_CHUNK) -> jax.Array:
     """Exact causal attention with the sequence sharded over ``axis_name``.
 
     q/k/v: (B, T, H, d) global arrays; T must divide by the axis size.
+    ``key_chunk`` bounds per-step score memory (see ``_RING_KEY_CHUNK``).
     """
     n = mesh.shape[axis_name]
     scale = 1.0 / math.sqrt(q.shape[-1])
     body = partial(_ring_attention_sharded, axis_name=axis_name,
-                   blocks_per_ring=n, scale=scale)
+                   blocks_per_ring=n, scale=scale, key_chunk=key_chunk)
     spec = P(None, axis_name, None, None)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
