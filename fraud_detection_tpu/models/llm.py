@@ -249,14 +249,15 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
     idx = jax.lax.axis_index(axis_name)
     B, T, H, d = q.shape
     qf = q.astype(jnp.float32)
-    # Smallest chunk count that divides T with chunk <= key_chunk (trace-time
-    # search; T is static). Indivisible worst cases degrade gracefully to
-    # more, smaller chunks rather than refusing.
-    n_chunks = 1
-    if T > key_chunk:
-        n_chunks = next(c for c in range(-(-T // key_chunk), T + 1)
-                        if T % c == 0)
-    chunk = T // n_chunks
+    # Ceil-division chunking (T is static): the last chunk may overhang the
+    # block; overhang keys are masked out via a sentinel position, so any
+    # T_loc — prime lengths included — keeps chunk ~= key_chunk instead of
+    # degrading to tiny divisors.
+    chunk = min(T, key_chunk)
+    n_chunks = -(-T // chunk)
+    pad = n_chunks * chunk - T
+    # Sentinel above every real global position: the causal mask rejects it.
+    far = blocks_per_ring * T + 1
 
     def step(s, carry):
         k_blk, v_blk, m, l, acc = carry
@@ -268,11 +269,15 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
             m, l, acc = _online_softmax_update(
                 qf, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale)
         else:
+            k_pad = jnp.pad(k_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v_pad = jnp.pad(v_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
             def chunk_body(c, inner):
                 mi, li, ai = inner
-                k_c = jax.lax.dynamic_slice_in_dim(k_blk, c * chunk, chunk, 1)
-                v_c = jax.lax.dynamic_slice_in_dim(v_blk, c * chunk, chunk, 1)
-                k_pos = src * T + c * chunk + jnp.arange(chunk)
+                k_c = jax.lax.dynamic_slice_in_dim(k_pad, c * chunk, chunk, 1)
+                v_c = jax.lax.dynamic_slice_in_dim(v_pad, c * chunk, chunk, 1)
+                j = c * chunk + jnp.arange(chunk)
+                k_pos = jnp.where(j < T, src * T + j, far)
                 return _online_softmax_update(
                     qf, k_c, v_c, q_pos, k_pos, mi, li, ai, scale)
 

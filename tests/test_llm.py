@@ -175,15 +175,15 @@ def test_tp_generation_runs():
 def test_ring_attention_key_chunked_matches_dense():
     """Force the within-step key-chunk loop (key_chunk < T_loc) — the
     memory-bounded path long shards take — and require exact agreement
-    with dense causal attention, including an indivisible chunk size that
-    must degrade to a smaller divisor."""
+    with dense causal attention, including indivisible chunk sizes whose
+    final overhang chunk is sentinel-masked."""
     mesh = seq_mesh(8)
     B, T, H, d = 1, 128, 2, 16    # T_loc = 16 per device
     rng = np.random.default_rng(7)
     q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
                for _ in range(3))
     dense = _attend(q, k, v, jnp.tril(jnp.ones((T, T), bool)))
-    for key_chunk in (4, 5, 16):  # 5 does not divide 16 -> falls to 4
+    for key_chunk in (4, 5, 7, 16):  # 5, 7: overhang chunks (16 % c != 0)
         ring = ring_attention(q, k, v, mesh, key_chunk=key_chunk)
         np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
                                    rtol=2e-5, atol=2e-5,

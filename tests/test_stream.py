@@ -476,3 +476,66 @@ def test_build_frames_float_formatting_parity():
         got = blob[start:ends[i]]
         start = int(ends[i])
         assert got == want, (i, got, want)
+
+
+def test_run_supervised_chaos_randomized(pipeline):
+    """Randomized fault injection (SURVEY.md §5 — the reference has none):
+    flush crashes, undrained flushes, and poll crashes fire at random points
+    across many engine incarnations. The at-least-once contract must hold —
+    every input classified at least once, losses never, duplicates allowed —
+    and the supervisor must actually have exercised restarts."""
+    import random as _random
+
+    from fraud_detection_tpu.stream.engine import run_supervised
+
+    rng = _random.Random(1234)
+    broker = InProcessBroker(num_partitions=3)
+    prod = broker.producer()
+    n = 120
+    for i in range(n):
+        prod.produce("t", json.dumps(
+            {"text": f"chaotic message number {i}", "id": i}).encode(),
+            key=str(i).encode())
+
+    class ChaoticProducer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def produce(self, topic, value, key=None):
+            self.inner.produce(topic, value, key)
+
+        def produce_batch(self, topic, items):
+            self.inner.produce_batch(topic, items)
+
+        def flush(self, timeout: float = 10.0) -> int:
+            r = rng.random()
+            if r < 0.15:
+                raise ConnectionError("chaos: flush crashed")
+            if r < 0.30:
+                return 1  # undrained: triggers the abort-don't-commit path
+            return self.inner.flush(timeout)
+
+    class ChaoticConsumer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def poll_batch(self, max_messages, timeout):
+            if rng.random() < 0.10:
+                raise TimeoutError("chaos: poll crashed")
+            return self.inner.poll_batch(max_messages, timeout)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    def make_engine():
+        return StreamingClassifier(
+            pipeline, ChaoticConsumer(broker.consumer(["t"], "chaos")),
+            ChaoticProducer(broker.producer()), "out", batch_size=16)
+
+    stats = run_supervised(make_engine, max_restarts=200, backoff=0.0,
+                           max_messages=n, idle_timeout=0.2,
+                           sleep=lambda s: None)
+    outs = broker.messages("out")
+    seen = {json.loads(m.value)["original_text"] for m in outs}
+    assert len(seen) == n, f"lost {n - len(seen)} messages"
+    assert stats.restarts > 0  # the chaos actually bit
