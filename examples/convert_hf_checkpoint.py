@@ -1,0 +1,63 @@
+"""Serve a HuggingFace checkpoint on-pod as the explanation LLM.
+
+Point this at a locally downloaded HF model directory (config.json +
+*.safetensors [+ index] + tokenizer files) and it becomes the zero-egress
+replacement for the reference's hosted DeepSeek round trip
+(utils/agent_api.py:36,66): Llama/Mistral/Gemma-family decoders convert
+into the framework's pytree layout (checkpoint/hf_convert.py — GQA/MQA,
+untied heads, Gemma's norm/scale/GeGLU quirks all handled, verified
+against an independent numpy forward in tests/test_hf_convert.py).
+
+Run:  python examples/convert_hf_checkpoint.py /path/to/hf-model-dir
+      python examples/convert_hf_checkpoint.py          # tiny synthetic demo
+"""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_synthetic_checkpoint(d: str) -> str:
+    """A tiny random Llama-architecture checkpoint so the demo runs without
+    downloading anything (the conversion path is identical)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+    from test_hf_convert import make_hf_config, make_hf_state
+
+    from fraud_detection_tpu.checkpoint.hf_convert import write_safetensors
+
+    hf = make_hf_config(gemma=False, n_kv=2)
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(hf, f)
+    write_safetensors(os.path.join(d, "model.safetensors"),
+                      make_hf_state(hf, seed=7))
+    return d
+
+
+def main():
+    from fraud_detection_tpu.explain.onpod import OnPodBackend
+
+    if len(sys.argv) > 1:
+        ckpt, tokenizer = sys.argv[1], None  # real dir: use its tokenizer
+        backend = OnPodBackend.from_hf_checkpoint(ckpt)
+    else:
+        with tempfile.TemporaryDirectory() as d:
+            make_synthetic_checkpoint(d)
+            from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
+
+            lm = load_hf_checkpoint(d, max_seq=128, tokenizer="byte")
+            backend = OnPodBackend.from_model(lm)
+            print("loaded synthetic checkpoint:",
+                  f"{lm.cfg.n_layers} layers, d_model={lm.cfg.d_model},",
+                  f"kv_heads={lm.cfg.kv_heads} (GQA)")
+
+    reply = backend.generate(
+        "Classify this call: 'you won a prize, read me your SSN'.",
+        temperature=0.0)
+    print("backend reply (random weights => noise; real weights => analysis):")
+    print(repr(reply[:200]))
+
+
+if __name__ == "__main__":
+    main()
