@@ -36,10 +36,25 @@ class OnPodBackend(_GenerateMixin):
     """LLMBackend over an in-process generation function."""
 
     generate_fn: Callable[[str, float, int], str]
+    # Optional batch variant: prompts -> replies in ONE device program (the
+    # reference pays one synchronous DeepSeek HTTPS call per message,
+    # app_ui.py:207; batching amortizes the round trip over a whole flagged
+    # batch). None = fall back to per-prompt generate_fn.
+    generate_batch_fn: Optional[Callable[[Sequence[str], float, int],
+                                         Sequence[str]]] = None
 
     def chat(self, messages: Sequence[ChatMessage], *, temperature: float = 1.0,
              max_tokens: int = 1000) -> str:
         return self.generate_fn(flatten_chat(messages), temperature, max_tokens)
+
+    def generate_batch(self, prompts: Sequence[str], *,
+                       temperature: float = 0.0,
+                       max_tokens: int = 256) -> Sequence[str]:
+        """Explain many dialogues per device round trip (uneven prompt
+        lengths batched via models/llm.py ``generate_text_batch``)."""
+        if self.generate_batch_fn is not None:
+            return self.generate_batch_fn(list(prompts), temperature, max_tokens)
+        return [self.generate_fn(p, temperature, max_tokens) for p in prompts]
 
     @classmethod
     def from_model(cls, lm, *, mesh=None) -> "OnPodBackend":
@@ -48,7 +63,11 @@ class OnPodBackend(_GenerateMixin):
             return lm.generate_text(prompt, temperature=temperature,
                                     max_new_tokens=max_tokens, mesh=mesh)
 
-        return cls(generate_fn)
+        def generate_batch_fn(prompts, temperature: float, max_tokens: int):
+            return lm.generate_text_batch(prompts, temperature=temperature,
+                                          max_new_tokens=max_tokens)
+
+        return cls(generate_fn, generate_batch_fn)
 
     @classmethod
     def from_hf_checkpoint(cls, ckpt_dir: str, *, mesh=None,

@@ -168,10 +168,13 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _attend(q, k, v, mask) -> jax.Array:
-    """Plain masked attention. q: (B,T,H,d), k/v: (B,S,H,d), mask (T,S)."""
+    """Plain masked attention. q: (B,T,H,d), k/v: (B,S,H,d), mask (T,S)
+    shared across the batch or (B,T,S) per-row (batched decode with uneven
+    prompt lengths)."""
     scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
     scores = scores / math.sqrt(q.shape[-1])
-    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    mask_b = mask[None] if mask.ndim == 2 else mask      # -> (B|1, T, S)
+    scores = jnp.where(mask_b[:, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
@@ -324,6 +327,7 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             *, positions: Optional[jax.Array] = None,
             kv_cache: Optional[Dict[str, jax.Array]] = None,
             cache_len: Optional[jax.Array] = None,
+            valid_from: Optional[jax.Array] = None,
             seq_mesh: Optional[Mesh] = None,
             use_flash: Optional[bool] = None) -> Tuple[jax.Array, Optional[Dict]]:
     """Logits for a token batch (B, T) -> (B, T, V).
@@ -336,7 +340,9 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
       * ring (seq_mesh given): sequence-parallel exact attention — T sharded
         over the mesh "seq" axis (prefill/scoring of long transcripts);
       * incremental (kv_cache given): T == 1 decode step against the cache;
-        returns the updated cache.
+        returns the updated cache. ``valid_from`` (B,) marks each row's
+        first REAL cache slot — left-padded batched decode masks everything
+        before it (uneven prompt lengths share one cache layout).
     """
     B, T = tokens.shape
     if positions is None:
@@ -372,6 +378,17 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             S = ck.shape[1]
             # causal within the appended block: row t sees keys <= cache_len+t
             valid = jnp.arange(S)[None, :] <= (cache_len + jnp.arange(T))[:, None]
+            if valid_from is not None:  # (B,): left-pad slots are not real
+                # Keep each query's OWN slot visible even in the pad region:
+                # a fully-masked row softmaxes to NaN, and NaN values poison
+                # later layers through 0-weighted (0 * NaN) attention sums.
+                # Pad-query outputs are garbage-but-finite and never read.
+                own = (jnp.arange(S)[None, :]
+                       == (cache_len + jnp.arange(T))[:, None])  # (T, S)
+                valid = ((valid[None]
+                          & (jnp.arange(S)[None, None, :]
+                             >= valid_from[:, None, None]))
+                         | own[None])
             attn = _attend(q, expand_kv(ck), expand_kv(cv), valid)
         elif seq_mesh is not None:
             attn = ring_attention(q, expand_kv(k), expand_kv(v), seq_mesh)
@@ -398,6 +415,15 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict[str, ja
 # generation
 # ---------------------------------------------------------------------------
 
+def _sample_token(temperature, logits_1, key):
+    """Greedy below the temperature epsilon, categorical above — the ONE
+    sampling rule both decode paths (single and batched) share."""
+    greedy = jnp.argmax(logits_1, -1)
+    scaled = logits_1 / jnp.maximum(temperature, 1e-6)
+    drawn = jax.random.categorical(key, scaled, -1)
+    return jnp.where(temperature <= 1e-6, greedy, drawn).astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_new"))
 def _generate_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array,
                   cfg: TransformerConfig, max_new: int,
@@ -411,12 +437,7 @@ def _generate_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array,
                             positions=jnp.broadcast_to(jnp.arange(Tp), (B, Tp)),
                             kv_cache=cache, cache_len=jnp.int32(0))
     last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
-
-    def sample(logits_1, key):
-        greedy = jnp.argmax(logits_1, -1)
-        scaled = logits_1 / jnp.maximum(temperature, 1e-6)
-        drawn = jax.random.categorical(key, scaled, -1)
-        return jnp.where(temperature <= 1e-6, greedy, drawn).astype(jnp.int32)
+    sample = partial(_sample_token, temperature)
 
     def step(carry, _):
         cache, last_logits, pos, key = carry
@@ -429,6 +450,44 @@ def _generate_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array,
 
     (_, _, _, _), toks = jax.lax.scan(
         step, (cache, last, prompt_len, rng), None, length=max_new)
+    return toks.T  # (B, max_new)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new"))
+def _generate_batch_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array,
+                        cfg: TransformerConfig, max_new: int,
+                        temperature: jax.Array, rng: jax.Array):
+    """Batched decode for UNEVEN prompt lengths. prompt: (B, Tp) LEFT-padded
+    so every row's last real token sits at Tp-1 — all rows then share one
+    scalar write position per step, while ``valid_from`` masks each row's
+    left-pad slots out of attention and RoPE positions stay per-row real
+    (negative on pads, which the mask discards). Returns (B, max_new).
+    Row b's greedy output matches the B=1 path on the same prompt —
+    tests/test_llm.py::test_batched_generation_matches_single."""
+    B, Tp = prompt.shape
+    max_len = Tp + max_new
+    cache = init_cache(cfg, B, max_len)
+    valid_from = Tp - prompt_len                               # (B,)
+    positions = jnp.arange(Tp)[None, :] - valid_from[:, None]  # real idx; <0 on pads
+    logits, cache = forward(params, prompt, cfg, positions=positions,
+                            kv_cache=cache, cache_len=jnp.int32(0),
+                            valid_from=valid_from)
+    last = logits[:, -1]                                       # every row ends at Tp-1
+    sample = partial(_sample_token, temperature)
+
+    def step(carry, i):
+        cache, last_logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(last_logits, sub)                         # (B,)
+        pos = prompt_len + i                                   # (B,) real position
+        logits, cache = forward(params, tok[:, None], cfg,
+                                positions=pos[:, None],
+                                kv_cache=cache, cache_len=Tp + i,
+                                valid_from=valid_from)
+        return (cache, logits[:, 0], key), tok
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache, last, rng), jnp.arange(max_new))
     return toks.T  # (B, max_new)
 
 
@@ -485,6 +544,28 @@ class LanguageModel:
                              jax.random.PRNGKey(seed))
         return np.asarray(toks)[0]
 
+    def generate_tokens_batch(self, prompts, *, max_new_tokens: int = 64,
+                              temperature: float = 0.0,
+                              seed: int = 0) -> np.ndarray:
+        """Decode a batch of UNEVEN-length prompts in one device program
+        (one prefill + one scan — a single tunnel round trip for the whole
+        batch). Prompts are left-padded to a shared bucket; per-row validity
+        masking keeps each row's context exactly its own prompt. Returns
+        (B, max_new_tokens)."""
+        if len(prompts) == 0:
+            return np.zeros((0, max_new_tokens), np.int32)
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        pad = 8 * ((int(lens.max()) + 7) // 8)  # bucket: fewer recompiles
+        prompt = np.zeros((len(prompts), pad), np.int32)
+        for i, p in enumerate(prompts):
+            prompt[i, pad - len(p):] = p        # LEFT-padded
+        toks = _generate_batch_jit(self.params, jnp.asarray(prompt),
+                                   jnp.asarray(lens), self.cfg,
+                                   int(max_new_tokens),
+                                   jnp.float32(temperature),
+                                   jax.random.PRNGKey(seed))
+        return np.asarray(toks)
+
     def generate_text(self, prompt: str, *, temperature: float = 0.0,
                       max_new_tokens: int = 256, mesh: Optional[Mesh] = None,
                       seed: int = 0) -> str:
@@ -493,3 +574,13 @@ class LanguageModel:
                                     max_new_tokens=max_new_tokens,
                                     temperature=temperature, seed=seed)
         return self.tokenizer.decode(toks)
+
+    def generate_text_batch(self, prompts, *, temperature: float = 0.0,
+                            max_new_tokens: int = 256, seed: int = 0):
+        """Batch text-in/text-out: explain MANY flagged dialogues per device
+        round trip (the reference pays one synchronous DeepSeek HTTPS call
+        per message — app_ui.py:207)."""
+        toks = self.generate_tokens_batch(
+            [self.tokenizer.encode(p) for p in prompts],
+            max_new_tokens=max_new_tokens, temperature=temperature, seed=seed)
+        return [self.tokenizer.decode(t) for t in toks]

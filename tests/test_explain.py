@@ -245,3 +245,23 @@ def test_history_larger_than_batch_size(pipeline):
     assert len(store) == 70
     hits = store.find_similar("agent: prize reward claims", k=5)
     assert len(hits) == 5
+
+
+def test_onpod_generate_batch_matches_per_prompt():
+    """The batched on-pod path (one device program for many prompts) must
+    produce the same greedy replies as per-prompt generation; a backend
+    without a batch fn falls back transparently."""
+    from fraud_detection_tpu.explain.onpod import OnPodBackend
+    from fraud_detection_tpu.models.llm import LanguageModel, TransformerConfig
+
+    lm = LanguageModel.init_random(
+        TransformerConfig(d_model=32, n_heads=2, n_layers=1, d_ff=64,
+                          max_seq=256), seed=3)
+    backend = OnPodBackend.from_model(lm)
+    prompts = ["short one", "a noticeably longer prompt about a scam call"]
+    batched = backend.generate_batch(prompts, max_tokens=8)
+    singles = [lm.generate_text(p, max_new_tokens=8) for p in prompts]
+    assert list(batched) == singles
+
+    no_batch = OnPodBackend(backend.generate_fn)
+    assert list(no_batch.generate_batch(prompts, max_tokens=8)) == singles

@@ -188,3 +188,20 @@ def test_ring_attention_key_chunked_matches_dense():
         np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
                                    rtol=2e-5, atol=2e-5,
                                    err_msg=f"key_chunk={key_chunk}")
+
+
+def test_batched_generation_matches_single(params):
+    """Batched decode over UNEVEN prompt lengths (left-pad + per-row
+    validity masking) must reproduce each prompt's B=1 greedy generation —
+    any cross-row cache contamination or off-by-one in the masking shows
+    up as a divergent token here."""
+    lm = LanguageModel(CFG, params)
+    prompts = ["Agent: hello",
+               "Customer: I was told I won a big prize yesterday",
+               "A"]
+    tok_prompts = [lm.tokenizer.encode(p) for p in prompts]
+    batched = lm.generate_tokens_batch(tok_prompts, max_new_tokens=12)
+    for i, tp in enumerate(tok_prompts):
+        single = lm.generate_tokens(tp, max_new_tokens=12)
+        np.testing.assert_array_equal(batched[i], single,
+                                      err_msg=prompts[i])
