@@ -417,40 +417,11 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict[str, ja
 
 def _sample_token(temperature, logits_1, key):
     """Greedy below the temperature epsilon, categorical above — the ONE
-    sampling rule both decode paths (single and batched) share."""
+    sampling rule the decode path uses."""
     greedy = jnp.argmax(logits_1, -1)
     scaled = logits_1 / jnp.maximum(temperature, 1e-6)
     drawn = jax.random.categorical(key, scaled, -1)
     return jnp.where(temperature <= 1e-6, greedy, drawn).astype(jnp.int32)
-
-
-@partial(jax.jit, static_argnames=("cfg", "max_new"))
-def _generate_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array,
-                  cfg: TransformerConfig, max_new: int,
-                  temperature: jax.Array, rng: jax.Array):
-    """Greedy/temperature decode. prompt: (1, Tp) padded; returns (1, max_new)."""
-    B, Tp = prompt.shape
-    max_len = Tp + max_new
-    cache = init_cache(cfg, B, max_len)
-    # prefill: run the padded prompt through decode-mode attention in one shot
-    logits, cache = forward(params, prompt, cfg,
-                            positions=jnp.broadcast_to(jnp.arange(Tp), (B, Tp)),
-                            kv_cache=cache, cache_len=jnp.int32(0))
-    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
-    sample = partial(_sample_token, temperature)
-
-    def step(carry, _):
-        cache, last_logits, pos, key = carry
-        key, sub = jax.random.split(key)
-        tok = sample(last_logits, sub)                        # (B,)
-        logits, cache = forward(params, tok[:, None], cfg,
-                                positions=pos[:, None],
-                                kv_cache=cache, cache_len=pos[0])
-        return (cache, logits[:, 0], pos + 1, key), tok
-
-    (_, _, _, _), toks = jax.lax.scan(
-        step, (cache, last, prompt_len, rng), None, length=max_new)
-    return toks.T  # (B, max_new)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new"))
@@ -534,15 +505,12 @@ class LanguageModel:
 
     def generate_tokens(self, prompt_tokens: np.ndarray, *, max_new_tokens: int = 64,
                         temperature: float = 0.0, seed: int = 0) -> np.ndarray:
-        prompt_len = len(prompt_tokens)
-        pad = 8 * ((prompt_len + 7) // 8)  # bucket prompt lengths: fewer recompiles
-        prompt = np.zeros((1, pad), np.int32)
-        prompt[0, :prompt_len] = prompt_tokens
-        toks = _generate_jit(self.params, jnp.asarray(prompt),
-                             jnp.asarray([prompt_len], jnp.int32), self.cfg,
-                             int(max_new_tokens), jnp.float32(temperature),
-                             jax.random.PRNGKey(seed))
-        return np.asarray(toks)[0]
+        """Single-prompt decode — the B=1 case of ``generate_tokens_batch``
+        (one decode program to maintain; the batch path's left-pad masking
+        degenerates to a no-op at B=1)."""
+        return self.generate_tokens_batch(
+            [np.asarray(prompt_tokens)], max_new_tokens=max_new_tokens,
+            temperature=temperature, seed=seed)[0]
 
     def generate_tokens_batch(self, prompts, *, max_new_tokens: int = 64,
                               temperature: float = 0.0,
@@ -552,11 +520,18 @@ class LanguageModel:
         batch). Prompts are left-padded to a shared bucket; per-row validity
         masking keeps each row's context exactly its own prompt. Returns
         (B, max_new_tokens)."""
-        if len(prompts) == 0:
+        n = len(prompts)
+        if n == 0:
             return np.zeros((0, max_new_tokens), np.int32)
-        lens = np.asarray([len(p) for p in prompts], np.int32)
-        pad = 8 * ((int(lens.max()) + 7) // 8)  # bucket: fewer recompiles
-        prompt = np.zeros((len(prompts), pad), np.int32)
+        # Bucket BOTH dims: prompt length to a multiple of 8 and batch size
+        # to a power of two (dummy rows, sliced away) — a live stream's
+        # per-batch valid-row count jitters, and each distinct (B, Tp) would
+        # otherwise recompile the whole decode scan.
+        b_pad = 1 << (n - 1).bit_length()
+        lens_list = [len(p) for p in prompts] + [1] * (b_pad - n)
+        lens = np.asarray(lens_list, np.int32)
+        pad = 8 * ((int(lens.max()) + 7) // 8)
+        prompt = np.zeros((b_pad, pad), np.int32)
         for i, p in enumerate(prompts):
             prompt[i, pad - len(p):] = p        # LEFT-padded
         toks = _generate_batch_jit(self.params, jnp.asarray(prompt),
@@ -564,7 +539,7 @@ class LanguageModel:
                                    int(max_new_tokens),
                                    jnp.float32(temperature),
                                    jax.random.PRNGKey(seed))
-        return np.asarray(toks)
+        return np.asarray(toks)[:n]
 
     def generate_text(self, prompt: str, *, temperature: float = 0.0,
                       max_new_tokens: int = 256, mesh: Optional[Mesh] = None,

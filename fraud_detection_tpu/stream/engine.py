@@ -173,6 +173,8 @@ class StreamingClassifier:
         text_field: str = "text",
         pipeline_depth: int = 2,
         explain_fn: Optional[Callable[[str, int, float], Optional[str]]] = None,
+        explain_batch_fn: Optional[Callable[[List[str], List[int], List[float]],
+                                            List[Optional[str]]]] = None,
     ):
         if pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
@@ -185,14 +187,21 @@ class StreamingClassifier:
         self.text_field = text_field
         self.pipeline_depth = pipeline_depth
         self.explain_fn = explain_fn
+        # Batch variant: one call per micro-batch over (texts, labels,
+        # confidences) of the valid rows — amortizes an on-pod LLM's device
+        # round trip over the whole batch (OnPodBackend.generate_batch)
+        # where the reference paid a synchronous HTTPS call per message
+        # (app_ui.py:207). Takes precedence over explain_fn when both given.
+        self.explain_batch_fn = explain_batch_fn
         self.stats = StreamStats()
         self._running = False
         self._flush_failed = False
         # Raw-JSON fast path: None = untried, False = unavailable (no native
         # library / vocab featurizer), True = in use (LR and tree models
-        # both ride it). The explain hook needs decoded text, so it forces
+        # both ride it). The explain hooks need decoded text, so they force
         # the slow path.
-        self._json_fast: Optional[bool] = None if explain_fn is None else False
+        self._json_fast: Optional[bool] = (
+            None if explain_fn is None and explain_batch_fn is None else False)
         # Native output-frame assembly: None = untried (probed on first use).
         self._frames_ok: Optional[bool] = None
         # The engine is single-driver by contract: stats, consumer position,
@@ -296,8 +305,28 @@ class StreamingClassifier:
                 for j, i in enumerate(inflight.valid_idx):
                     results[i] = (labels[j], confs[j])
 
+        # Batch explanations: ONE hook call for the whole micro-batch's valid
+        # rows (vs the per-message call below) — an on-pod LLM then explains
+        # the batch in a single device program.
+        analyses: Optional[List[Optional[str]]] = None
+        if self.explain_batch_fn is not None:
+            valid = [(i, results[i]) for i in range(len(msgs))
+                     if results[i] is not None]
+            batch_out = self.explain_batch_fn(
+                [texts[i] for i, _ in valid],
+                [r[0] for _, r in valid],
+                [r[1] for _, r in valid]) if valid else []
+            if len(batch_out) != len(valid):  # zip would silently drop rows
+                raise ValueError(
+                    f"explain_batch_fn returned {len(batch_out)} analyses "
+                    f"for {len(valid)} rows")
+            analyses = [None] * len(msgs)
+            for (i, _), a in zip(valid, batch_out):
+                analyses[i] = a
+
+        explain = self.explain_fn is not None or analyses is not None
         wires: List[tuple] = []
-        for msg, text, res in zip(msgs, texts, results):
+        for idx, (msg, text, res) in enumerate(zip(msgs, texts, results)):
             if res is None:
                 self.stats.malformed += 1
                 wire = _malformed_wire(msg)
@@ -313,7 +342,7 @@ class StreamingClassifier:
                     # their json.dumps across the hot loop).
                     label_json = _label_json_table(label)[label]
                     wire = _OUT_TEMPLATE_B % (label, label_json, confidence, text)
-                elif self.explain_fn is None:
+                elif not explain:
                     # Fast path: only the text needs JSON escaping; the frame
                     # is a fixed template (json.dumps of the full dict costs
                     # ~2.5x more and this runs per message at 30k+/sec).
@@ -327,7 +356,8 @@ class StreamingClassifier:
                         "confidence": round(confidence, 6),
                         "original_text": text,
                     }
-                    analysis = self.explain_fn(text, label, confidence)
+                    analysis = (analyses[idx] if analyses is not None
+                                else self.explain_fn(text, label, confidence))
                     if analysis is not None:
                         out["analysis"] = analysis
                     wire = json.dumps(out).encode()

@@ -539,3 +539,35 @@ def test_run_supervised_chaos_randomized(pipeline):
     seen = {json.loads(m.value)["original_text"] for m in outs}
     assert len(seen) == n, f"lost {n - len(seen)} messages"
     assert stats.restarts > 0  # the chaos actually bit
+
+
+def test_explain_batch_hook(pipeline):
+    """The batch explanation hook runs ONCE per micro-batch over the valid
+    rows (the on-pod LLM amortization seam) and its analyses land on the
+    right messages; malformed rows are excluded from the hook's input."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    corpus = generate_corpus(n=20, seed=13)
+    broker = InProcessBroker(num_partitions=1)
+    _feed(broker, [(d.text, d.label) for d in corpus])
+    broker.producer().produce("customer-dialogues-raw", b"junk", key=b"bad")
+
+    calls = []
+
+    def explain_batch(texts, labels, confs):
+        calls.append(len(texts))
+        assert len(texts) == len(labels) == len(confs)
+        return [f"batch analysis label={l}" for l in labels]
+
+    consumer = broker.consumer(["customer-dialogues-raw"], "grp")
+    engine = StreamingClassifier(
+        pipeline, consumer, broker.producer(), "out", batch_size=32,
+        max_wait=0.01, explain_batch_fn=explain_batch)
+    stats = engine.run(max_messages=21, idle_timeout=0.2)
+    assert stats.processed == 21 and stats.malformed == 1
+    assert sum(calls) == 20 and len(calls) <= 2  # once per batch, valid rows only
+    outs = [json.loads(m.value) for m in broker.messages("out")]
+    analysed = [o for o in outs if "analysis" in o]
+    assert len(analysed) == 20
+    for o in analysed:
+        assert o["analysis"] == f"batch analysis label={o['prediction']}"
