@@ -426,8 +426,8 @@ def _sample_token(temperature, logits_1, key):
 
 @partial(jax.jit, static_argnames=("cfg", "max_new"))
 def _generate_batch_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array,
-                        cfg: TransformerConfig, max_new: int,
-                        temperature: jax.Array, rng: jax.Array):
+                        row_real: jax.Array, cfg: TransformerConfig,
+                        max_new: int, temperature: jax.Array, rng: jax.Array):
     """Batched decode for UNEVEN prompt lengths. prompt: (B, Tp) LEFT-padded
     so every row's last real token sits at Tp-1 — all rows then share one
     scalar write position per step, while ``valid_from`` masks each row's
@@ -445,21 +445,35 @@ def _generate_batch_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array
                             valid_from=valid_from)
     last = logits[:, -1]                                       # every row ends at Tp-1
     sample = partial(_sample_token, temperature)
+    out0 = jnp.full((B, max_new), cfg.EOS, jnp.int32)
 
-    def step(carry, i):
-        cache, last_logits, key = carry
+    # while_loop, not scan: once every row has emitted EOS the loop exits —
+    # short answers stop paying per-step forwards (unemitted slots stay EOS,
+    # which the tokenizers already treat as end-of-text).
+    def cond(carry):
+        _, _, _, i, done, _ = carry
+        return (i < max_new) & ~jnp.all(done)
+
+    def body(carry):
+        cache, last_logits, key, i, done, out = carry
         key, sub = jax.random.split(key)
         tok = sample(last_logits, sub)                         # (B,)
+        tok = jnp.where(done, cfg.EOS, tok)                    # freeze done rows
+        out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, i))
+        done = done | (tok == cfg.EOS)
         pos = prompt_len + i                                   # (B,) real position
         logits, cache = forward(params, tok[:, None], cfg,
                                 positions=pos[:, None],
                                 kv_cache=cache, cache_len=Tp + i,
                                 valid_from=valid_from)
-        return (cache, logits[:, 0], key), tok
+        return cache, logits[:, 0], key, i + 1, done, out
 
-    (_, _, _), toks = jax.lax.scan(
-        step, (cache, last, rng), jnp.arange(max_new))
-    return toks.T  # (B, max_new)
+    # Batch-bucketing dummy rows start DONE — waiting on a garbage row that
+    # may never sample EOS would defeat the early exit for every batch whose
+    # real size isn't a power of two.
+    carry = (cache, last, rng, jnp.int32(0), ~row_real, out0)
+    *_, out = jax.lax.while_loop(cond, body, carry)
+    return out  # (B, max_new); rows past their EOS hold EOS
 
 
 class ByteTokenizer:
@@ -516,8 +530,8 @@ class LanguageModel:
                               temperature: float = 0.0,
                               seed: int = 0) -> np.ndarray:
         """Decode a batch of UNEVEN-length prompts in one device program
-        (one prefill + one scan — a single tunnel round trip for the whole
-        batch). Prompts are left-padded to a shared bucket; per-row validity
+        (one prefill + one early-exit decode loop — a single tunnel round
+        trip for the whole batch). Prompts are left-padded to a shared bucket; per-row validity
         masking keeps each row's context exactly its own prompt. Returns
         (B, max_new_tokens)."""
         n = len(prompts)
@@ -534,9 +548,10 @@ class LanguageModel:
         prompt = np.zeros((b_pad, pad), np.int32)
         for i, p in enumerate(prompts):
             prompt[i, pad - len(p):] = p        # LEFT-padded
+        row_real = np.arange(b_pad) < n
         toks = _generate_batch_jit(self.params, jnp.asarray(prompt),
-                                   jnp.asarray(lens), self.cfg,
-                                   int(max_new_tokens),
+                                   jnp.asarray(lens), jnp.asarray(row_real),
+                                   self.cfg, int(max_new_tokens),
                                    jnp.float32(temperature),
                                    jax.random.PRNGKey(seed))
         return np.asarray(toks)[:n]

@@ -205,3 +205,21 @@ def test_batched_generation_matches_single(params):
         single = lm.generate_tokens(tp, max_new_tokens=12)
         np.testing.assert_array_equal(batched[i], single,
                                       err_msg=prompts[i])
+
+
+def test_generation_freezes_after_eos(params):
+    """Once a row samples EOS the early-stop decode freezes it: every
+    later slot holds EOS (the while_loop exits when all rows are done).
+    High-temperature sampling draws EOS naturally within a few seeds."""
+    lm = LanguageModel(CFG, params)
+    enc = lm.tokenizer.encode("hello there")
+    for seed in range(40):
+        toks = lm.generate_tokens(enc, max_new_tokens=24,
+                                  temperature=3.0, seed=seed)
+        hits = np.where(toks == CFG.EOS)[0]
+        if len(hits) and hits[0] < 16:
+            first = int(hits[0])
+            assert (toks[first:] == CFG.EOS).all(), toks
+            break
+    else:
+        raise AssertionError("no early EOS drawn in 40 seeds at temp 3.0")
