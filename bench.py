@@ -248,10 +248,15 @@ def llm_bench() -> dict:
     n_new = 64
     model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)  # compile
     t0 = time.perf_counter()
-    model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
-    decode_tok_s = n_new / (time.perf_counter() - t0)
+    out = model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
+    dt = time.perf_counter() - t0
+    # Early-exit decode: count tokens actually generated (up to and incl.
+    # the first EOS), not the requested budget.
+    eos_hits = np.flatnonzero(np.asarray(out) == cfg.EOS)
+    emitted = int(eos_hits[0]) + 1 if eos_hits.size else n_new
     return {"prefill_tok_per_s": round(prefill_tok_s, 1),
-            "decode_tok_per_s": round(decode_tok_s, 1),
+            "decode_tok_per_s": round(emitted / dt, 1),
+            "decode_tokens": emitted,
             "prefill_T": T, "dtype": str(dtype.__name__)}
 
 

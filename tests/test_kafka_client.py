@@ -201,3 +201,48 @@ def test_unavailable_without_wheel():
         pytest.skip("confluent_kafka installed in this environment")
     with pytest.raises(RuntimeError, match="confluent_kafka is not installed"):
         kmod.KafkaConsumer(config=CFG)
+
+
+def test_engine_end_to_end_over_stubbed_kafka(kafka_mod):
+    """The full StreamingClassifier drives the Kafka adapters (not just the
+    in-process broker): consume -> classify -> produce -> flush -> commit,
+    with offsets committed through confluent's TopicPartition API. The
+    fake consumer feeds real JSON messages; the fake producer records what
+    the engine published."""
+    import json
+
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+    from fraud_detection_tpu.stream.engine import StreamingClassifier
+
+    pipe = synthetic_demo_pipeline(batch_size=16, n=200, seed=3,
+                                   num_features=1024)
+    consumer = kafka_mod.KafkaConsumer(CFG)
+    producer = kafka_mod.KafkaProducer(CFG)
+    texts = [f"hello agent this is customer number {i} calling about a prize"
+             for i in range(10)]
+    consumer._consumer.queue = [
+        FakeKafkaMessage(topic="raw",
+                         value=json.dumps({"text": t}).encode(),
+                         key=str(i).encode(), partition=i % 3, offset=i // 3)
+        for i, t in enumerate(texts)
+    ] + [FakeKafkaMessage(topic="raw", value=b"broken", key=b"bad",
+                          partition=0, offset=99)]
+
+    engine = StreamingClassifier(pipe, consumer, producer, "classified",
+                                 batch_size=16, max_wait=0.01)
+    stats = engine.run(max_messages=11, idle_timeout=0.3)
+
+    assert stats.processed == 11 and stats.malformed == 1
+    fake_prod = producer._producer
+    assert len(fake_prod.produced) == 11
+    outs = {key: json.loads(val) for _, val, key in fake_prod.produced}
+    for i, t in enumerate(texts):
+        payload = outs[str(i).encode()]
+        assert payload["original_text"] == t
+        assert payload["prediction"] in (0, 1)
+    assert outs[b"bad"]["error"] == "malformed message"
+    # offsets committed once per batch through TopicPartition objects
+    commits = consumer._consumer.commits
+    assert commits, "no offsets committed"
+    tps = [tp for offsets, _ in commits for tp in offsets]
+    assert {(tp.topic, tp.partition) for tp in tps} <= {("raw", 0), ("raw", 1), ("raw", 2)}
