@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+DATA_AXIS = "data"  # batch axis on 2-D (data, seq) / (data, model) meshes
 
 Params = Dict[str, jax.Array]
 
@@ -238,7 +239,8 @@ _RING_KEY_CHUNK = 2048
 
 
 def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
-                            scale: float, key_chunk: int = _RING_KEY_CHUNK):
+                            scale: float, key_chunk: int = _RING_KEY_CHUNK,
+                            batch_axis: Optional[str] = None):
     """Per-shard body (runs under shard_map): exact causal attention with K/V
     blocks rotating around the ring, flash-style online softmax; within a
     step, keys are processed in ``key_chunk`` slices so score memory stays
@@ -293,9 +295,11 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
 
     # pvary: the accumulators become device-varying on the first iteration, so
     # their carry types must be marked varying over the ring axis up front.
-    m0 = jax.lax.pvary(jnp.full((B, H, T), -jnp.inf, jnp.float32), axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((B, H, T), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((B, H, T, d), jnp.float32), axis_name)
+    vary = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
+    mark = partial(jax.lax.pcast, axis_name=vary, to="varying")
+    m0 = mark(jnp.full((B, H, T), -jnp.inf, jnp.float32))
+    l0 = mark(jnp.zeros((B, H, T), jnp.float32))
+    acc0 = mark(jnp.zeros((B, H, T, d), jnp.float32))
     _, _, m, l, acc = jax.lax.fori_loop(
         0, blocks_per_ring, step, (k, v, m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)[..., None]             # (B,H,T,d)
@@ -304,17 +308,22 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = SEQ_AXIS,
-                   key_chunk: int = _RING_KEY_CHUNK) -> jax.Array:
+                   key_chunk: int = _RING_KEY_CHUNK,
+                   batch_axis: Optional[str] = None) -> jax.Array:
     """Exact causal attention with the sequence sharded over ``axis_name``.
 
     q/k/v: (B, T, H, d) global arrays; T must divide by the axis size.
     ``key_chunk`` bounds per-step score memory (see ``_RING_KEY_CHUNK``).
+    ``batch_axis``: on a 2-D (data, seq) mesh, also shard the batch dim —
+    without it the shard_map spec would silently REPLICATE the batch across
+    the data axis (an all-gather of every dp-sharded activation).
     """
     n = mesh.shape[axis_name]
     scale = 1.0 / math.sqrt(q.shape[-1])
     body = partial(_ring_attention_sharded, axis_name=axis_name,
-                   blocks_per_ring=n, scale=scale, key_chunk=key_chunk)
-    spec = P(None, axis_name, None, None)
+                   blocks_per_ring=n, scale=scale, key_chunk=key_chunk,
+                   batch_axis=batch_axis)
+    spec = P(batch_axis, axis_name, None, None)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
 
@@ -391,7 +400,11 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
                          | own[None])
             attn = _attend(q, expand_kv(ck), expand_kv(cv), valid)
         elif seq_mesh is not None:
-            attn = ring_attention(q, expand_kv(k), expand_kv(v), seq_mesh)
+            # On a (data, seq) training mesh the batch dim rides the data
+            # axis through the ring body; a pure-seq serving mesh has none.
+            b_axis = DATA_AXIS if DATA_AXIS in seq_mesh.axis_names else None
+            attn = ring_attention(q, expand_kv(k), expand_kv(v), seq_mesh,
+                                  batch_axis=b_axis)
         else:
             attn = causal_attention(q, expand_kv(k), expand_kv(v), use_flash)
 

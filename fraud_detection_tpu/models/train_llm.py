@@ -38,10 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fraud_detection_tpu.models import llm as llm_mod
 from fraud_detection_tpu.models.llm import (
-    ByteTokenizer, LanguageModel, Params, TransformerConfig, forward,
-    init_params, param_shardings)
-
-DATA_AXIS = "data"
+    DATA_AXIS, ByteTokenizer, LanguageModel, Params, TransformerConfig,
+    forward, init_params, param_shardings)
 
 
 @dataclass(frozen=True)
@@ -105,12 +103,15 @@ def batch_for_step(stream: np.ndarray, step: int, tcfg: LLMTrainConfig) -> np.nd
 # ---------------------------------------------------------------------------
 
 def _loss_fn(params: Params, windows: jax.Array, cfg: TransformerConfig,
-             remat: bool) -> jax.Array:
+             remat: bool, seq_mesh=None) -> jax.Array:
     """Mean next-token cross-entropy over (B, T+1) windows."""
     # use_flash=False: training runs params model-axis sharded (dp x tp) and
     # pallas_call has no GSPMD partitioning rule (llm.causal_attention).
-    # Bound via partial so jax.checkpoint never traces the flag.
-    fwd = partial(forward, use_flash=False)
+    # seq_mesh: sequence-parallel training — the forward's attention rides
+    # the ring over the mesh "seq" axis (gradients flow back through the
+    # ppermute rotation). Bound via partial so jax.checkpoint never traces
+    # either flag.
+    fwd = partial(forward, use_flash=False, seq_mesh=seq_mesh)
     if remat:
         fwd = jax.checkpoint(fwd, static_argnums=(2,))
     logits, _ = fwd(params, windows[:, :-1], cfg)
@@ -130,11 +131,12 @@ def make_optimizer(tcfg: LLMTrainConfig) -> optax.GradientTransformation:
         optax.adamw(schedule, weight_decay=tcfg.weight_decay))
 
 
-@partial(jax.jit, static_argnames=("cfg", "tcfg", "opt"))
+@partial(jax.jit, static_argnames=("cfg", "tcfg", "opt", "seq_mesh"))
 def _train_step(params: Params, opt_state, windows: jax.Array,
                 cfg: TransformerConfig, tcfg: LLMTrainConfig,
-                opt: optax.GradientTransformation):
-    loss, grads = jax.value_and_grad(_loss_fn)(params, windows, cfg, tcfg.remat)
+                opt: optax.GradientTransformation, seq_mesh=None):
+    loss, grads = jax.value_and_grad(_loss_fn)(params, windows, cfg,
+                                               tcfg.remat, seq_mesh)
     updates, opt_state = opt.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return params, opt_state, loss
@@ -178,9 +180,12 @@ def fit_language_model(
 ) -> Tuple[LanguageModel, List[float]]:
     """Fine-tune the byte-level decoder on a text corpus.
 
-    With ``mesh`` (axes ``("data",)`` or ``("data", "model")``), batches shard
-    over "data" and parameters tensor-parallel over "model" — the dp x tp
-    layout an on-pod explanation model trains with. Returns the trained
+    With ``mesh`` (axes ``("data",)``, ``("data", "model")``, or
+    ``("data", "seq")``), batches shard over "data", parameters
+    tensor-parallel over "model", and attention sequence-parallel over
+    "seq" (ring attention in the training step — gradients flow through
+    the ppermute rotation) — the layouts an on-pod explanation model
+    trains with. Returns the trained
     ``LanguageModel`` and the per-step loss history of THIS invocation.
     """
     cfg = cfg or TransformerConfig()
@@ -248,6 +253,15 @@ def fit_language_model(
                 f"batch_size {tcfg.batch_size} not divisible by data axis "
                 f"size {mesh.shape[DATA_AXIS]}")
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    # Sequence parallelism: attention rides the ring over "seq" (dp x sp —
+    # long-transcript fine-tuning where a single device can't hold T).
+    seq_mesh = None
+    if mesh is not None and llm_mod.SEQ_AXIS in mesh.axis_names:
+        if tcfg.seq_len % mesh.shape[llm_mod.SEQ_AXIS] != 0:
+            raise ValueError(
+                f"seq_len {tcfg.seq_len} not divisible by seq axis "
+                f"size {mesh.shape[llm_mod.SEQ_AXIS]}")
+        seq_mesh = mesh
 
     losses: List[float] = []
     for step in range(start_step, tcfg.steps):
@@ -255,7 +269,7 @@ def fit_language_model(
         if batch_sharding is not None:
             windows = jax.device_put(windows, batch_sharding)
         params, opt_state, loss = _train_step(
-            params, opt_state, windows, cfg, tcfg, opt)
+            params, opt_state, windows, cfg, tcfg, opt, seq_mesh)
         losses.append(float(loss))
         if log_every and (step + 1) % log_every == 0:
             print(f"step {step + 1}/{tcfg.steps} loss {losses[-1]:.4f}")
