@@ -306,6 +306,48 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)         # (B,T,H,d)
 
 
+def _ulysses_sharded(q, k, v, *, axis_name: str, causal_mask):
+    """Per-shard body: all-to-all heads<->sequence, local full attention,
+    all-to-all back. q/k/v arrive (B, T/n, H, d); after the first collective
+    each device holds ALL T positions for H/n heads."""
+    def to_heads(x):   # (B, T/n, H, d) -> (B, T, H/n, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def to_seq(x):     # (B, T, H/n, d) -> (B, T/n, H, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    attn = _attend(to_heads(q), to_heads(k), to_heads(v), causal_mask)
+    return to_seq(attn)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis_name: str = SEQ_AXIS,
+                      batch_axis: Optional[str] = None) -> jax.Array:
+    """All-to-all sequence parallelism (the Ulysses layout) — the second SP
+    strategy next to ``ring_attention``. Two collectives per call re-shard
+    heads<->sequence so every device runs plain full causal attention for
+    its H/n head group over the WHOLE sequence: cheaper in ICI traffic than
+    the ring's n-step rotation when heads divide evenly and the full (T, T)
+    score block for H/n heads fits on a device; the ring (with key
+    chunking) remains the memory-bounded choice for extreme T.
+
+    q/k/v: (B, T, H, d) global; T and H must divide by the axis size.
+    """
+    n = mesh.shape[axis_name]
+    B, T, H, d = q.shape
+    if T % n or H % n:
+        raise ValueError(
+            f"ulysses_attention needs T ({T}) and H ({H}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use ring_attention otherwise")
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    body = partial(_ulysses_sharded, axis_name=axis_name, causal_mask=causal)
+    spec = P(batch_axis, axis_name, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = SEQ_AXIS,
                    key_chunk: int = _RING_KEY_CHUNK,
@@ -338,6 +380,7 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             cache_len: Optional[jax.Array] = None,
             valid_from: Optional[jax.Array] = None,
             seq_mesh: Optional[Mesh] = None,
+            sp_impl: str = "ring",
             use_flash: Optional[bool] = None) -> Tuple[jax.Array, Optional[Dict]]:
     """Logits for a token batch (B, T) -> (B, T, V).
 
@@ -346,8 +389,10 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         the flash kernel for long sequences (``use_flash`` None = auto;
         pass False when params are model-axis sharded, see
         ``causal_attention``);
-      * ring (seq_mesh given): sequence-parallel exact attention — T sharded
+      * sequence-parallel (seq_mesh given): exact attention with T sharded
         over the mesh "seq" axis (prefill/scoring of long transcripts);
+        ``sp_impl`` picks the strategy — "ring" (K/V rotation, memory-
+        bounded) or "ulysses" (two all-to-alls, head-partitioned);
       * incremental (kv_cache given): T == 1 decode step against the cache;
         returns the updated cache. ``valid_from`` (B,) marks each row's
         first REAL cache slot — left-padded batched decode masks everything
@@ -401,10 +446,12 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             attn = _attend(q, expand_kv(ck), expand_kv(cv), valid)
         elif seq_mesh is not None:
             # On a (data, seq) training mesh the batch dim rides the data
-            # axis through the ring body; a pure-seq serving mesh has none.
+            # axis through the SP body; a pure-seq serving mesh has none.
             b_axis = DATA_AXIS if DATA_AXIS in seq_mesh.axis_names else None
-            attn = ring_attention(q, expand_kv(k), expand_kv(v), seq_mesh,
-                                  batch_axis=b_axis)
+            sp = (ulysses_attention if sp_impl == "ulysses"
+                  else ring_attention)
+            attn = sp(q, expand_kv(k), expand_kv(v), seq_mesh,
+                      batch_axis=b_axis)
         else:
             attn = causal_attention(q, expand_kv(k), expand_kv(v), use_flash)
 
