@@ -181,27 +181,103 @@ def _attend(q, k, v, mask) -> jax.Array:
 
 
 # Below this the materialized-score path is cheaper to compile and its
-# O(T^2) scores are small; above it the flash kernel keeps memory O(T*d).
+# O(T^2) scores are small; above it the blockwise paths keep memory bounded.
 _FLASH_MIN_T = 512
 
 
-def causal_attention(q, k, v, use_flash: Optional[bool] = None) -> jax.Array:
-    """Full-sequence causal attention: the Pallas flash kernel
-    (ops/attention.py — blockwise online softmax, scores never
-    materialized) for long sequences, the plain path for short prompts.
+def _chunked_key_pass(qf, q_pos, k_pad, v_pad, *, chunk: int, n_chunks: int,
+                      base_pos, valid_len: int, far, carry, scale: float,
+                      remat: bool):
+    """Online-softmax accumulation over the key chunks of ONE padded block —
+    the inner loop both the ring step and the single-device chunked path
+    share (one copy of the sentinel/masking convention). ``base_pos`` is
+    the block's global position offset; overhang keys (j >= valid_len) get
+    the ``far`` sentinel the causal test rejects. With ``remat`` each
+    chunk's probabilities are recomputed in backward instead of saved —
+    without it, reverse-mode AD stores every (q, k)-chunk softmax block and
+    the memory win evaporates exactly at long-context training sizes."""
+    update = (jax.checkpoint(_online_softmax_update) if remat
+              else _online_softmax_update)
 
-    ``use_flash``: None = auto by length. Callers running under
-    model-axis-sharded params (tensor parallelism) must pass False —
-    ``pallas_call`` has no GSPMD partitioning rule, so the flash path would
-    force an all-gather of the head-sharded activations, while ``_attend``'s
-    einsums partition cleanly over heads."""
+    def body(c, inner):
+        m, l, acc = inner
+        k_c = jax.lax.dynamic_slice_in_dim(k_pad, c * chunk, chunk, 1)
+        v_c = jax.lax.dynamic_slice_in_dim(v_pad, c * chunk, chunk, 1)
+        j = c * chunk + jnp.arange(chunk)
+        k_pos = jnp.where(j < valid_len, base_pos + j, far)
+        return update(qf, k_c, v_c, q_pos, k_pos, m, l, acc, scale)
+
+    return jax.lax.fori_loop(0, n_chunks, body, carry)
+
+
+def chunked_causal_attention(q, k, v, q_chunk: int = 512,
+                             key_chunk: int = 1024) -> jax.Array:
+    """Memory-efficient causal attention in pure XLA: a static loop over
+    query chunks, online softmax over key chunks — peak score memory
+    O(q_chunk * key_chunk) per head instead of O(T^2), in backward too
+    (chunk updates are rematerialized). Unlike the Pallas flash kernel this
+    is reverse-differentiable and GSPMD-partitionable (plain einsums shard
+    over heads under tensor parallelism), so it is the long-sequence path
+    TRAINING and TP use. Each query chunk only visits key chunks at or
+    below the diagonal (the loop bound is static per chunk), so no FLOPs
+    go to fully-masked blocks. Ragged tails are handled like the ring's:
+    padded keys carry a sentinel position; padded queries are sliced away.
+    """
+    B, T, H, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qc = min(q_chunk, T)
+    kc = min(key_chunk, T)
+    n_q = -(-T // qc)
+    n_k = -(-T // kc)
+    q_pad = jnp.pad(q, ((0, 0), (0, n_q * qc - T), (0, 0), (0, 0)))
+    k_pad = jnp.pad(k, ((0, 0), (0, n_k * kc - T), (0, 0), (0, 0)))
+    v_pad = jnp.pad(v, ((0, 0), (0, n_k * kc - T), (0, 0), (0, 0)))
+    far = T + 1  # sentinel: beyond every real query position
+
+    outs = []
+    for qi in range(n_q):  # static: per-chunk causal bounds, differentiable
+        q_c = jax.lax.dynamic_slice_in_dim(q_pad, qi * qc, qc, 1)
+        qf = q_c.astype(jnp.float32)
+        q_pos = qi * qc + jnp.arange(qc)
+        carry = (jnp.full((B, H, qc), -jnp.inf, jnp.float32),
+                 jnp.zeros((B, H, qc), jnp.float32),
+                 jnp.zeros((B, H, qc, d), jnp.float32))
+        # key chunks entirely above the diagonal contribute nothing
+        n_k_i = min(n_k, -(-(qi * qc + qc) // kc))
+        _, l, acc = _chunked_key_pass(
+            qf, q_pos, k_pad, v_pad, chunk=kc, n_chunks=n_k_i, base_pos=0,
+            valid_len=T, far=far, carry=carry, scale=scale, remat=True)
+        out = acc / jnp.maximum(l, 1e-30)[..., None]          # (B,H,qc,d)
+        outs.append(out.transpose(0, 2, 1, 3))                # (B,qc,H,d)
+
+    out = jnp.concatenate(outs, axis=1)
+    return out[:, :T].astype(q.dtype)
+
+
+def causal_attention(q, k, v, use_flash: Optional[bool] = None) -> jax.Array:
+    """Full-sequence causal attention, dispatched by length and context:
+
+    * short sequences — materialized scores (cheapest to compile);
+    * long + ``use_flash`` allowed — the Pallas flash kernel
+      (ops/attention.py);
+    * long + ``use_flash=False`` (training, tensor parallelism) —
+      ``chunked_causal_attention``: same bounded memory, differentiable,
+      and GSPMD shards its einsums over heads (``pallas_call`` has no
+      partitioning rule, so the flash path would all-gather head-sharded
+      activations).
+
+    ``use_flash``: None = auto by length; model-axis-sharded callers must
+    pass False."""
+    long_seq = q.shape[1] >= _FLASH_MIN_T
     if use_flash is None:
-        use_flash = q.shape[1] >= _FLASH_MIN_T
+        use_flash = long_seq
     if use_flash:
         from fraud_detection_tpu.ops.attention import (auto_interpret,
                                                        flash_attention)
 
         return flash_attention(q, k, v, interpret=auto_interpret())
+    if long_seq:
+        return chunked_causal_attention(q, k, v)
     causal = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
     return _attend(q, k, v, causal)
 
@@ -276,17 +352,10 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
         else:
             k_pad = jnp.pad(k_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
             v_pad = jnp.pad(v_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
-
-            def chunk_body(c, inner):
-                mi, li, ai = inner
-                k_c = jax.lax.dynamic_slice_in_dim(k_pad, c * chunk, chunk, 1)
-                v_c = jax.lax.dynamic_slice_in_dim(v_pad, c * chunk, chunk, 1)
-                j = c * chunk + jnp.arange(chunk)
-                k_pos = jnp.where(j < T, src * T + j, far)
-                return _online_softmax_update(
-                    qf, k_c, v_c, q_pos, k_pos, mi, li, ai, scale)
-
-            m, l, acc = jax.lax.fori_loop(0, n_chunks, chunk_body, (m, l, acc))
+            m, l, acc = _chunked_key_pass(
+                qf, q_pos, k_pad, v_pad, chunk=chunk, n_chunks=n_chunks,
+                base_pos=src * T, valid_len=T, far=far, carry=(m, l, acc),
+                scale=scale, remat=False)
         k_next = jax.lax.ppermute(
             k_blk, axis_name, [(i, (i + 1) % blocks_per_ring) for i in range(blocks_per_ring)])
         v_next = jax.lax.ppermute(
