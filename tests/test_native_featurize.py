@@ -318,3 +318,33 @@ def test_fuzz_json_path_parity():
     np.testing.assert_array_equal(np.asarray(batch.ids), np.asarray(want.ids))
     np.testing.assert_array_equal(np.asarray(batch.counts),
                                   np.asarray(want.counts))
+
+
+def test_megabyte_transcript_parity():
+    """Length invariance at stress scale (SURVEY.md §5 long-context): a
+    multi-megabyte transcript through BOTH native paths must match the
+    Python featurizer byte-for-byte — guarding the C++ span/offset
+    arithmetic (int32 spans, row truncation) at sizes real batching never
+    reaches."""
+    rng = __import__("random").Random(3)
+    words = ["prize", "urgent", "account", "verify", "hello", "thanks",
+             "ok", "transfer", "don't", "Agent:", "Customer:", "CALL"]
+    big = " ".join(rng.choice(words) for _ in range(400_000))  # ~2.6 MB
+    feat = HashingTfIdfFeaturizer(num_features=10000)
+    twin = _python_twin(feat)
+    got = feat.encode([big], batch_size=1)
+    want = twin.encode([big], batch_size=1)
+    np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
+    np.testing.assert_array_equal(np.asarray(got.counts),
+                                  np.asarray(want.counts))
+
+    msg = json.dumps({"text": big, "id": 1}).encode()
+    out = feat.encode_json([msg], "text", batch_size=1,
+                           max_tokens=got.ids.shape[1])
+    assert out is not None
+    batch, status, span_start, span_len = out
+    assert status[0] == 1
+    np.testing.assert_array_equal(np.asarray(batch.ids),
+                                  np.asarray(got.ids))
+    literal = msg[span_start[0] : span_start[0] + span_len[0]]
+    assert json.loads(literal) == big
