@@ -166,7 +166,11 @@ class HashingTfIdfFeaturizer:
         counts = np.zeros((b, length), np.uint16)
         for r, (idx, val) in enumerate(rows):
             if len(idx) > length:  # extremely long transcript: keep top-count buckets
-                keep = np.argsort(-val)[:length]
+                # stable: ties resolve toward the LOWER bucket id (the
+                # documented rule the native fill implements) — default
+                # quicksort breaks ties arbitrarily and diverges from C++
+                # exactly when a tie group straddles the cut
+                keep = np.argsort(-val, kind="stable")[:length]
                 keep.sort()
                 idx, val = idx[keep], val[keep]
             ids[r, : len(idx)] = idx

@@ -323,20 +323,35 @@ def test_fuzz_json_path_parity():
 def test_megabyte_transcript_parity():
     """Length invariance at stress scale (SURVEY.md §5 long-context): a
     multi-megabyte transcript through BOTH native paths must match the
-    Python featurizer byte-for-byte — guarding the C++ span/offset
-    arithmetic (int32 spans, row truncation) at sizes real batching never
-    reaches."""
+    Python featurizer byte-for-byte. The corpus mixes a hot 12-word core
+    (per-bucket counts in the tens of thousands — the accumulation regime)
+    with thousands of rare words (row width in the thousands — the
+    truncation regime), guarding the C++ span/offset arithmetic and the
+    keep-top-count rule at sizes real batching never reaches."""
     rng = __import__("random").Random(3)
-    words = ["prize", "urgent", "account", "verify", "hello", "thanks",
-             "ok", "transfer", "don't", "Agent:", "Customer:", "CALL"]
-    big = " ".join(rng.choice(words) for _ in range(400_000))  # ~2.6 MB
+    hot = ["prize", "urgent", "account", "verify", "hello", "thanks",
+           "ok", "transfer", "don't", "Agent:", "Customer:", "CALL"]
+    # letter-only suffixes: digits would strip during cleaning and
+    # collapse every rare word onto one bucket
+    alpha = "abcdefghijklmnopqrstuvwxyz"
+    rare = lambda: "rare" + "".join(rng.choice(alpha) for _ in range(3))
+    draw = lambda: rng.choice(hot) if rng.random() < 0.98 else rare()
+    big = " ".join(draw() for _ in range(400_000))  # ~2.6 MB
     feat = HashingTfIdfFeaturizer(num_features=10000)
     twin = _python_twin(feat)
     got = feat.encode([big], batch_size=1)
     want = twin.encode([big], batch_size=1)
+    assert got.ids.shape[1] > 1000  # wide row: thousands of unique buckets
     np.testing.assert_array_equal(np.asarray(got.ids), np.asarray(want.ids))
     np.testing.assert_array_equal(np.asarray(got.counts),
                                   np.asarray(want.counts))
+
+    # Truncation regime: keep-top-count rule on a row far wider than L.
+    got_t = feat.encode([big], batch_size=1, max_tokens=64)
+    want_t = twin.encode([big], batch_size=1, max_tokens=64)
+    np.testing.assert_array_equal(np.asarray(got_t.ids), np.asarray(want_t.ids))
+    np.testing.assert_array_equal(np.asarray(got_t.counts),
+                                  np.asarray(want_t.counts))
 
     msg = json.dumps({"text": big, "id": 1}).encode()
     out = feat.encode_json([msg], "text", batch_size=1,
@@ -344,7 +359,8 @@ def test_megabyte_transcript_parity():
     assert out is not None
     batch, status, span_start, span_len = out
     assert status[0] == 1
-    np.testing.assert_array_equal(np.asarray(batch.ids),
-                                  np.asarray(got.ids))
+    np.testing.assert_array_equal(np.asarray(batch.ids), np.asarray(got.ids))
+    np.testing.assert_array_equal(np.asarray(batch.counts),
+                                  np.asarray(got.counts))
     literal = msg[span_start[0] : span_start[0] + span_len[0]]
     assert json.loads(literal) == big
