@@ -70,3 +70,38 @@ def test_load_shipped_artifact(reference_artifact_path):
     assert lr.threshold == 0.5
     # LR nonzeros only on buckets that appeared in training (docFreq > 0).
     assert np.all(idf.doc_freq[np.nonzero(lr.coefficients)[0]] > 0)
+
+
+def test_corrupted_artifacts_fail_loudly(reference_artifact_path, tmp_path):
+    """Corruption must raise, never load silently-wrong weights: a missing
+    stage directory, mangled metadata JSON, and a truncated weights parquet
+    each produce an exception."""
+    import shutil
+
+    def fresh(name):
+        dst = tmp_path / name
+        shutil.copytree(reference_artifact_path, dst)
+        return dst
+
+    # missing stage directory
+    art = fresh("missing_stage")
+    stage = next(p for p in (art / "stages").iterdir() if "IDF" in p.name)
+    shutil.rmtree(stage)
+    with pytest.raises(Exception):
+        load_spark_pipeline(str(art))
+
+    # mangled pipeline metadata
+    art = fresh("bad_meta")
+    meta = art / "metadata" / "part-00000"
+    meta.write_text("{not valid json")
+    with pytest.raises(Exception):
+        load_spark_pipeline(str(art))
+
+    # truncated LR weights parquet
+    art = fresh("truncated_parquet")
+    lr_dir = next(p for p in (art / "stages").iterdir()
+                  if "LogisticRegression" in p.name)
+    pq = next((lr_dir / "data").glob("*.parquet"))
+    pq.write_bytes(pq.read_bytes()[:100])
+    with pytest.raises(Exception):
+        load_spark_pipeline(str(art))

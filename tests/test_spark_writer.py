@@ -150,3 +150,67 @@ def test_tree_stage_records_num_features(tmp_path, corpus):
     with open(meta_path) as fh:
         meta = _json.loads(fh.read())
     assert meta["paramMap"]["numFeatures"] == 2048
+
+
+def test_random_ensemble_roundtrip_property(tmp_path):
+    """Property fuzz: randomly-structured ensembles (ragged trees, extreme
+    thresholds/counts, 2-3 classes, per-tree weights) must survive the
+    write->load round trip with identical traversal results — probing node
+    layouts and magnitudes the trained-model tests never produce."""
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.models.trees import TreeEnsemble, predict_proba
+
+    rng = np.random.default_rng(123)
+    F = 64
+
+    def rand_tree(M, C, depth):
+        feature = np.full(M, -1, np.int32)
+        thr = np.zeros(M, np.float32)
+        left = np.full(M, -1, np.int32)
+        right = np.full(M, -1, np.int32)
+        leaf = np.zeros((M, C), np.float32)
+        slot = [1]
+
+        def build(i, d):
+            if d == 0 or rng.random() < 0.35 or slot[0] + 2 > M:
+                leaf[i] = (rng.random(C) + 0.01) * rng.choice([1.0, 500.0, 0.01])
+                return
+            feature[i] = rng.integers(0, F)
+            thr[i] = float(rng.normal() * rng.choice([1.0, 1e3, 1e-3]))
+            l, r = slot[0], slot[0] + 1
+            slot[0] += 2
+            left[i], right[i] = l, r
+            build(l, d - 1)
+            build(r, d - 1)
+
+        build(0, depth)
+        return feature, thr, left, right, leaf
+
+    for trial in range(6):
+        C = int(rng.integers(2, 4))
+        depth = int(rng.integers(1, 6))
+        n_trees = int(rng.integers(1, 7))
+        M = 2 ** (depth + 1) - 1
+        parts = [rand_tree(M, C, depth) for _ in range(n_trees)]
+        kind = "decision_tree" if n_trees == 1 else "random_forest"
+        ens = TreeEnsemble(
+            feature=jnp.asarray(np.stack([p[0] for p in parts])),
+            threshold=jnp.asarray(np.stack([p[1] for p in parts])),
+            left=jnp.asarray(np.stack([p[2] for p in parts])),
+            right=jnp.asarray(np.stack([p[3] for p in parts])),
+            leaf=jnp.asarray(np.stack([p[4] for p in parts])),
+            tree_weights=jnp.asarray(rng.random(n_trees).astype(np.float32) + 0.5),
+            kind=kind, max_depth=depth)
+
+        feat = HashingTfIdfFeaturizer(num_features=F)
+        feat.fit_idf(["some scam text to give idf a corpus", "another text"])
+        path = str(tmp_path / f"export{trial}")
+        save_spark_pipeline(path, feat, ens)
+        loaded = ServingPipeline.from_spark_artifact(
+            load_spark_pipeline(path), batch_size=8).model
+        X = jnp.asarray(rng.normal(size=(32, F)).astype(np.float32) * 100)
+        np.testing.assert_allclose(
+            np.asarray(predict_proba(ens, X)),
+            np.asarray(predict_proba(loaded, X)),
+            atol=1e-6, err_msg=f"trial {trial} kind={kind} C={C} depth={depth}")
