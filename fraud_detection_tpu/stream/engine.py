@@ -31,6 +31,7 @@ from fraud_detection_tpu.explain.prompts import label_name
 from fraud_detection_tpu.models.pipeline import ServingPipeline
 from fraud_detection_tpu.stream.broker import Consumer, Message, Producer
 from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
+from fraud_detection_tpu.utils.tracing import Tracer
 
 # Output wire-format fast path: fixed frame, %.6f confidence (same 6-decimal
 # precision as the dict path's round(confidence, 6)).
@@ -175,6 +176,7 @@ class StreamingClassifier:
         explain_fn: Optional[Callable[[str, int, float], Optional[str]]] = None,
         explain_batch_fn: Optional[Callable[[List[str], List[int], List[float]],
                                             List[Optional[str]]]] = None,
+        tracer: Optional[Tracer] = None,
     ):
         if pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
@@ -193,6 +195,11 @@ class StreamingClassifier:
         # where the reference paid a synchronous HTTPS call per message
         # (app_ui.py:207). Takes precedence over explain_fn when both given.
         self.explain_batch_fn = explain_batch_fn
+        # Optional utils.tracing.Tracer: per-batch "dispatch" / "finish"
+        # spans (host featurize+launch vs device-wait+produce+commit legs)
+        # for profiling beyond StreamStats' aggregate latencies. None = the
+        # hot loop pays nothing.
+        self.tracer = tracer
         self.stats = StreamStats()
         self._running = False
         self._flush_failed = False
@@ -447,10 +454,14 @@ class StreamingClassifier:
         # spent parked behind the next batch's poll — that's pipeline
         # queueing, not processing, and would inflate the number by up to
         # max_wait on a sparse stream.
-        dt = inflight.dispatch_time + (time.perf_counter() - t1)
+        finish_dt = time.perf_counter() - t1
+        dt = inflight.dispatch_time + finish_dt
         self.stats.processed += len(msgs)
         self.stats.batches += 1
         self.stats.record_latency(dt)
+        if self.tracer is not None:
+            self.tracer.record("dispatch", inflight.dispatch_time)
+            self.tracer.record("finish", finish_dt)
         return len(msgs)
 
     def process_batch(self, msgs: List[Message]) -> int:

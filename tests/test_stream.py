@@ -571,3 +571,21 @@ def test_explain_batch_hook(pipeline):
     assert len(analysed) == 20
     for o in analysed:
         assert o["analysis"] == f"batch analysis label={o['prediction']}"
+
+
+def test_tracer_spans_recorded(pipeline):
+    """An attached Tracer collects per-batch dispatch/finish spans (the
+    host-featurize vs device-wait split StreamStats aggregates away)."""
+    from fraud_detection_tpu.utils.tracing import Tracer
+
+    broker = InProcessBroker(num_partitions=1)
+    _feed(broker, [("Agent: hello there friend.", 0)] * 12)
+    tracer = Tracer()
+    engine = StreamingClassifier(
+        pipeline, broker.consumer(["customer-dialogues-raw"], "tr"),
+        broker.producer(), "out", batch_size=4, max_wait=0.01, tracer=tracer)
+    stats = engine.run(max_messages=12, idle_timeout=0.2)
+    spans = tracer.stats()
+    assert spans["dispatch"].count == stats.batches
+    assert spans["finish"].count == stats.batches
+    assert spans["dispatch"].total > 0 and spans["finish"].total > 0
