@@ -154,7 +154,9 @@ class ServingPipeline:
         return cls(featurizer, model, fold_idf=True, batch_size=batch_size)
 
     def predict_json_async(self, values: Sequence[bytes], text_field: str = "text"
-                           ) -> Optional[Tuple["PendingPrediction", np.ndarray, np.ndarray, np.ndarray]]:
+                           ) -> Optional[Tuple["PendingPrediction", np.ndarray,
+                                               np.ndarray, np.ndarray,
+                                               Optional[list]]]:
         """Raw-JSON fast path: score Kafka message bytes without Python-side
         json.loads (featurize/tfidf.py ``encode_json`` — one native pass from
         message bytes to hashed sparse rows).
