@@ -296,16 +296,19 @@ def _tree_prob_encoded(ensemble: TreeEnsemble, ids, counts, idf, binary: bool):
 
 def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 7,
                             num_features: int = 10000,
-                            model: str = "lr") -> ServingPipeline:
+                            model: str = "lr",
+                            corpus_kwargs: dict | None = None) -> ServingPipeline:
     """Train a quick model on the synthetic corpus — the shared demo/bench
     fallback pipeline (one recipe, used by bench.py and app/serve.py).
-    ``model``: "lr" (default) | "dt" | "rf" | "xgb"."""
+    ``model``: "lr" (default) | "dt" | "rf" | "xgb". ``corpus_kwargs`` is
+    forwarded to generate_corpus (e.g. hard_fraction/label_noise=0 for the
+    separable corpus transport tests train against)."""
     from fraud_detection_tpu.data import generate_corpus
     from fraud_detection_tpu.models.train_linear import fit_logistic_regression
     from fraud_detection_tpu.models.train_trees import (
         fit_decision_tree, fit_gradient_boosting, fit_random_forest)
 
-    corpus = generate_corpus(n=n, seed=seed)
+    corpus = generate_corpus(n=n, seed=seed, **(corpus_kwargs or {}))
     feat = HashingTfIdfFeaturizer(num_features=num_features)
     feat.fit_idf([d.text for d in corpus])
     X = np.asarray(feat.featurize_dense([d.text for d in corpus]))

@@ -12,7 +12,9 @@ from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
 def pipeline():
     from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
 
-    return synthetic_demo_pipeline(batch_size=64, n=400, seed=3, num_features=2048)
+    return synthetic_demo_pipeline(batch_size=64, n=400, seed=3, num_features=2048,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
 
 
 def _feed(broker, dialogues, topic="customer-dialogues-raw"):
@@ -25,7 +27,9 @@ def _feed(broker, dialogues, topic="customer-dialogues-raw"):
 def test_end_to_end_stream_classification(pipeline):
     from fraud_detection_tpu.data import generate_corpus
 
-    corpus = generate_corpus(n=120, seed=77)
+    # Separable corpus: this test verifies transport plumbing, so the model's
+    # accuracy vs ground truth must not be capped by corpus label noise.
+    corpus = generate_corpus(n=120, seed=77, hard_fraction=0.0, label_noise=0.0)
     broker = InProcessBroker(num_partitions=3)
     _feed(broker, [(d.text, d.label) for d in corpus])
 

@@ -47,13 +47,38 @@ def test_metrics_report_structure_and_floors(run):
     for name, per_split in report["metrics"].items():
         for split in ("Validation", "Test"):
             m = per_split[split]
-            # Floors, not exact values: the reference publishes ~0.98-0.99
-            # on the real corpus; the synthetic corpus is separable.
+            # Floors, not exact values: the synthetic corpus carries 2% label
+            # noise + vocabulary-overlapping hard families (data/synthetic.py),
+            # so ~0.93-0.98 is the expected regime, not 1.0.
             assert m["f1"] > 0.9, (name, split, m)
-            assert m["auc"] > 0.95, (name, split, m)
+            assert m["auc"] > 0.9, (name, split, m)
             cm = np.asarray(m["confusion"])
             assert cm.shape == (2, 2) and cm.sum() == (
                 40 if split == "Validation" else 80)
+    # Live discriminative guard (complements the committed-report test, which
+    # cannot see a corpus regression): if data/synthetic.py reverts to a
+    # trivially separable default corpus, every model saturates at 1.0 here.
+    test_accs = [per["Test"]["accuracy"] for per in report["metrics"].values()]
+    assert max(test_accs) < 1.0, test_accs
+
+
+def test_committed_report_is_discriminative():
+    """The committed full-scale report must reproduce the *shape* of the
+    reference's published results (report-paper.pdf Table II: DT 0.9834 below
+    RF/XGB 0.9934): every model strictly under 1.0 on test, and the depth-5
+    single tree under both 100-tree ensembles. Guards against regressions that
+    make the corpus trivially separable again (round-2 verdict item 1)."""
+    path = os.path.join(os.path.dirname(__file__), "..", "reports", "metrics.json")
+    report = json.loads(open(path).read())
+    meta = report["meta"]
+    assert meta["n"] == 1600 and meta["n_trees"] == 100 and meta["n_rounds"] == 100
+    test_m = {name: per["Test"] for name, per in report["metrics"].items()}
+    for name, m in test_m.items():
+        assert 0.9 < m["accuracy"] < 1.0, (name, m)   # non-trivial, non-saturated
+        assert 0.9 < m["f1"] < 1.0, (name, m)
+    for ens in ("rf", "xgb"):
+        assert test_m["dt"]["accuracy"] < test_m[ens]["accuracy"], (ens, test_m)
+        assert test_m["dt"]["f1"] < test_m[ens]["f1"], (ens, test_m)
 
 
 def test_plots_written(run):

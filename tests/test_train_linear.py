@@ -52,7 +52,10 @@ def test_mesh_training_matches_single_device():
 
 
 def test_end_to_end_train_on_synthetic_corpus():
-    corpus = generate_corpus(n=800, seed=7)
+    # Separable corpus (no hard families / label noise): this is an L-BFGS
+    # trainer sanity check with tight floors; corpus-difficulty behavior is
+    # covered by test_train_integration.test_committed_report_is_discriminative.
+    corpus = generate_corpus(n=800, seed=7, hard_fraction=0.0, label_noise=0.0)
     train, val, test = train_val_test_split(corpus, seed=42)
     assert len(train) == 560 and len(val) == 80 and len(test) == 160
 
