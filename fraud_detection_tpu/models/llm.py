@@ -254,17 +254,43 @@ def chunked_causal_attention(q, k, v, q_chunk: int = 512,
     return out[:, :T].astype(q.dtype)
 
 
+@jax.custom_vjp
+def _flash_attention_diff(q, k, v):
+    """Flash forward with a differentiable backward: ``pallas_call`` defines
+    no VJP, so the backward pass re-derives gradients through
+    ``chunked_causal_attention`` (the exact same function, computed in
+    bounded-memory XLA). External callers differentiating an auto-dispatched
+    long-sequence ``forward()`` therefore get real gradients instead of an
+    opaque Pallas AD error (round-2 advisor finding)."""
+    from fraud_detection_tpu.ops.attention import auto_interpret, flash_attention
+
+    return flash_attention(q, k, v, interpret=auto_interpret())
+
+
+def _flash_diff_fwd(q, k, v):
+    return _flash_attention_diff(q, k, v), (q, k, v)
+
+
+def _flash_diff_bwd(res, g):
+    _, vjp = jax.vjp(chunked_causal_attention, *res)
+    return vjp(g)
+
+
+_flash_attention_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def causal_attention(q, k, v, use_flash: Optional[bool] = None) -> jax.Array:
     """Full-sequence causal attention, dispatched by length and context:
 
     * short sequences — materialized scores (cheapest to compile);
     * long + ``use_flash`` allowed — the Pallas flash kernel
-      (ops/attention.py);
+      (ops/attention.py), wrapped so its backward runs through the chunked
+      XLA path (differentiable even under auto-dispatch);
     * long + ``use_flash=False`` (training, tensor parallelism) —
-      ``chunked_causal_attention``: same bounded memory, differentiable,
-      and GSPMD shards its einsums over heads (``pallas_call`` has no
-      partitioning rule, so the flash path would all-gather head-sharded
-      activations).
+      ``chunked_causal_attention``: same bounded memory, one fused
+      forward+backward program, and GSPMD shards its einsums over heads
+      (``pallas_call`` has no partitioning rule, so the flash path would
+      all-gather head-sharded activations).
 
     ``use_flash``: None = auto by length; model-axis-sharded callers must
     pass False."""
@@ -272,10 +298,7 @@ def causal_attention(q, k, v, use_flash: Optional[bool] = None) -> jax.Array:
     if use_flash is None:
         use_flash = long_seq
     if use_flash:
-        from fraud_detection_tpu.ops.attention import (auto_interpret,
-                                                       flash_attention)
-
-        return flash_attention(q, k, v, interpret=auto_interpret())
+        return _flash_attention_diff(q, k, v)
     if long_seq:
         return chunked_causal_attention(q, k, v)
     causal = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
@@ -364,8 +387,12 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
 
     # pvary: the accumulators become device-varying on the first iteration, so
     # their carry types must be marked varying over the ring axis up front.
+    # pcast is the jax>=0.9 spelling; fall back to pvary (same marking,
+    # deprecated in 0.9) so the declared jax>=0.8 floor actually runs.
     vary = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
-    mark = partial(jax.lax.pcast, axis_name=vary, to="varying")
+    _pcast = getattr(jax.lax, "pcast", None)
+    mark = (partial(_pcast, axis_name=vary, to="varying") if _pcast is not None
+            else partial(jax.lax.pvary, axis_name=vary))
     m0 = mark(jnp.full((B, H, T), -jnp.inf, jnp.float32))
     l0 = mark(jnp.zeros((B, H, T), jnp.float32))
     acc0 = mark(jnp.zeros((B, H, T, d), jnp.float32))
@@ -544,12 +571,18 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict[str, ja
 # generation
 # ---------------------------------------------------------------------------
 
-def _sample_token(temperature, logits_1, key):
+def _sample_token(temperature, logits_1, step_key):
     """Greedy below the temperature epsilon, categorical above — the ONE
-    sampling rule the decode path uses."""
+    sampling rule the decode path uses. Each row draws from its own key,
+    ``fold_in(step_key, row)``, so a row's sample depends only on
+    (seed, step, row) — NOT on how many prompts are co-batched (batch-size
+    bucketing pads B; a (B, V)-shaped draw would change with the padding)."""
     greedy = jnp.argmax(logits_1, -1)
     scaled = logits_1 / jnp.maximum(temperature, 1e-6)
-    drawn = jax.random.categorical(key, scaled, -1)
+    row_keys = jax.vmap(partial(jax.random.fold_in, step_key))(
+        jnp.arange(logits_1.shape[0]))
+    drawn = jax.vmap(lambda k, lg: jax.random.categorical(k, lg, -1))(
+        row_keys, scaled)
     return jnp.where(temperature <= 1e-6, greedy, drawn).astype(jnp.int32)
 
 
@@ -580,12 +613,15 @@ def _generate_batch_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array
     # short answers stop paying per-step forwards (unemitted slots stay EOS,
     # which the tokenizers already treat as end-of-text).
     def cond(carry):
-        _, _, _, i, done, _ = carry
+        _, _, i, done, _ = carry
         return (i < max_new) & ~jnp.all(done)
 
     def body(carry):
-        cache, last_logits, key, i, done, out = carry
-        key, sub = jax.random.split(key)
+        cache, last_logits, i, done, out = carry
+        # Per-step key derived by counter from the closed-over rng, per-row
+        # keys inside _sample_token: output stream for row r is a pure
+        # function of (seed, step, r).
+        sub = jax.random.fold_in(rng, i)
         tok = sample(last_logits, sub)                         # (B,)
         tok = jnp.where(done, cfg.EOS, tok)                    # freeze done rows
         out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, i))
@@ -595,12 +631,12 @@ def _generate_batch_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array
                                 positions=pos[:, None],
                                 kv_cache=cache, cache_len=Tp + i,
                                 valid_from=valid_from)
-        return cache, logits[:, 0], key, i + 1, done, out
+        return cache, logits[:, 0], i + 1, done, out
 
     # Batch-bucketing dummy rows start DONE — waiting on a garbage row that
     # may never sample EOS would defeat the early exit for every batch whose
     # real size isn't a power of two.
-    carry = (cache, last, rng, jnp.int32(0), ~row_real, out0)
+    carry = (cache, last, jnp.int32(0), ~row_real, out0)
     *_, out = jax.lax.while_loop(cond, body, carry)
     return out  # (B, max_new); rows past their EOS hold EOS
 
@@ -661,7 +697,9 @@ class LanguageModel:
         """Decode a batch of UNEVEN-length prompts in one device program
         (one prefill + one early-exit decode loop — a single tunnel round
         trip for the whole batch). Prompts are left-padded to a shared bucket; per-row validity
-        masking keeps each row's context exactly its own prompt. Returns
+        masking keeps each row's context exactly its own prompt. Sampling is
+        batch-composition invariant: row r's tokens depend only on
+        (seed, step, r), not on how many prompts are co-batched. Returns
         (B, max_new_tokens)."""
         n = len(prompts)
         if n == 0:

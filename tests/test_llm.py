@@ -296,3 +296,45 @@ def test_long_seq_training_step_uses_chunked_path(params):
 
     g = jax.grad(loss)(params)
     assert all(bool(jnp.all(jnp.isfinite(v))) for v in g.values())
+
+
+def test_stochastic_sampling_batch_composition_invariant(params):
+    """At temperature > 0, row r's sampled tokens are a function of
+    (seed, step, r) only — co-batching more prompts (which changes the
+    power-of-two batch bucket) must not change an earlier row's stream
+    (round-2 advisor finding: a (B, V)-shaped noise draw broke this)."""
+    lm = LanguageModel(CFG, params)
+    tok = lm.tokenizer.encode("Customer: I was told I won a prize")
+    alone = lm.generate_tokens_batch([tok], max_new_tokens=10,
+                                     temperature=1.0, seed=5)
+    extras = [lm.tokenizer.encode(p) for p in ("Agent: hi", "B", "CC")]
+    cobatched = lm.generate_tokens_batch([tok] + extras, max_new_tokens=10,
+                                         temperature=1.0, seed=5)
+    np.testing.assert_array_equal(alone[0], cobatched[0])
+    # and the single-prompt wrapper is the same stream
+    single = lm.generate_tokens(tok, max_new_tokens=10, temperature=1.0, seed=5)
+    np.testing.assert_array_equal(single, alone[0])
+
+
+def test_auto_flash_dispatch_is_differentiable():
+    """Long-sequence auto-dispatch takes the Pallas flash kernel, whose
+    backward is rerouted through chunked_causal_attention by custom_vjp —
+    external callers differentiating forward() without use_flash=False must
+    get real gradients matching the pure-XLA path (round-2 advisor finding:
+    this used to raise an opaque Pallas AD error)."""
+    from fraud_detection_tpu.models.llm import causal_attention
+
+    B, T, H, d = 1, 512, 2, 8  # T >= _FLASH_MIN_T triggers auto flash
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+
+    loss_auto = lambda q, k, v: jnp.sum(causal_attention(q, k, v) ** 2)
+    loss_ref = lambda q, k, v: jnp.sum(
+        causal_attention(q, k, v, use_flash=False) ** 2)
+    g_auto = jax.grad(loss_auto, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for ga, gr in zip(g_auto, g_ref):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4)
