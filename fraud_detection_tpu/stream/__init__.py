@@ -1,6 +1,6 @@
-from fraud_detection_tpu.stream.broker import InProcessBroker, Message
+from fraud_detection_tpu.stream.broker import CommitFailedError, InProcessBroker, Message
 from fraud_detection_tpu.stream.engine import StreamingClassifier, StreamStats
 from fraud_detection_tpu.stream.kafka import kafka_available
 
-__all__ = ["InProcessBroker", "Message", "StreamingClassifier", "StreamStats",
+__all__ = ["CommitFailedError", "InProcessBroker", "Message", "StreamingClassifier", "StreamStats",
            "kafka_available"]

@@ -16,7 +16,14 @@ needs, with two implementations:
 Semantics follow Kafka where it matters for the engine: per-partition FIFO,
 consumer offsets advance only on commit (the reference never commits — Q2 —
 and reprocesses from earliest on every restart; this engine commits after
-produce, deliberately fixing that and documenting the difference).
+produce, deliberately fixing that and documenting the difference), and
+consumer-GROUP partition assignment: members of one group own disjoint
+partition subsets (round-robin assignor), rebalanced on join/leave/eviction,
+with commits rejected for partitions the member no longer owns
+(``CommitFailedError``, like Kafka on a stale generation). The reference
+creates its topics with ``--partitions 3`` and a consumer group
+(README; utils/kafka_utils.py:15) — N engines in one group scale out
+horizontally exactly the way N reference consumers would.
 """
 
 from __future__ import annotations
@@ -73,17 +80,50 @@ class Producer(Protocol):
         ...
 
 
+class CommitFailedError(RuntimeError):
+    """Commit advanced a partition this member does not currently own —
+    the group rebalanced underneath it (Kafka's CommitFailedError). The
+    engine treats this as a failed incarnation: offsets stay uncommitted and
+    the partition's new owner reprocesses the batch (at-least-once)."""
+
+
+class _GroupState:
+    """Broker-side consumer-group bookkeeping (the group-coordinator role)."""
+
+    __slots__ = ("generation", "members", "assignment", "acquired", "join_seq",
+                 "next_evict_scan")
+
+    def __init__(self):
+        self.generation = 0
+        self.members: Dict[str, dict] = {}      # member_id -> {topics, seen, joined}
+        self.assignment: Dict[str, set] = {}    # member_id -> {(topic, partition)}
+        self.next_evict_scan = 0.0              # liveness scans are rate-limited
+        # (topic, partition) -> generation its CURRENT owner acquired it at.
+        # This is what lets a consumer distinguish "I owned p continuously"
+        # from "p bounced away and back while I wasn't polling" — the local
+        # read-ahead position is only valid in the first case.
+        self.acquired: Dict[tuple, int] = {}
+        self.join_seq = itertools.count()
+
+
 class InProcessBroker:
     """Thread-safe partitioned topic store with Kafka-ish offset semantics."""
 
-    def __init__(self, num_partitions: int = 3):
+    def __init__(self, num_partitions: int = 3, session_timeout: float = 30.0):
         self.num_partitions = num_partitions
+        # Members that neither polled nor closed within this window are
+        # evicted at the next group operation (zombie crash recovery); the
+        # supervised engine path closes consumers explicitly, so eviction is
+        # the backstop, not the common path.
+        self.session_timeout = session_timeout
         self._topics: Dict[str, List[List[Message]]] = {}
         # Group-durable committed offsets: (group, topic, partition) -> next
         # offset. Lives on the BROKER, like Kafka's __consumer_offsets — a
         # fresh consumer in the same group resumes where the group left off
         # (this is what makes crash/restart tests honest).
         self._group_offsets: Dict[tuple, int] = {}
+        self._groups: Dict[str, _GroupState] = {}
+        self._member_ids = itertools.count()
         self._lock = threading.Lock()
         self._rr = itertools.count()
         self._seq = itertools.count()
@@ -136,6 +176,104 @@ class InProcessBroker:
     def producer(self) -> "InProcessProducer":
         return InProcessProducer(self)
 
+    # ------------------------------------------------------------------
+    # group coordination (Kafka's group-coordinator role, in-process)
+    # ------------------------------------------------------------------
+
+    def _evict_expired_locked(self, group: _GroupState, now: float) -> bool:
+        stale = [m for m, info in group.members.items()
+                 if now - info["seen"] > self.session_timeout]
+        for m in stale:
+            del group.members[m]
+        return bool(stale)
+
+    def _rebalance_locked(self, group: _GroupState) -> None:
+        """Round-robin assignor: each subscribed topic's partitions dealt out
+        over that topic's subscribers in join order. Bumps the generation —
+        every member notices on its next poll and refreshes its owned set.
+        Partitions that change hands get their acquisition generation
+        restamped; continuously-owned ones keep it."""
+        old_owner = {pair: m for m, pairs in group.assignment.items()
+                     for pair in pairs}
+        group.generation += 1
+        members = sorted(group.members, key=lambda m: group.members[m]["joined"])
+        group.assignment = {m: set() for m in members}
+        topics = sorted({t for m in members for t in group.members[m]["topics"]})
+        acquired: Dict[tuple, int] = {}
+        for topic in topics:
+            subs = [m for m in members if topic in group.members[m]["topics"]]
+            for p in range(self.num_partitions):
+                owner, pair = subs[p % len(subs)], (topic, p)
+                group.assignment[owner].add(pair)
+                acquired[pair] = (group.acquired.get(pair, group.generation)
+                                  if old_owner.get(pair) == owner
+                                  else group.generation)
+        group.acquired = acquired
+
+    def _join_group(self, group_id: str, topics: Sequence[str]) -> str:
+        with self._lock:
+            group = self._groups.setdefault(group_id, _GroupState())
+            now = time.time()
+            self._evict_expired_locked(group, now)
+            member_id = f"{group_id}-{next(self._member_ids)}"
+            group.members[member_id] = {"topics": tuple(topics), "seen": now,
+                                        "joined": next(group.join_seq)}
+            self._rebalance_locked(group)
+            return member_id
+
+    def _leave_group(self, group_id: str, member_id: str) -> None:
+        with self._lock:
+            group = self._groups.get(group_id)
+            if group is None or member_id not in group.members:
+                return
+            del group.members[member_id]
+            self._rebalance_locked(group)
+
+    def _sync_member_locked(self, group_id: str, member_id: str,
+                            topics: Sequence[str],
+                            known_generation: int = -1) -> tuple:
+        """Heartbeat + assignment fetch (caller holds self._lock). Returns
+        (generation, owned set, {pair: acquisition generation}) — or
+        (known_generation, None, None) on the fast path: member known,
+        generation unchanged, no liveness scan due. poll()'s 1 ms spin calls
+        this ~1000x/sec per idle consumer, so the common case must be a
+        heartbeat write and two compares, not an O(members) scan plus a dict
+        build that _refresh_locked would throw away. Eviction scans are
+        rate-limited to session_timeout/4, which bounds zombie-stall at
+        ~1.25x the configured timeout. An evicted member transparently
+        rejoins — Kafka's rejoin-after-session-expiry, minus the error
+        round trip."""
+        group = self._groups.setdefault(group_id, _GroupState())
+        now = time.time()
+        member = group.members.get(member_id)
+        if member is not None:
+            member["seen"] = now
+            if group.generation == known_generation and now < group.next_evict_scan:
+                return known_generation, None, None
+        changed = False
+        if now >= group.next_evict_scan:
+            changed = self._evict_expired_locked(group, now)
+            group.next_evict_scan = now + self.session_timeout / 4
+        if member_id not in group.members:
+            group.members[member_id] = {"topics": tuple(topics), "seen": now,
+                                        "joined": next(group.join_seq)}
+            changed = True
+        if changed:
+            self._rebalance_locked(group)
+        if group.generation == known_generation:
+            return known_generation, None, None
+        owned = group.assignment[member_id]
+        return (group.generation, owned,
+                {pair: group.acquired[pair] for pair in owned})
+
+    def group_assignment(self, group_id: str) -> Dict[str, List[tuple]]:
+        """Current member -> sorted[(topic, partition)] map (observability)."""
+        with self._lock:
+            group = self._groups.get(group_id)
+            if group is None:
+                return {}
+            return {m: sorted(pairs) for m, pairs in group.assignment.items()}
+
 
 class InProcessConsumer:
     """Earliest-offset consumer with manual commit (auto-commit off, like the
@@ -145,20 +283,60 @@ class InProcessConsumer:
         self.broker = broker
         self.topics = topics
         self.group_id = group_id
-        # Start from the group's broker-durable committed offsets (Kafka
-        # semantics: auto.offset.reset='earliest' applies only to partitions
-        # the group has never committed).
-        with broker._lock:
-            self._position: Dict[tuple, int] = {
-                (t, p): off for (g, t, p), off in broker._group_offsets.items()
-                if g == group_id and t in topics}
-        self._committed: Dict[tuple, int] = dict(self._position)
+        self.member_id = broker._join_group(group_id, topics)
+        # Stale until the first poll refreshes it against the coordinator.
+        self._generation = -1
+        self._owned: set = set()
+        self._acquired: Dict[tuple, int] = {}
+        self._position: Dict[tuple, int] = {}
+        self._committed: Dict[tuple, int] = {}
         self._closed = False
         # Kafka consumers are not thread-safe and neither is this one
         # (._position/._committed are read-modify-write). The region turns
         # concurrent poll/commit from two threads into a RaceError instead of
         # lost offsets (utils/racecheck.py).
         self._region = ExclusiveRegion("InProcessConsumer")
+
+    def _refresh_locked(self) -> None:
+        """Heartbeat + adopt the current assignment (caller holds broker lock).
+        On a generation change: partitions owned CONTINUOUSLY (same
+        acquisition generation on both sides) keep their local read-ahead
+        position; everything else — newly gained, or bounced away-and-back
+        while this member wasn't polling (eviction/rejoin, an intervening
+        member's whole tenure) — resumes from the GROUP's committed offsets
+        (auto.offset.reset='earliest' applies only where the group never
+        committed). Dropped partitions forget their local positions — their
+        new owner is authoritative now.
+
+        Raises on a closed consumer: Kafka errors on use-after-close, and the
+        transparent-rejoin path would otherwise re-register the member and
+        strand its partitions until the session timeout (the read is ordered
+        by the broker lock against close(), which sets the flag before
+        leaving the group)."""
+        if self._closed:
+            raise RuntimeError(
+                f"consumer {self.member_id!r} (group {self.group_id!r}) is closed")
+        gen, owned, acquired = self.broker._sync_member_locked(
+            self.group_id, self.member_id, self.topics, self._generation)
+        if owned is None:
+            return
+        offsets = self.broker._group_offsets
+        self._position = {
+            key: (self._position.get(key, offsets.get((self.group_id, *key), 0))
+                  if self._acquired.get(key) == acquired[key]
+                  else offsets.get((self.group_id, *key), 0))
+            for key in owned}
+        self._acquired = dict(acquired)
+        self._committed = {key: off for key, off in self._committed.items()
+                           if key in owned}
+        self._owned = set(owned)
+        self._generation = gen
+
+    def assignment(self) -> List[tuple]:
+        """This member's current (topic, partition) ownership (refreshed)."""
+        with self._region, self.broker._lock:
+            self._refresh_locked()
+            return sorted(self._owned)
 
     def _next_from(self, topic: str, part_idx: int) -> Optional[Message]:
         parts = self.broker._partitions(topic)
@@ -175,11 +353,12 @@ class InProcessConsumer:
         with self._region:
             deadline = time.time() + timeout
             while True:
-                for topic in self.topics:
-                    for p in range(self.broker.num_partitions):
-                        msg = self._next_from(topic, p)
-                        if msg is not None:
-                            return msg
+                with self.broker._lock:
+                    self._refresh_locked()
+                for topic, p in sorted(self._owned):
+                    msg = self._next_from(topic, p)
+                    if msg is not None:
+                        return msg
                 if time.time() >= deadline:
                     return None
                 time.sleep(0.001)
@@ -188,8 +367,8 @@ class InProcessConsumer:
         """Drain up to max_messages; waits at most ``timeout`` for the first.
 
         After the (possibly waiting) first message, the rest of the batch is
-        sliced per partition under one lock — not polled one message at a
-        time (per-message lock traffic was ~15% of the serve loop's host
+        sliced per owned partition under one lock — not polled one message at
+        a time (per-message lock traffic was ~15% of the serve loop's host
         budget at 35k msgs/sec)."""
         out: List[Message] = []
         first = self.poll(timeout)
@@ -197,31 +376,44 @@ class InProcessConsumer:
             return out
         out.append(first)
         with self._region, self.broker._lock:
-            for topic in self.topics:
+            for topic, p_idx in sorted(self._owned):
+                if len(out) >= max_messages:
+                    return out
                 all_parts = self.broker._topics.get(topic)
                 if all_parts is None:
                     continue
-                for p_idx, part in enumerate(all_parts):
-                    if len(out) >= max_messages:
-                        return out
-                    key = (topic, p_idx)
-                    pos = self._position.get(key, 0)
-                    take = min(len(part) - pos, max_messages - len(out))
-                    if take > 0:
-                        out.extend(part[pos : pos + take])
-                        self._position[key] = pos + take
+                part = all_parts[p_idx]
+                key = (topic, p_idx)
+                pos = self._position.get(key, 0)
+                take = min(len(part) - pos, max_messages - len(out))
+                if take > 0:
+                    out.extend(part[pos : pos + take])
+                    self._position[key] = pos + take
         return out
 
     def commit(self) -> None:
         with self._region:
+            # Refresh first: a rebalance prunes _position to owned partitions,
+            # so this never advances group offsets for a partition whose new
+            # owner is already authoritative.
+            with self.broker._lock:
+                self._refresh_locked()
             self._committed.update(self._position)
             self._write_through()
 
     def commit_offsets(self, offsets: Dict[tuple, int]) -> None:
         with self._region:
-            for key, off in offsets.items():
-                if off > self._committed.get(key, 0):
-                    self._committed[key] = off
+            advances = {key: off for key, off in offsets.items()
+                        if off > self._committed.get(key, 0)}
+            with self.broker._lock:
+                self._refresh_locked()
+                lost = [key for key in advances if key not in self._owned]
+                if lost:
+                    raise CommitFailedError(
+                        f"group {self.group_id!r} rebalanced: member "
+                        f"{self.member_id!r} no longer owns {sorted(lost)}; "
+                        "offsets stay uncommitted — the new owner reprocesses")
+            self._committed.update(advances)
             self._write_through()
 
     def _write_through(self) -> None:
@@ -239,7 +431,9 @@ class InProcessConsumer:
         self._position = dict(self._committed)
 
     def close(self) -> None:
-        self._closed = True
+        if not self._closed:
+            self._closed = True
+            self.broker._leave_group(self.group_id, self.member_id)
 
 
 class InProcessProducer:
