@@ -110,3 +110,23 @@ def predict_dense(model: LogisticRegression, x) -> tuple[jax.Array, jax.Array]:
 def predict_encoded(model: LogisticRegression, batch: EncodedBatch) -> tuple[jax.Array, jax.Array]:
     """Fused sparse path over an EncodedBatch (idf must be folded into weights)."""
     return _predict_encoded(model, jnp.asarray(batch.ids), jnp.asarray(batch.counts))
+
+
+def predict_encoded_mesh(model: LogisticRegression, batch: EncodedBatch,
+                         mesh) -> tuple[np.ndarray, np.ndarray]:
+    """Data-parallel serving over a device mesh: the encoded batch's rows are
+    sharded on the mesh "data" axis (weights replicated), each device scores
+    its shard with the same fused gather-accumulate as ``prob_encoded``, and
+    ONE gather returns the full probability vector — the horizontal-serving
+    shape of BASELINE's v5e-8 north star (N chips scoring one micro-batch;
+    the reference scales the same way with N Spark consumers on its
+    3-partition topic). Rows are zero-padded to a data-axis multiple on the
+    host; padded rows cost sigmoid(intercept) each and are sliced off before
+    returning. Returns host (pred, prob) at the real row count."""
+    from fraud_detection_tpu.parallel.mesh import shard_rows
+
+    n = batch.ids.shape[0]
+    ids = shard_rows(np.asarray(batch.ids), mesh)
+    counts = shard_rows(np.asarray(batch.counts), mesh)
+    prob = np.asarray(_prob_encoded(model, ids, counts))[:n]
+    return (prob > model.threshold).astype(np.int32), prob

@@ -79,3 +79,35 @@ def test_serving_pipeline_from_shipped_artifact(reference_artifact_path):
     batch = pipe.predict([SCAM_TEXT, BENIGN_TEXT] * 5)
     assert batch.labels.tolist() == [1, 0] * 5
     np.testing.assert_allclose(batch.probabilities[0], prob, rtol=1e-5)
+
+
+def test_predict_encoded_mesh_matches_single_device():
+    """Data-parallel mesh serving (rows sharded over "data", weights
+    replicated) returns the same probabilities as the single-device fused
+    path, including when rows don't divide the mesh (zero-padded rows are
+    sliced off) — the dryrun's serving leg, pinned on the CPU mesh."""
+    import numpy as np
+
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+    from fraud_detection_tpu.models.linear import (LogisticRegression,
+                                                   predict_encoded_mesh,
+                                                   prob_encoded)
+    from fraud_detection_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    model = LogisticRegression.from_arrays(
+        rng.normal(0, 0.3, 4096).astype(np.float32), -0.5)
+    feat = HashingTfIdfFeaturizer(num_features=4096)
+    texts = [f"urgent prize claim number {i}" if i % 2
+             else f"hello appointment slot {i}" for i in range(19)]  # 19 % 8 != 0
+    enc = feat.encode(texts, max_tokens=16)   # 19 rows: 19 % 8 != 0
+
+    mesh = make_mesh(n_devices=8)
+    pred, prob = predict_encoded_mesh(model, enc, mesh)
+    want = np.asarray(prob_encoded(model, enc))
+    # Both paths return the featurizer's row count (callers slice to
+    # len(texts) like ServingPipeline does); the MESH padding to a
+    # device-count multiple must not leak out.
+    assert prob.shape == want.shape == (19,)
+    np.testing.assert_allclose(prob, want, rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(pred, (want > 0.5).astype(np.int32))
