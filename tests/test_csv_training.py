@@ -1,0 +1,72 @@
+"""End-to-end training from a real-schema CSV (round-2 verdict item 8).
+
+The reference trains from ``agent_conversation_all.csv`` with a 4-column
+schema — dialogue, personality, type, labels — through the filter/cast/clean
+chain at fraud_detection_spark.py:30-45. That dataset isn't fetchable here,
+so ``tests/data/agent_conversation_sample.csv`` is a vendored 57-row
+schema-identical sample (50 content rows + 7 hand-written edge rows pinning
+the chain: trimmed labels, float labels, out-of-domain labels, clean-text
+emptiness vs the all-spaces survivor quirk, CSV quoting). These tests drive the NON-synthetic branch of
+``app/train.py load_corpus`` end to end — previously only unit-tested.
+"""
+
+import json
+import os
+
+import numpy as np
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data",
+                       "agent_conversation_sample.csv")
+
+
+def test_strict_loader_filter_chain():
+    """load_dialogue_csv applies the reference's exact semantics: ' 1 ' is
+    trimmed and kept, '1.0'/'2'/'scam' are dropped (isin(["0","1"])), a
+    no-spaces symbol dialogue cleans to the EXACT empty string and is
+    dropped, a digits+spaces dialogue cleans to all-spaces and SURVIVES
+    (the reference filters only clean_text != "" —
+    fraud_detection_spark.py:45; loader parity note Q3), and quoted
+    commas/newlines survive CSV parsing intact."""
+    from fraud_detection_tpu.data import load_dialogue_csv
+
+    rows = load_dialogue_csv(FIXTURE)
+    # 57 raw = 50 content + 7 edge; strict keeps 50 + trimmed + spaces + quoted.
+    assert len(rows) == 53
+    assert all(r.label in (0, 1) for r in rows)
+    spaces = [r for r in rows if not r.clean_text.strip()]
+    assert len(spaces) == 1 and spaces[0].clean_text != ""  # the survivor quirk
+    quoted = [r for r in rows if "all clear" in r.dialogue]
+    assert len(quoted) == 1 and "\n" in quoted[0].dialogue
+    assert quoted[0].kind == "clinic" and quoted[0].personality == "cheerful"
+
+
+def test_train_cli_end_to_end_from_csv(tmp_path):
+    """The full driver on --data <csv>: load, split, train, evaluate, save,
+    re-serve — the reference's whole main() on file-sourced data. The CLI
+    additionally accepts '1.0'-style labels (documented convenience), so it
+    sees one row more than the strict loader."""
+    from fraud_detection_tpu.app.train import main as train_main
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+
+    metrics = tmp_path / "metrics.json"
+    rc = train_main([
+        "--data", FIXTURE, "--seed", "42",
+        "--models", "dt,lr", "--num-features", "1024",
+        "--metrics-out", str(metrics),
+        "--save", f"lr={tmp_path / 'ckpt_lr'}",
+    ])
+    assert rc == 0
+    report = json.loads(metrics.read_text())
+    # 54 usable rows (53 strict + the '1.0' convenience row), split 70/10/20.
+    assert report["meta"]["splits"] == {"train": 38, "val": 5, "test": 11}
+    assert set(report["metrics"]) == {"dt", "lr"}
+    for split in ("Validation", "Test"):
+        cm = np.asarray(report["metrics"]["lr"][split]["confusion"])
+        assert cm.sum() == report["meta"]["splits"]["val" if split == "Validation" else "test"]
+
+    pipe = ServingPipeline.from_checkpoint(str(tmp_path / "ckpt_lr"), batch_size=8)
+    label, p = pipe.predict_one(
+        "Agent: You must verify your account immediately and pay the fee with "
+        "gift cards today or a warrant will be issued. This is very urgent, "
+        "do not hang up and do not tell anyone at your bank.")
+    assert label in (0, 1) and 0.0 <= p <= 1.0

@@ -1,0 +1,97 @@
+"""Streamlit app wiring, driven headlessly (round-2 verdict item 9).
+
+streamlit isn't installable in this environment, so the wiring that main()
+composes — backend selection in ``build_agent`` (the function behind the
+``st.cache_resource`` boundary) and the real-time monitor's worker-thread
+lifecycle (``MonitorState``/``start_monitor``) — is driven at module level.
+An AppTest-based drive of main() itself runs wherever streamlit exists
+(skipped here via importorskip).
+
+Reference surface: /root/reference/app_ui.py (three tabs; its monitor ran a
+blocking poll loop in the script thread — the worker-thread design under
+test is this framework's fix for that race, SURVEY.md §5).
+"""
+
+import time
+
+import pytest
+
+from fraud_detection_tpu.app.ui import MonitorState, build_agent, start_monitor
+from fraud_detection_tpu.explain import CannedBackend, FraudAnalysisAgent, OpenAIChatBackend
+from fraud_detection_tpu.utils import AppConfig
+
+
+@pytest.fixture()
+def config(monkeypatch, reference_artifact_path):
+    # The shipped Spark artifact loads in milliseconds (no training), making
+    # agent construction cheap; it is also the UI's real default in serving.
+    # reference_artifact_path (conftest) skips cleanly where it's absent.
+    monkeypatch.setenv("FRAUD_MODEL_PATH", f"spark:{reference_artifact_path}")
+    monkeypatch.delenv("DEEPSEEK_API_KEY", raising=False)
+    return AppConfig.from_env(dotenv_paths=[])
+
+
+def test_build_agent_backend_selection(config):
+    """The sidebar's backend choice maps to the right backend class, with the
+    documented fallback: 'DeepSeek API' without an api key degrades to the
+    canned offline backend instead of constructing a client that would 401."""
+    offline = build_agent(config, "Offline (no LLM)", "", temperature=0.7)
+    assert isinstance(offline, FraudAnalysisAgent)
+    assert isinstance(offline.backend, CannedBackend)
+    assert offline.temperature == pytest.approx(0.7)
+
+    url_agent = build_agent(config, "OpenAI-compatible URL",
+                            "http://localhost:9999/v1", temperature=0.2)
+    assert isinstance(url_agent.backend, OpenAIChatBackend)
+    assert url_agent.backend.base_url.startswith("http://localhost:9999")
+
+    no_key = build_agent(config, "DeepSeek API", "", temperature=1.0)
+    assert isinstance(no_key.backend, CannedBackend)
+
+
+def test_monitor_thread_lifecycle(config):
+    """Start Monitoring (demo mode) spins the engine in a daemon worker;
+    results tap into the thread-safe deque; Stop halts the thread promptly;
+    a second start on the reset state works (the rerun-after-stop path)."""
+    agent = build_agent(config, "Offline (no LLM)", "", temperature=1.0)
+
+    state = MonitorState(maxlen=50)
+    start_monitor(state, agent, config, demo=True)
+    assert state.thread is not None and state.thread.daemon
+
+    deadline = time.time() + 30
+    while time.time() < deadline and not state.snapshot(1):
+        time.sleep(0.05)
+    snap = state.snapshot(5)
+    assert snap, "no classified messages reached the monitor tap"
+    assert all({"prediction", "label"} <= set(p) for p in snap)
+    assert len(snap) <= 5
+    assert state.engine.stats.processed > 0
+
+    state.engine.stop()
+    state.thread.join(timeout=15)
+    assert not state.thread.is_alive()
+
+    # the UI's Stop button clears engine; Start builds a fresh one
+    state.engine = None
+    start_monitor(state, agent, config, demo=True)
+    state.engine.stop()
+    state.thread.join(timeout=15)
+    assert not state.thread.is_alive()
+
+
+def test_main_via_apptest():
+    """Full main() drive wherever streamlit is installed — the only place
+    the real @st.cache_resource agent keying (choice, url, temperature) is
+    exercised; module-level tests cover the build_agent factory behind it."""
+    import os
+
+    st = pytest.importorskip("streamlit")
+    from streamlit.testing.v1 import AppTest
+
+    ui_path = os.path.join(os.path.dirname(__file__), "..",
+                           "fraud_detection_tpu", "app", "ui.py")
+    at = AppTest.from_file(ui_path, default_timeout=60)
+    at.run()
+    assert not at.exception
+    assert at.title and "Phone-Scam Detection" in at.title[0].value
