@@ -36,7 +36,27 @@ import time
 
 import numpy as np
 
+
+from fraud_detection_tpu.utils.jax_cache import enable_persistent_compile_cache
+
+# The tree trainers compile depth-unrolled programs that cost far more to
+# compile than to run (RF-100's fused chunk: ~22s cold vs ~4.7s of actual
+# building; the 18-layer LLM programs are similar) — without the cache,
+# recorded fit times lean toward compile benchmarks. Same cache as the
+# test suite (ONE definition: utils/jax_cache.py).
+enable_persistent_compile_cache()
+
 NORTH_STAR = 10_000.0  # dialogues/sec, BASELINE.json
+
+# TPU v5e (v5litepod) public per-chip peaks — the denominators for every
+# mfu/roofline field in the bench line. Off-TPU the fields are omitted
+# (a CPU "percent of v5e peak" would be noise).
+V5E_PEAK_BF16_FLOPS = 197e12   # MXU, bf16
+V5E_PEAK_HBM_BPS = 819e9       # HBM bandwidth, bytes/sec
+
+
+def _peaks_if_tpu():
+    return (V5E_PEAK_BF16_FLOPS, V5E_PEAK_HBM_BPS) if _on_tpu() else (None, None)
 
 
 def build_pipeline(batch_size: int, model: str = "lr"):
@@ -126,12 +146,22 @@ def training_bench() -> dict:
     """Wall-clock for the three reference model families on the default
     (Pallas-on-TPU) path. DT is fit twice: the first call carries the jit
     compile for this (N, F) shape, the second is the steady-state number
-    (RF/GBT amortize compilation across their chunks/rounds internally)."""
+    (RF/GBT amortize compilation across their chunks/rounds internally).
+
+    Data reaches the device as int8 BIN IDS, not floats: quantile edges come
+    from a 20k-row sample, the full matrix is binned on the host
+    (``bin_rows_host``), and the upload is a quarter of the f32 bytes —
+    round-2 verdict item 4 (the 819MB f32 upload took ~24s over the tunnel
+    and dwarfed every fit it fed). A sample of the host bins is checked
+    against the device ``apply_bins`` before anything is timed, so the
+    measured path stays a verified-correct one.
+    """
     import jax
+    import jax.numpy as jnp
 
     from fraud_detection_tpu.models.train_trees import (
-        TreeTrainConfig, fit_decision_tree, fit_gradient_boosting,
-        fit_random_forest, quantile_bin_edges)
+        TreeTrainConfig, apply_bins, bin_rows_host, fit_decision_tree,
+        fit_gradient_boosting, fit_random_forest, quantile_bin_edges)
 
     rows = int(os.environ.get("BENCH_TRAIN_ROWS", "100000"))
     features = int(os.environ.get("BENCH_TRAIN_FEATURES", "2048"))
@@ -145,35 +175,79 @@ def training_bench() -> dict:
                                              replace=False)
     edges = quantile_bin_edges(X[sample], 32)
 
-    import jax.numpy as jnp
+    tb = time.time()
+    bins8 = bin_rows_host(X, edges)               # (N, F) int8
+    bin_host_s = time.time() - tb
+    # Binning parity on a sample: host searchsorted == device compare-count.
+    check = np.asarray(apply_bins(jnp.asarray(X[:2048]), jnp.asarray(edges)))
+    assert (check == bins8[:2048]).all(), "host/device binning disagree"
 
-    cfg = TreeTrainConfig()           # use_pallas resolves per backend
-    # Stage the matrix on device once, untimed: training measures the
-    # trainers, not the host->device link (which on a tunneled host costs
-    # more than the fits; a co-located host pays ~0.1s for this transfer).
     tu = time.time()
-    X_dev = jnp.asarray(X)
+    X_dev = jnp.asarray(bins8)
     X_dev.block_until_ready()
     upload_s = time.time() - tu
 
     t0 = time.time()
-    fit_decision_tree(X_dev, y, config=cfg, edges=edges)
+    fit_decision_tree(X_dev, y, config=None, edges=edges)
     t1 = time.time()
-    fit_decision_tree(X_dev, y, config=cfg, edges=edges)
+    fit_decision_tree(X_dev, y, config=None, edges=edges)
     t2 = time.time()
-    fit_random_forest(X_dev, y, n_trees=n_trees, config=cfg, edges=edges)
+    fit_random_forest(X_dev, y, n_trees=n_trees, edges=edges)
     t3 = time.time()
     fit_gradient_boosting(X_dev, y, n_rounds=n_trees, edges=edges)
     t4 = time.time()
-    return {
+    cfg = TreeTrainConfig()           # use_pallas resolves per backend
+    from fraud_detection_tpu.models.train_trees import resolve_tree_chunk
+
+    chunk = resolve_tree_chunk(cfg)   # the trainer's own per-program width
+    # Steady-state rates: re-fit small counts now that the programs are
+    # compiled — the 100-tree walls above include one-time compile+trace
+    # (which the persistent cache only halves; tracing and Pallas lowering
+    # re-run per process).
+    t5 = time.time()
+    fit_random_forest(X_dev, y, n_trees=2 * chunk, edges=edges)
+    t6 = time.time()
+    fit_gradient_boosting(X_dev, y, n_rounds=16, edges=edges)
+    t7 = time.time()
+    rf_steady_s, xgb_steady_s = (t6 - t5) / (2 * chunk), (t7 - t6) / 16
+
+    out = {
         "rows": rows, "features": features, "depth": cfg.max_depth,
         "pallas": bool(cfg.use_pallas), "backend": jax.default_backend(),
-        "parity_max_abs_diff": parity, "data_upload_s": round(upload_s, 3),
+        "parity_max_abs_diff": parity,
+        "bin_host_s": round(bin_host_s, 3),
+        "upload_bytes": int(bins8.nbytes),
+        "data_upload_s": round(upload_s, 3),
         "dt_fit_s": round(t2 - t1, 3),
         "dt_fit_with_compile_s": round(t1 - t0, 3),
         f"rf{n_trees}_fit_s": round(t3 - t2, 3),
         f"xgb{n_trees}_fit_s": round(t4 - t3, 3),
+        "rf_steady_trees_per_s": round(1.0 / rf_steady_s, 1),
+        "xgb_steady_trees_per_s": round(1.0 / xgb_steady_s, 1),
     }
+    _, hbm_peak = _peaks_if_tpu()
+    if hbm_peak:
+        # Roofline for the histogram sweep — the algorithm's MINIMUM
+        # mandatory HBM traffic: each depth level streams the full (N, F)
+        # int32 bin matrix once per builder program (the fused RF kernel
+        # shares ONE sweep across its whole chunk; XGB sweeps once per
+        # round). All three legs use STEADY-STATE walls (DT's second fit,
+        # the post-compile RF/XGB re-fits) so the ratios describe program
+        # structure, not compile time. FLOP counting is meaningless for
+        # binned tree building, so HBM is the denominator; achieved
+        # single-digit percentages of peak say the builder is bound by
+        # structure (31 small per-level grids, gain scans, routing), NOT
+        # bandwidth — the sweep model shows headroom, not saturation.
+        sweep = rows * features * 4 * (cfg.max_depth + 1)      # bytes/program
+        legs = {"dt": (t2 - t1, sweep),
+                "rf_chunk_steady": (t6 - t5, sweep * 2),
+                "xgb_rounds_steady": (t7 - t6, sweep * 16)}
+        out["roofline"] = {
+            name: {"hist_sweep_gb": round(bytes_ / 1e9, 1),
+                   "achieved_gbps": round(bytes_ / secs / 1e9, 1),
+                   "pct_hbm_peak": round(100 * bytes_ / secs / hbm_peak, 1)}
+            for name, (secs, bytes_) in legs.items()}
+    return out
 
 
 def _warm(pipe, texts, batch_size: int) -> None:
@@ -228,49 +302,221 @@ def tree_streaming_bench(texts, batch_size: int, depth: int,
     return out
 
 
+GEMMA2B_HF_CONFIG = {
+    # Gemma-2B's actual architecture (BASELINE config 5 names "Gemma-2B via
+    # JAX" as the on-pod scale target): MQA with one 256-wide KV head, GeGLU
+    # ffw, tied embeddings, 256k vocab.
+    "model_type": "gemma", "vocab_size": 256000, "hidden_size": 2048,
+    "intermediate_size": 16384, "num_hidden_layers": 18,
+    "num_attention_heads": 8, "num_key_value_heads": 1, "head_dim": 256,
+    "hidden_act": "gelu", "rms_norm_eps": 1e-6, "rope_theta": 10000.0,
+    "tie_word_embeddings": True,
+}
+
+
+def _gemma2b_synthetic_dir() -> str:
+    """Write (once, cached) a synthetic HF checkpoint with Gemma-2B's exact
+    architecture: config.json + model.safetensors, bf16 random weights in the
+    HF tensor layout. The real weights can't be fetched here (zero egress);
+    perf is weight-value independent, so this makes the 2.5B-param serving
+    path measurable end to end THROUGH the real converter (hf_convert.py)."""
+    import ml_dtypes
+
+    from fraud_detection_tpu.checkpoint.hf_convert import write_safetensors
+
+    cache = os.environ.get("BENCH_GEMMA_DIR",
+                           os.path.expanduser("~/.cache/fraud_tpu_gemma2b"))
+    cfg_path = os.path.join(cache, "config.json")
+    st_path = os.path.join(cache, "model.safetensors")
+    if os.path.exists(cfg_path) and os.path.exists(st_path):
+        try:
+            with open(cfg_path) as f:
+                if json.load(f) == GEMMA2B_HF_CONFIG:
+                    return cache
+        except (OSError, ValueError):
+            pass  # truncated/corrupt cache: rebuild below
+        # stale cache from an older config constant: rebuild, don't silently
+        # benchmark yesterday's architecture
+    os.makedirs(cache, exist_ok=True)
+    c = GEMMA2B_HF_CONFIG
+    D, dh = c["hidden_size"], c["head_dim"]
+    H, Hkv, F = c["num_attention_heads"], c["num_key_value_heads"], c["intermediate_size"]
+    rng = np.random.default_rng(0)
+
+    def w(*shape, scale=0.02):
+        return (rng.standard_normal(shape, dtype=np.float32) * scale).astype(
+            ml_dtypes.bfloat16)
+
+    tensors = {"model.embed_tokens.weight": w(c["vocab_size"], D),
+               # Gemma RMSNorm stores gamma - 1; zeros mean gamma = 1.
+               "model.norm.weight": np.zeros(D, ml_dtypes.bfloat16)}
+    for l in range(c["num_hidden_layers"]):
+        pre = f"model.layers.{l}."
+        tensors[pre + "self_attn.q_proj.weight"] = w(H * dh, D)
+        tensors[pre + "self_attn.k_proj.weight"] = w(Hkv * dh, D)
+        tensors[pre + "self_attn.v_proj.weight"] = w(Hkv * dh, D)
+        tensors[pre + "self_attn.o_proj.weight"] = w(D, H * dh)
+        tensors[pre + "mlp.gate_proj.weight"] = w(F, D)
+        tensors[pre + "mlp.up_proj.weight"] = w(F, D)
+        tensors[pre + "mlp.down_proj.weight"] = w(D, F)
+        tensors[pre + "input_layernorm.weight"] = np.zeros(D, ml_dtypes.bfloat16)
+        tensors[pre + "post_attention_layernorm.weight"] = np.zeros(D, ml_dtypes.bfloat16)
+    write_safetensors(st_path, tensors)
+    # config.json is the cache-validity marker, so it lands LAST and
+    # atomically — a kill mid-write must not leave a "valid-looking" dir.
+    tmp = cfg_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(c, f)
+    os.replace(tmp, cfg_path)
+    return cache
+
+
+def _llm_flops_per_token(cfg) -> float:
+    """Matmul FLOPs per token (2 MACs per weight element): qkvo + gated mlp
+    per layer, plus the d_model x vocab output head. Embedding lookup is a
+    gather, not FLOPs."""
+    D, dh, H, Hkv = cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.kv_heads
+    per_layer = 2 * D * (H * dh) + 2 * D * (Hkv * dh) + 3 * D * cfg.d_ff
+    return 2.0 * (cfg.n_layers * per_layer + D * cfg.vocab_size)
+
+
 def llm_bench() -> dict:
-    """On-pod explanation LLM evidence: prefill tokens/sec through the
-    flash-attention path at T=2048 and incremental decode tokens/sec
-    against the KV cache (BASELINE config 5 — the zero-egress replacement
-    for the reference's per-message DeepSeek HTTPS round trip,
-    utils/agent_api.py:36,66)."""
+    """On-pod explanation LLM at BASELINE's named scale: a Gemma-2B-
+    architecture checkpoint (synthetic weights, real converter) — prefill
+    tokens/sec through the flash-attention path at T=2048, single-stream and
+    BATCHED decode against the KV cache, explanations/sec through the
+    generate_batch seam the engine's explain_batch_fn drives, and MFU /
+    HBM-roofline accounting for each (round-2 verdict items 2 and 3).
+    BENCH_LLM_SCALE=demo falls back to the tiny 4-layer config (the only
+    option off-TPU, where 2.5B bf16 params don't fit a CPU run's patience)."""
     import jax
     import jax.numpy as jnp
 
     from fraud_detection_tpu.models import llm
 
-    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
-    cfg = llm.TransformerConfig(d_model=256, n_layers=4, n_heads=8,
-                                d_ff=1024, max_seq=4096, dtype=dtype)
-    model = llm.LanguageModel.init_random(cfg, seed=0)
+    scale = os.environ.get("BENCH_LLM_SCALE",
+                           "gemma2b" if _on_tpu() else "demo")
+    if scale == "gemma2b":
+        from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
+
+        t0 = time.perf_counter()
+        ckpt_dir = _gemma2b_synthetic_dir()
+        synth_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        model = load_hf_checkpoint(ckpt_dir, max_seq=4096, tokenizer="byte")
+        jax.block_until_ready(model.params)
+        load_s = time.perf_counter() - t0
+        cfg = model.cfg
+        meta = {"model": "gemma-2b-arch (synthetic weights)",
+                "synth_checkpoint_s": round(synth_s, 1),
+                "convert_upload_s": round(load_s, 1)}
+    else:
+        dtype = jnp.bfloat16 if _on_tpu() else jnp.float32
+        cfg = llm.TransformerConfig(d_model=256, n_layers=4, n_heads=8,
+                                    d_ff=1024, max_seq=4096, dtype=dtype)
+        model = llm.LanguageModel.init_random(cfg, seed=0)
+        meta = {"model": "demo"}
+
+    n_params = int(sum(np.prod(x.shape) for x in model.params.values()))
+    param_bytes = int(sum(np.prod(x.shape) * x.dtype.itemsize
+                          for x in model.params.values()))
+    flops_tok = _llm_flops_per_token(cfg)
+    meta.update({"params": n_params, "n_layers": cfg.n_layers,
+                 "d_model": cfg.d_model, "vocab": cfg.vocab_size,
+                 "dtype": str(np.dtype(cfg.dtype).name)})
+    flops_peak, hbm_peak = _peaks_if_tpu()
+
     rng = np.random.default_rng(0)
     T = 2048
-    toks = jnp.asarray(rng.integers(0, 256, size=(1, T)), jnp.int32)
+    toks = jnp.asarray(rng.integers(0, 255, size=(1, T)), jnp.int32)
 
-    # Jitted, like the decode path's _generate_jit — timing the eager
-    # per-op dispatch instead would swamp this small model's compute.
-    prefill = jax.jit(lambda p, t: llm.forward(p, t, cfg)[0])
-    prefill(model.params, toks).block_until_ready()
+    # Timing rules for the tunneled device (see ROUND3 notes): (1) never
+    # trust block_until_ready alone — on the axon platform it acks the
+    # dispatch, not completion (it "measured" 226x MXU peak); (2) fetch a
+    # SMALL output computed inside jit — slicing the (1, T, V) logits from
+    # the host would pull all 2GB through the tunnel; (3) amortize the
+    # ~100ms RTT over a lax.scan of carry-DEPENDENT forwards (the carry
+    # perturbs each iteration's tokens by a runtime zero, so XLA cannot
+    # hoist the loop-invariant forward and run it once).
+    reps = 8 if _on_tpu() else 2
+
+    @jax.jit
+    def prefill_reps(p, t):
+        def body(acc, _):
+            t_i = t + (acc[:1] != acc[:1]).astype(jnp.int32)  # runtime zero
+            logits, _ = llm.forward(p, t_i, cfg)
+            return acc + logits[0, -1, :8].astype(jnp.float32), None
+        acc, _ = jax.lax.scan(body, jnp.zeros(8, jnp.float32), None,
+                              length=reps)
+        return acc
+
+    np.asarray(prefill_reps(model.params, toks))     # compile + warm
     t0 = time.perf_counter()
-    for _ in range(3):
-        out = prefill(model.params, toks)
-    out.block_until_ready()
-    prefill_tok_s = 3 * T / (time.perf_counter() - t0)
+    np.asarray(prefill_reps(model.params, toks))     # one RTT, `reps` prefills
+    prefill_dt = time.perf_counter() - t0
+    prefill_tok_s = reps * T / prefill_dt
+    # causal attention FLOPs: 4*L*H*dh per token per layer, avg L = T/2
+    attn_tok = 4.0 * (T / 2) * cfg.n_heads * cfg.head_dim * cfg.n_layers
+    line = {**meta, "prefill_T": T,
+            "prefill_tok_per_s": round(prefill_tok_s, 1)}
+    if flops_peak:
+        line["prefill_mfu_pct"] = round(
+            100 * prefill_tok_s * (flops_tok + attn_tok) / flops_peak, 1)
 
-    prompt = rng.integers(0, 256, size=128)
+    def _emitted(row) -> int:
+        eos = np.flatnonzero(np.asarray(row) == cfg.EOS)
+        return int(eos[0]) + 1 if eos.size else len(row)
+
+    prompt = rng.integers(0, 255, size=128)
     n_new = 64
     model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)  # compile
     t0 = time.perf_counter()
     out = model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
     dt = time.perf_counter() - t0
-    # Early-exit decode: count tokens actually generated (up to and incl.
-    # the first EOS), not the requested budget.
-    eos_hits = np.flatnonzero(np.asarray(out) == cfg.EOS)
-    emitted = int(eos_hits[0]) + 1 if eos_hits.size else n_new
-    return {"prefill_tok_per_s": round(prefill_tok_s, 1),
-            "decode_tok_per_s": round(emitted / dt, 1),
-            "decode_tokens": emitted,
-            "prefill_T": T, "dtype": str(dtype.__name__)}
+    emitted = _emitted(out)
+    line.update({"decode_tok_per_s": round(emitted / dt, 1),
+                 "decode_tokens": emitted})
+    if hbm_peak:
+        # Single-stream decode is weight-streaming bound: every token reads
+        # all param bytes from HBM once.
+        line["decode_weight_stream_gbps"] = round(
+            param_bytes * emitted / dt / 1e9, 1)
+        line["decode_pct_hbm_peak"] = round(
+            100 * param_bytes * emitted / dt / hbm_peak, 1)
+
+    # Batched decode — ONE device program for B uneven prompts
+    # (generate_tokens_batch, the engine under OnPodBackend.generate_batch,
+    # which the streaming engine's explain_batch_fn drives). Timed at the
+    # token level for exact counting; the text-in/text-out seam itself is
+    # exercised once, untimed.
+    from fraud_detection_tpu.explain.onpod import OnPodBackend
+
+    B = 8
+    prompts = [f"Analyze this dialogue for scam risk (case {i}): the caller "
+               "claims to be the bank fraud department and demands immediate "
+               "gift card payment to reverse a suspicious charge. "
+               + "Customer hesitates repeatedly. " * (i % 3 + 1)
+               for i in range(B)]
+    tok_prompts = [model.tokenizer.encode(p) for p in prompts]
+    model.generate_tokens_batch(tok_prompts, max_new_tokens=n_new)  # compile
+    t0 = time.perf_counter()
+    out_b = model.generate_tokens_batch(tok_prompts, max_new_tokens=n_new)
+    bdt = time.perf_counter() - t0
+    toks_out = sum(_emitted(row) for row in np.asarray(out_b))
+    line.update({"batch_decode_B": B,
+                 "batch_decode_tok_per_s": round(toks_out / bdt, 1),
+                 "explanations_per_s": round(B / bdt, 2)})
+    if hbm_peak:
+        # B rows amortize one weight stream per step; the decode while_loop
+        # runs until the SLOWEST row finishes, so the step count is the max
+        # per-row emission, not the mean.
+        steps = max(_emitted(row) for row in np.asarray(out_b))
+        line["batch_decode_weight_stream_gbps"] = round(
+            param_bytes * steps / bdt / 1e9, 1)
+    backend = OnPodBackend.from_model(model)
+    replies = backend.generate_batch(prompts[:2], temperature=0.0, max_tokens=8)
+    assert len(replies) == 2          # the explain seam stays wired
+    return line
 
 
 def main() -> None:
@@ -298,11 +544,23 @@ def main() -> None:
         if best_stats is None or stats.msgs_per_sec > best:
             best, best_stats = stats.msgs_per_sec, stats
 
+    # Device FLOPs per dialogue on the fused LR path: one gather-MAC per
+    # padded token slot (2L FLOPs at this corpus's padded width L). The
+    # resulting fraction of MXU peak is ~1e-6 % — recorded to make the
+    # bottleneck attribution explicit: streaming is bound by host transport
+    # and featurization, the device is essentially idle (round-2 verdict
+    # item 3, "stream scoring" row). LR-only: the tree families do different
+    # device work, so these fields would misattribute under BENCH_MODEL=dt.
+    L_pad = pipe.featurizer.encode(texts[:256]).ids.shape[1]
+    flops_peak, _ = _peaks_if_tpu()
+    if model != "lr":
+        flops_peak = None
+
     def _headline_fields(best, best_stats) -> dict:
         # Active per-batch processing latency of the best run (dispatch +
         # finish legs; excludes pipeline queueing) — evidence for the
         # "sub-second per dialogue" parity claim (report-paper.pdf §III.H).
-        return {
+        fields = {
             "value": round(best, 1),
             "vs_baseline": round(best / NORTH_STAR, 4),
             "batch_latency_ms": {
@@ -310,6 +568,11 @@ def main() -> None:
                 "p99": round(best_stats.latency_percentile(99) * 1e3, 2),
             },
         }
+        if flops_peak:
+            fields["device_flops_per_dialogue"] = 2 * L_pad
+            fields["device_pct_of_peak"] = round(
+                100 * best * 2 * L_pad / flops_peak, 9)
+        return fields
 
     line = {
         "metric": "kafka_stream_classification_throughput",

@@ -1,0 +1,28 @@
+"""Shared persistent-XLA-compilation-cache setup.
+
+The tree trainers unroll depth-wise programs and the 18-layer LLM compiles
+cost far more than they run; both the test suite (tests/conftest.py) and the
+benchmark (bench.py) want the same on-disk cache so they share compiled
+programs. ONE definition here keeps the directory and knobs from drifting
+apart. Tracing and Pallas lowering still run per process — the cache roughly
+halves a cold program's cost, it does not zero it.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_compile_cache(min_compile_secs: float = 1.0) -> None:
+    """Best-effort: the cache is an optimization, never a failure source."""
+    import jax
+
+    path = os.environ.get("JAX_TEST_COMPILATION_CACHE",
+                          os.path.expanduser("~/.cache/fraud_tpu_jax_tests"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+    except Exception:
+        pass

@@ -23,14 +23,10 @@ jax.config.update("jax_num_cpu_devices", 8)
 # Persistent compilation cache: the tree trainers unroll depth-wise programs
 # whose CPU compiles dominate suite wall-clock (~half of the slowest tests'
 # time); repeat runs — including the driver's — hit the cache instead.
-_CACHE_DIR = os.environ.get("JAX_TEST_COMPILATION_CACHE",
-                            os.path.expanduser("~/.cache/fraud_tpu_jax_tests"))
-try:
-    os.makedirs(_CACHE_DIR, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:  # cache is an optimization, never a failure source
-    pass
+# Shared definition with bench.py (utils/jax_cache.py).
+from fraud_detection_tpu.utils.jax_cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
 
 import pytest  # noqa: E402
 

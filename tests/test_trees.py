@@ -269,3 +269,59 @@ def test_serving_pipeline_multiclass_tree_uses_argmax():
     want, _ = predict(dt, jnp.asarray(X))
     np.testing.assert_array_equal(got.labels, np.asarray(want))
     assert np.mean(got.labels == y) > 0.9
+
+
+def test_prebinned_int8_training_matches_float_path():
+    """bin_rows_host + int8 upload is the remote-tunnel training path
+    (round-2 verdict item 4): host bins must equal device apply_bins
+    bit-for-bit, trainers must accept the int8 matrix with edges and build
+    the identical model, and pre-binned input without edges must refuse."""
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.models.train_trees import (
+        apply_bins, bin_rows_host, fit_decision_tree, fit_gradient_boosting,
+        quantile_bin_edges)
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(0, 1, (400, 24)).astype(np.float32)
+    X[rng.uniform(size=X.shape) < 0.6] = 0.0        # TF-IDF-ish zero inflation
+    y = (X[:, 0] + 0.2 * rng.normal(size=400) > 0).astype(np.int32)
+    edges = quantile_bin_edges(X, 32)
+
+    bins8 = bin_rows_host(X, edges)
+    assert bins8.dtype == np.int8
+    np.testing.assert_array_equal(
+        np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges))), bins8)
+
+    for fit in (fit_decision_tree,
+                lambda a, b, edges: fit_gradient_boosting(a, b, n_rounds=3,
+                                                          edges=edges)):
+        m_f32 = fit(X, y, edges=edges)
+        m_int8 = fit(bins8, y, edges=edges)
+        for field_name in ("feature", "threshold", "left", "right", "leaf"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(m_f32, field_name)),
+                np.asarray(getattr(m_int8, field_name)), err_msg=field_name)
+
+    with pytest.raises(ValueError, match="pre-binned"):
+        fit_decision_tree(bins8, y)
+
+
+def test_prebinned_guards_reject_garbage():
+    """The integer-dtype pre-binned signal is validated, not trusted: raw
+    integer features (out-of-range ids) raise instead of silently indexing
+    histograms with garbage, and host binning refuses edge counts beyond
+    int8 (round-3 review findings)."""
+    from fraud_detection_tpu.models.train_trees import (
+        bin_rows_host, fit_decision_tree, quantile_bin_edges)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (100, 8)).astype(np.float32)
+    edges = quantile_bin_edges(X, 32)
+    raw_counts = rng.integers(0, 500, (100, 8)).astype(np.int32)  # NOT bins
+    with pytest.raises(ValueError, match="bin_rows_host output"):
+        fit_decision_tree(raw_counts, (X[:, 0] > 0).astype(int), edges=edges)
+
+    wide = np.tile(np.linspace(0, 1, 200, dtype=np.float32)[:, None], (1, 8))
+    with pytest.raises(ValueError, match="int8 range"):
+        bin_rows_host(X, quantile_bin_edges(wide, 256))
