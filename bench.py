@@ -491,7 +491,11 @@ def llm_bench() -> dict:
     # exercised once, untimed.
     from fraud_detection_tpu.explain.onpod import OnPodBackend
 
-    B = 8
+    # Weight-streaming-bound decode amortizes ~linearly with batch: measured
+    # 13.1 / 26.2 / 41.8 explanations/sec at B=8/16/32 on the 2B model
+    # (B=16 costs the same wall as B=8). Default 8 keeps the driver's run
+    # short; BENCH_LLM_B raises it.
+    B = int(os.environ.get("BENCH_LLM_B", "8"))
     prompts = [f"Analyze this dialogue for scam risk (case {i}): the caller "
                "claims to be the bank fraud department and demands immediate "
                "gift card payment to reverse a suspicious charge. "

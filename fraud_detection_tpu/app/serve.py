@@ -60,6 +60,11 @@ def main(argv=None) -> int:
                     help="restart the engine up to N times on crash/flush "
                          "failure (resumes from committed offsets; see "
                          "stream.engine.run_supervised)")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="engines sharing ONE consumer group: each owns a "
+                         "disjoint partition subset (the reference's "
+                         "--partitions 3 scale-out unit; docs/serving.md "
+                         "'Horizontal scale-out')")
     args = ap.parse_args(argv)
 
     if args.kafka and args.demo:
@@ -68,6 +73,8 @@ def main(argv=None) -> int:
         # Fail fast: inside --supervise this would read as a transient
         # incarnation failure and burn restarts on a pure config error.
         raise SystemExit(f"--pipeline-depth must be >= 1, got {args.pipeline_depth}")
+    if args.workers < 1:
+        raise SystemExit(f"--workers must be >= 1, got {args.workers}")
 
     from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
     from fraud_detection_tpu.stream.kafka import kafka_available
@@ -107,7 +114,84 @@ def main(argv=None) -> int:
                                    pipeline_depth=args.pipeline_depth)
 
     print(f"serving: model={args.model} in={args.input_topic} out={args.output_topic} "
-          f"batch={args.batch_size}", flush=True)
+          f"batch={args.batch_size} workers={args.workers}", flush=True)
+    if args.workers > 1:
+        # Horizontal scale-out: N engines, ONE group — the broker (in-process
+        # or Kafka) deals each a disjoint partition subset; a worker's exit
+        # rebalances its partitions to the survivors. Workers share the
+        # pipeline (scoring is jitted + thread-safe; the engine serializes
+        # its own consumer). Per-worker message caps can't split a global
+        # --max-messages meaningfully — refuse the combination rather than
+        # silently ignore the cap (the CLI's other config conflicts fail
+        # fast too).
+        if args.max_messages is not None:
+            raise SystemExit(
+                "--max-messages cannot be split across --workers > 1; "
+                "drop one of the two (workers drain until idle)")
+        import threading
+
+        from fraud_detection_tpu.stream.engine import (StreamStats,
+                                                       _merge_stats,
+                                                       run_supervised)
+
+        results = [None] * args.workers
+        errors = [None] * args.workers
+        live = [None] * args.workers     # current engine, for Ctrl-C stop
+
+        def run_worker(i: int) -> None:
+            def make():
+                live[i] = make_engine()
+                return live[i]
+
+            try:
+                if args.supervise > 0:
+                    results[i] = run_supervised(
+                        make, max_restarts=args.supervise,
+                        max_messages=None, idle_timeout=idle)
+                else:
+                    engine = make()
+                    try:
+                        results[i] = engine.run(max_messages=None,
+                                                idle_timeout=idle)
+                    finally:
+                        engine.consumer.close()
+            except BaseException as e:  # noqa: BLE001 — surfaced via exit code
+                errors[i] = e
+
+        threads = [threading.Thread(target=run_worker, args=(i,), daemon=True)
+                   for i in range(args.workers)]
+        for t in threads:
+            t.start()
+        try:
+            for t in threads:
+                t.join()
+        except KeyboardInterrupt:
+            # Graceful drain: stop every live engine (its run() returns and
+            # the worker's close/supervisor path leaves the group — killing
+            # daemon threads abruptly would strand partitions on zombie
+            # members until the session timeout).
+            for engine in live:
+                if engine is not None:
+                    engine.stop()
+            for t in threads:
+                t.join(timeout=30)
+        total = StreamStats()
+        for r in results:
+            if r is not None:
+                _merge_stats(total, r)
+        merged = {**total.as_dict(), "workers": args.workers,
+                  "per_worker_processed": [r.processed if r else None
+                                           for r in results]}
+        print(json.dumps(merged))
+        if args.demo:
+            n_out = broker.topic_size(args.output_topic)
+            print(f"classified messages on {args.output_topic}: {n_out}")
+        failures = [e for e in errors if e is not None]
+        if failures:
+            print(f"{len(failures)} worker(s) failed; first: {failures[0]!r}",
+                  file=sys.stderr)
+            return 1
+        return 0
     if args.supervise > 0:
         # The supervisor builds and closes every consumer/producer itself
         # (including on Ctrl-C, where it returns the aggregated stats).
