@@ -75,6 +75,13 @@ def main(argv=None) -> int:
         raise SystemExit(f"--pipeline-depth must be >= 1, got {args.pipeline_depth}")
     if args.workers < 1:
         raise SystemExit(f"--workers must be >= 1, got {args.workers}")
+    if args.workers > 1 and args.max_messages is not None:
+        # Per-worker message caps can't split a global cap meaningfully —
+        # refuse BEFORE the expensive pipeline build, like every other
+        # config conflict above.
+        raise SystemExit(
+            "--max-messages cannot be split across --workers > 1; "
+            "drop one of the two (workers drain until idle)")
 
     from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
     from fraud_detection_tpu.stream.kafka import kafka_available
@@ -120,14 +127,7 @@ def main(argv=None) -> int:
         # or Kafka) deals each a disjoint partition subset; a worker's exit
         # rebalances its partitions to the survivors. Workers share the
         # pipeline (scoring is jitted + thread-safe; the engine serializes
-        # its own consumer). Per-worker message caps can't split a global
-        # --max-messages meaningfully — refuse the combination rather than
-        # silently ignore the cap (the CLI's other config conflicts fail
-        # fast too).
-        if args.max_messages is not None:
-            raise SystemExit(
-                "--max-messages cannot be split across --workers > 1; "
-                "drop one of the two (workers drain until idle)")
+        # its own consumer). --max-messages was already rejected up top.
         import threading
 
         from fraud_detection_tpu.stream.engine import (StreamStats,
@@ -137,10 +137,20 @@ def main(argv=None) -> int:
         results = [None] * args.workers
         errors = [None] * args.workers
         live = [None] * args.workers     # current engine, for Ctrl-C stop
+        # Cooperative shutdown: KeyboardInterrupt only reaches the MAIN
+        # thread, so a supervised worker in its backoff sleep would rebuild
+        # and keep consuming after the operator's Ctrl-C stopped its dead
+        # incarnation. The event closes that race — an engine built after
+        # shutdown is stopped before it runs, so its run() returns
+        # immediately and the supervisor unwinds through its own
+        # close-the-consumer path.
+        shutdown = threading.Event()
 
         def run_worker(i: int) -> None:
             def make():
                 live[i] = make_engine()
+                if shutdown.is_set():
+                    live[i].stop()
                 return live[i]
 
             try:
@@ -170,6 +180,7 @@ def main(argv=None) -> int:
             # the worker's close/supervisor path leaves the group — killing
             # daemon threads abruptly would strand partitions on zombie
             # members until the session timeout).
+            shutdown.set()
             for engine in live:
                 if engine is not None:
                     engine.stop()
@@ -179,6 +190,14 @@ def main(argv=None) -> int:
         for r in results:
             if r is not None:
                 _merge_stats(total, r)
+        done = [r for r in results if r is not None]
+        # _merge_stats SUMS elapsed (right for run_supervised's sequential
+        # incarnations, wrong for parallel threads — it would report the
+        # aggregate rate divided by N); workers overlap, so wall-clock is
+        # the slowest worker. restarts isn't merged there either (the
+        # supervisor increments it outside _merge_stats).
+        total.elapsed = max((r.elapsed for r in done), default=0.0)
+        total.restarts = sum(r.restarts for r in done)
         merged = {**total.as_dict(), "workers": args.workers,
                   "per_worker_processed": [r.processed if r else None
                                            for r in results]}
