@@ -217,8 +217,16 @@ class StreamingClassifier:
         # turns a second concurrent run()/process_batch() into an immediate
         # RaceError instead of silent stat/offset corruption.
         self._drive_region = ExclusiveRegion("StreamingClassifier.drive")
+        self._stopped = False  # stop() latches this; run() then refuses
 
     def stop(self) -> None:
+        """Request shutdown — and latch it: a stopped engine STAYS stopped.
+        run() entered after stop() returns immediately instead of resetting
+        the flag, which is what lets an external coordinator (serve.py's
+        multi-worker Ctrl-C path) stop an engine it built but whose run()
+        hasn't started yet — without the latch, run()'s entry write would
+        overwrite the request and the engine would consume anyway."""
+        self._stopped = True
         self._running = False
 
     def _decode(self, msg: Message) -> Optional[str]:
@@ -482,6 +490,8 @@ class StreamingClassifier:
         (tunneled) TPU the round-trip latency exceeds one batch of host work,
         so deeper pipelining is what makes the stream host-bound."""
         with self._drive_region:
+            if self._stopped:
+                return self.stats          # stop() latched: stay stopped
             # State writes only AFTER the region admits us: a second run()
             # resetting _running/_flush_failed before its RaceError fired
             # would corrupt the active run's abort logic.

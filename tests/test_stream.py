@@ -593,3 +593,26 @@ def test_tracer_spans_recorded(pipeline):
     assert spans["dispatch"].count == stats.batches
     assert spans["finish"].count == stats.batches
     assert spans["dispatch"].total > 0 and spans["finish"].total > 0
+
+
+def test_stop_latches_before_run(pipeline):
+    """stop() on an engine whose run() hasn't started must hold: run()
+    returns immediately without consuming (round-3 review: run()'s entry
+    used to reset the flag, so a coordinator stopping a just-built engine —
+    serve.py's multi-worker Ctrl-C — raced and lost)."""
+    broker = InProcessBroker(num_partitions=1)
+    prod = broker.producer()
+    for i in range(10):
+        prod.produce("t", json.dumps({"text": "hello there"}).encode())
+    consumer = broker.consumer(["t"], "latch")
+    engine = StreamingClassifier(pipeline, consumer, broker.producer(), "out",
+                                 batch_size=4, max_wait=0.01)
+    engine.stop()
+    stats = engine.run(max_messages=10, idle_timeout=0.2)
+    assert stats.processed == 0
+    assert broker.messages("out") == []
+    # the messages are still there for a live engine
+    engine2 = StreamingClassifier(pipeline, broker.consumer(["t"], "latch2"),
+                                  broker.producer(), "out", batch_size=4,
+                                  max_wait=0.01)
+    assert engine2.run(max_messages=10, idle_timeout=0.2).processed == 10
