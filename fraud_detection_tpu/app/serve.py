@@ -65,6 +65,14 @@ def main(argv=None) -> int:
                          "disjoint partition subset (the reference's "
                          "--partitions 3 scale-out unit; docs/serving.md "
                          "'Horizontal scale-out')")
+    ap.add_argument("--explain", default="off", metavar="SPEC",
+                    help="attach LLM analyses to flagged messages, batched "
+                         "per micro-batch: 'off' | 'canned' (offline stub) | "
+                         "'onpod:<hf checkpoint dir>' (zero-egress, "
+                         "checkpoint/hf_convert.py) | 'deepseek' (env "
+                         "DEEPSEEK_API_KEY, the reference's backend)")
+    ap.add_argument("--explain-tokens", type=int, default=128,
+                    help="max new tokens per analysis (--explain)")
     args = ap.parse_args(argv)
 
     if args.kafka and args.demo:
@@ -75,6 +83,8 @@ def main(argv=None) -> int:
         raise SystemExit(f"--pipeline-depth must be >= 1, got {args.pipeline_depth}")
     if args.workers < 1:
         raise SystemExit(f"--workers must be >= 1, got {args.workers}")
+    if args.explain_tokens < 1:
+        raise SystemExit(f"--explain-tokens must be >= 1, got {args.explain_tokens}")
     if args.workers > 1 and args.max_messages is not None:
         # Per-worker message caps can't split a global cap meaningfully —
         # refuse BEFORE the expensive pipeline build, like every other
@@ -85,6 +95,36 @@ def main(argv=None) -> int:
 
     from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
     from fraud_detection_tpu.stream.kafka import kafka_available
+
+    explain_hook = None
+    if args.explain != "off":
+        from fraud_detection_tpu.explain import make_stream_explain_hook
+
+        temp = 0.0  # deterministic analyses unless the env says otherwise
+        if args.explain == "canned":
+            from fraud_detection_tpu.explain import CannedBackend
+
+            backend = CannedBackend(responses=[
+                "(offline analysis stub — run --explain onpod:<dir> or "
+                "--explain deepseek for a real model)"])
+        elif args.explain.startswith("onpod:"):
+            from fraud_detection_tpu.explain import OnPodBackend
+
+            backend = OnPodBackend.from_hf_checkpoint(args.explain[len("onpod:"):])
+        elif args.explain == "deepseek":
+            from fraud_detection_tpu.utils.config import LLMConfig
+
+            llm_cfg = LLMConfig.from_env()
+            if not llm_cfg.api_key:
+                raise SystemExit("--explain deepseek needs DEEPSEEK_API_KEY")
+            backend = llm_cfg.make_backend()
+            # LLM_TEMPERATURE rides the same env surface as the reference's
+            # agent; it must reach the hook, not die in the parsed config.
+            temp = llm_cfg.temperature
+        else:
+            raise SystemExit(f"unknown --explain spec {args.explain!r}")
+        explain_hook = make_stream_explain_hook(
+            backend, temperature=temp, max_tokens=args.explain_tokens)
 
     pipe = build_pipeline(args.model, args.batch_size)
 
@@ -118,7 +158,8 @@ def main(argv=None) -> int:
         c, p = make_clients()
         return StreamingClassifier(pipe, c, p, args.output_topic,
                                    batch_size=args.batch_size, max_wait=args.max_wait,
-                                   pipeline_depth=args.pipeline_depth)
+                                   pipeline_depth=args.pipeline_depth,
+                                   explain_batch_fn=explain_hook)
 
     print(f"serving: model={args.model} in={args.input_topic} out={args.output_topic} "
           f"batch={args.batch_size} workers={args.workers}", flush=True)

@@ -16,7 +16,7 @@ from fraud_detection_tpu.explain.backends import (
     OpenAIChatBackend,
 )
 from fraud_detection_tpu.explain.history import HistoricalCaseStore
-from fraud_detection_tpu.explain.onpod import OnPodBackend
+from fraud_detection_tpu.explain.onpod import OnPodBackend, make_stream_explain_hook
 from fraud_detection_tpu.explain.prompts import (
     analysis_prompt,
     historical_insight_prompt,
@@ -30,6 +30,7 @@ __all__ = [
     "LLMBackend",
     "OpenAIChatBackend",
     "OnPodBackend",
+    "make_stream_explain_hook",
     "HistoricalCaseStore",
     "analysis_prompt",
     "historical_insight_prompt",

@@ -79,3 +79,59 @@ class OnPodBackend(_GenerateMixin):
 
         lm = load_hf_checkpoint(ckpt_dir, max_seq=max_seq, mesh=mesh)
         return cls.from_model(lm, mesh=mesh)
+
+
+def make_stream_explain_hook(backend, *, temperature: float = 0.0,
+                             max_tokens: int = 128,
+                             only_scams: bool = True):
+    """Build a ``StreamingClassifier.explain_batch_fn`` from any backend
+    with ``generate_batch`` (OnPodBackend, or a canned/test double).
+
+    One backend call per micro-batch covers every row selected for
+    explanation (default: predicted scams only — the reference's agent
+    explains flagged dialogues, utils/agent_api.py:129-170, and spending
+    decode budget on benign calls would throttle the stream for nothing).
+    Backends without ``generate_batch`` (the HTTP clients, CannedBackend)
+    fall back to one ``generate`` per selected row — still hook-shaped, just
+    without the single-device-program amortization. Unselected rows get
+    ``None`` so their output frames carry no "analysis" field. Row alignment
+    is positional and length-checked by the engine.
+    """
+    from fraud_detection_tpu.explain.prompts import analysis_prompt
+    from fraud_detection_tpu.utils import get_logger
+
+    log = get_logger("explain.hook")
+    gen_batch = getattr(backend, "generate_batch", None)
+
+    def explain_batch(texts, labels, confs):
+        picked = [i for i, lab in enumerate(labels)
+                  if (lab == 1 or not only_scams)]
+        out = [None] * len(texts)
+        if picked:
+            prompts = [analysis_prompt(texts[i], labels[i], confs[i])
+                       for i in picked]
+            try:
+                if gen_batch is not None:
+                    replies = gen_batch(prompts, temperature=temperature,
+                                        max_tokens=max_tokens)
+                else:
+                    replies = [backend.generate(p, temperature=temperature,
+                                                max_tokens=max_tokens)
+                               for p in prompts]
+            except Exception as e:  # noqa: BLE001 — annotation, not pipeline
+                # Degraded mode: a rate-limited/unreachable backend must not
+                # halt CLASSIFICATION — messages go out unannotated and the
+                # incident is logged (the reference's agent likewise returns
+                # an error string instead of raising, agent_api.py:57-63).
+                log.warning("explanation backend failed for a %d-row batch: %r",
+                            len(picked), e)
+                return out
+            if len(replies) != len(picked):  # zip would silently drop rows
+                raise ValueError(
+                    f"backend returned {len(replies)} analyses for "
+                    f"{len(picked)} prompts")
+            for i, reply in zip(picked, replies):
+                out[i] = reply
+        return out
+
+    return explain_batch

@@ -265,3 +265,54 @@ def test_onpod_generate_batch_matches_per_prompt():
 
     no_batch = OnPodBackend(backend.generate_fn)
     assert list(no_batch.generate_batch(prompts, max_tokens=8)) == singles
+
+
+def test_make_stream_explain_hook_selection_and_fallback():
+    """The hook explains flagged rows only by default, keeps positional
+    alignment, uses generate_batch when the backend has it, and falls back
+    to per-prompt generate otherwise (HTTP clients, CannedBackend)."""
+    from fraud_detection_tpu.explain import CannedBackend, make_stream_explain_hook
+
+    canned = CannedBackend(responses=["analysis A", "analysis B"])
+    hook = make_stream_explain_hook(canned, max_tokens=17)
+    out = hook(["scam one", "benign", "scam two"], [1, 0, 1], [0.9, 0.1, 0.8])
+    assert out[1] is None and out[0] == "analysis A" and out[2] == "analysis B"
+    assert all(c["max_tokens"] == 17 for c in canned.calls)
+    assert "scam one" in canned.calls[0]["messages"][-1]["content"]
+
+    class BatchBackend:
+        def __init__(self):
+            self.batches = []
+
+        def generate_batch(self, prompts, *, temperature, max_tokens):
+            self.batches.append(list(prompts))
+            return [f"r{i}" for i in range(len(prompts))]
+
+    bb = BatchBackend()
+    hook_b = make_stream_explain_hook(bb, only_scams=False)
+    out = hook_b(["a", "b"], [0, 1], [0.2, 0.9])
+    assert out == ["r0", "r1"]
+    assert len(bb.batches) == 1 and len(bb.batches[0]) == 2  # ONE batched call
+
+
+def test_stream_explain_hook_degrades_on_backend_failure():
+    """A failing backend (rate limit, network) yields unannotated messages,
+    not a dead stream (round-3 review: one 429 would otherwise abort the
+    engine run); a misaligned reply count still raises loudly."""
+    from fraud_detection_tpu.explain import make_stream_explain_hook
+
+    class Failing:
+        def generate_batch(self, prompts, *, temperature, max_tokens):
+            raise ConnectionError("rate limited")
+
+    hook = make_stream_explain_hook(Failing())
+    assert hook(["scam text"], [1], [0.9]) == [None]
+
+    class Short:
+        def generate_batch(self, prompts, *, temperature, max_tokens):
+            return ["only one"]
+
+    import pytest as _pytest
+    hook2 = make_stream_explain_hook(Short())
+    with _pytest.raises(ValueError, match="analyses for 2 prompts"):
+        hook2(["scam a", "scam b"], [1, 1], [0.9, 0.8])

@@ -76,3 +76,44 @@ def test_worker_failure_exits_nonzero(artifact_spec, capsys, monkeypatch):
     assert rc == 1
     err = capsys.readouterr().err
     assert "worker(s) failed" in err and "broker gone" in err
+
+
+def test_demo_with_canned_explanations(artifact_spec, capsys):
+    """--explain canned attaches an analysis to every flagged (scam) output
+    and leaves benign ones untouched — the CLI surface of the engine's
+    batched-explanation seam."""
+    import json as j
+
+    # capture the broker the CLI builds so the output topic can be inspected
+    built = {}
+    from fraud_detection_tpu.stream import InProcessBroker
+
+    class SpyBroker(InProcessBroker):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            built["broker"] = self
+
+    import fraud_detection_tpu.stream as stream_pkg
+    old = stream_pkg.InProcessBroker
+    stream_pkg.InProcessBroker = SpyBroker
+    try:
+        rc = serve_main(["--model", artifact_spec, "--demo", "120",
+                         "--batch-size", "32", "--max-wait", "0.01",
+                         "--explain", "canned", "--explain-tokens", "32"])
+    finally:
+        stream_pkg.InProcessBroker = old
+    assert rc == 0
+    outs = [j.loads(m.value) for m in built["broker"].messages("dialogues-classified")]
+    assert len(outs) == 120
+    flagged = [o for o in outs if o["prediction"] == 1]
+    benign = [o for o in outs if o["prediction"] == 0]
+    assert flagged and benign
+    assert all("analysis" in o and "offline analysis stub" in o["analysis"]
+               for o in flagged)
+    assert all("analysis" not in o for o in benign)
+
+
+def test_explain_spec_validation():
+    with pytest.raises(SystemExit, match="unknown --explain"):
+        serve_main(["--model", "synthetic", "--demo", "10",
+                    "--explain", "bogus"])
