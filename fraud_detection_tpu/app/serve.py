@@ -100,7 +100,10 @@ def main(argv=None) -> int:
     if args.explain != "off":
         from fraud_detection_tpu.explain import make_stream_explain_hook
 
-        temp = 0.0  # deterministic analyses unless the env says otherwise
+        # LLM_TEMPERATURE is the reference's env surface for analysis
+        # sampling; honor it for EVERY backend, defaulting to deterministic
+        # greedy decoding when unset.
+        temp = float(os.getenv("LLM_TEMPERATURE", "0.0"))
         if args.explain == "canned":
             from fraud_detection_tpu.explain import CannedBackend
 
@@ -118,9 +121,6 @@ def main(argv=None) -> int:
             if not llm_cfg.api_key:
                 raise SystemExit("--explain deepseek needs DEEPSEEK_API_KEY")
             backend = llm_cfg.make_backend()
-            # LLM_TEMPERATURE rides the same env surface as the reference's
-            # agent; it must reach the hook, not die in the parsed config.
-            temp = llm_cfg.temperature
         else:
             raise SystemExit(f"unknown --explain spec {args.explain!r}")
         explain_hook = make_stream_explain_hook(

@@ -51,10 +51,24 @@ class OnPodBackend(_GenerateMixin):
                        temperature: float = 0.0,
                        max_tokens: int = 256) -> Sequence[str]:
         """Explain many dialogues per device round trip (uneven prompt
-        lengths batched via models/llm.py ``generate_text_batch``)."""
+        lengths batched via models/llm.py ``generate_text_batch``).
+
+        Framing parity with ``generate``: each prompt gets the same
+        system-instruction + chat template the single path applies
+        (``_GenerateMixin.generate`` -> ``chat`` -> ``flatten_chat``) — an
+        instruction-tuned checkpoint must see identical inputs whether a
+        batch or a single call produced them (round-3 review finding)."""
+        framed = [flatten_chat(self._frame(p)) for p in prompts]
         if self.generate_batch_fn is not None:
-            return self.generate_batch_fn(list(prompts), temperature, max_tokens)
-        return [self.generate_fn(p, temperature, max_tokens) for p in prompts]
+            return self.generate_batch_fn(framed, temperature, max_tokens)
+        return [self.generate_fn(p, temperature, max_tokens) for p in framed]
+
+    @staticmethod
+    def _frame(prompt: str) -> Sequence[ChatMessage]:
+        from fraud_detection_tpu.explain.backends import DEFAULT_SYSTEM_PROMPT
+
+        return [{"role": "system", "content": DEFAULT_SYSTEM_PROMPT},
+                {"role": "user", "content": prompt}]
 
     @classmethod
     def from_model(cls, lm, *, mesh=None) -> "OnPodBackend":
@@ -64,6 +78,7 @@ class OnPodBackend(_GenerateMixin):
                                     max_new_tokens=max_tokens, mesh=mesh)
 
         def generate_batch_fn(prompts, temperature: float, max_tokens: int):
+            # prompts arrive PRE-FRAMED by generate_batch
             return lm.generate_text_batch(prompts, temperature=temperature,
                                           max_new_tokens=max_tokens)
 
@@ -110,28 +125,35 @@ def make_stream_explain_hook(backend, *, temperature: float = 0.0,
         if picked:
             prompts = [analysis_prompt(texts[i], labels[i], confs[i])
                        for i in picked]
-            try:
-                if gen_batch is not None:
+            # Degraded mode everywhere below: a rate-limited/unreachable
+            # backend must not halt CLASSIFICATION — messages go out
+            # unannotated and the incident is logged (the reference's agent
+            # likewise returns an error string instead of raising,
+            # agent_api.py:57-63).
+            if gen_batch is not None:
+                try:
                     replies = gen_batch(prompts, temperature=temperature,
                                         max_tokens=max_tokens)
-                else:
-                    replies = [backend.generate(p, temperature=temperature,
-                                                max_tokens=max_tokens)
-                               for p in prompts]
-            except Exception as e:  # noqa: BLE001 — annotation, not pipeline
-                # Degraded mode: a rate-limited/unreachable backend must not
-                # halt CLASSIFICATION — messages go out unannotated and the
-                # incident is logged (the reference's agent likewise returns
-                # an error string instead of raising, agent_api.py:57-63).
-                log.warning("explanation backend failed for a %d-row batch: %r",
-                            len(picked), e)
-                return out
-            if len(replies) != len(picked):  # zip would silently drop rows
-                raise ValueError(
-                    f"backend returned {len(replies)} analyses for "
-                    f"{len(picked)} prompts")
-            for i, reply in zip(picked, replies):
-                out[i] = reply
+                except Exception as e:  # noqa: BLE001 — annotation only
+                    log.warning("explanation backend failed for a %d-row "
+                                "batch: %r", len(picked), e)
+                    return out
+                if len(replies) != len(picked):  # zip would silently drop rows
+                    raise ValueError(
+                        f"backend returned {len(replies)} analyses for "
+                        f"{len(picked)} prompts")
+                for i, reply in zip(picked, replies):
+                    out[i] = reply
+            else:
+                # Per-row containment: one failed HTTPS call must not throw
+                # away the analyses already paid for in this batch.
+                for i, prompt in zip(picked, prompts):
+                    try:
+                        out[i] = backend.generate(prompt,
+                                                  temperature=temperature,
+                                                  max_tokens=max_tokens)
+                    except Exception as e:  # noqa: BLE001 — annotation only
+                        log.warning("explanation backend failed for row: %r", e)
         return out
 
     return explain_batch

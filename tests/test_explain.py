@@ -260,7 +260,10 @@ def test_onpod_generate_batch_matches_per_prompt():
     backend = OnPodBackend.from_model(lm)
     prompts = ["short one", "a noticeably longer prompt about a scam call"]
     batched = backend.generate_batch(prompts, max_tokens=8)
-    singles = [lm.generate_text(p, max_new_tokens=8) for p in prompts]
+    # The invariant includes FRAMING: the batch path must see the same
+    # system-instruction + chat template as the single generate() path.
+    singles = [backend.generate(p, temperature=0.0, max_tokens=8)
+               for p in prompts]
     assert list(batched) == singles
 
     no_batch = OnPodBackend(backend.generate_fn)
@@ -316,3 +319,23 @@ def test_stream_explain_hook_degrades_on_backend_failure():
     hook2 = make_stream_explain_hook(Short())
     with _pytest.raises(ValueError, match="analyses for 2 prompts"):
         hook2(["scam a", "scam b"], [1, 1], [0.9, 0.8])
+
+
+def test_stream_explain_hook_keeps_partial_results_per_row():
+    """On the per-prompt fallback path, one failing call must not discard
+    the analyses already produced for earlier rows in the batch."""
+    from fraud_detection_tpu.explain import make_stream_explain_hook
+
+    class FlakyGenerate:
+        def __init__(self):
+            self.n = 0
+
+        def generate(self, prompt, *, temperature, max_tokens):
+            self.n += 1
+            if self.n == 2:
+                raise ConnectionError("one bad call")
+            return f"ok{self.n}"
+
+    hook = make_stream_explain_hook(FlakyGenerate())
+    out = hook(["scam a", "scam b", "scam c"], [1, 1, 1], [0.9, 0.9, 0.9])
+    assert out == ["ok1", None, "ok3"]

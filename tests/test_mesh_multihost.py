@@ -217,3 +217,85 @@ def test_two_process_tree_training_parity(tmp_path):
     single_acc = float((np.asarray(tree_predict(ens, X)[0]) == y).mean())
     dist_acc = float(results[0][3])
     assert abs(dist_acc - single_acc) < 0.01, (dist_acc, single_acc)
+
+
+_LLM_TP_CHILD = '''
+import os, sys, hashlib
+sys.path.insert(0, "{repo}")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from fraud_detection_tpu.parallel.mesh import initialize_distributed
+assert initialize_distributed()
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from fraud_detection_tpu.models.llm import (MODEL_AXIS, TransformerConfig,
+                                            forward, init_params, shard_params)
+cfg = TransformerConfig(d_model=32, n_heads=8, n_layers=2, d_ff=64, max_seq=64)
+params = init_params(jax.random.PRNGKey(0), cfg)
+mesh = Mesh(np.array(jax.devices()).reshape(8), (MODEL_AXIS,))
+sp = shard_params(params, cfg, mesh)           # params split ACROSS PROCESSES
+toks = np.arange(16, dtype=np.int32)[None, :] % 250
+toks_d = jax.device_put(toks, NamedSharding(mesh, P()))
+logits = jax.jit(lambda p, t: forward(p, t, cfg)[0])(sp, toks_d)
+local = np.concatenate([np.asarray(s.data) for s in logits.addressable_shards], axis=0)
+digest = hashlib.sha256(np.ascontiguousarray(local).tobytes()).hexdigest()
+sample = " ".join("%.4f" % v for v in np.asarray(local)[0, -1, :5])
+print("RESULT", os.environ["JAX_PROCESS_ID"], digest, "|", sample, flush=True)
+'''
+
+
+def test_two_process_llm_tensor_parallel_forward():
+    """The on-pod LLM's tensor parallelism crosses the PROCESS boundary: two
+    jax.distributed processes hold disjoint halves of the model-axis-sharded
+    params (4 local devices each of a global 8-device mesh), run one jitted
+    forward whose head/ffw contractions reduce over gloo, and must see the
+    SAME replicated logits — the multi-host analogue of the dryrun's tp leg
+    (SURVEY.md SS2.4 comm backend; the reference's NCCL/MPI role)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _LLM_TP_CHILD.format(repo=repo)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            p.kill()
+    results = []
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+        results.append([ln for ln in out.splitlines()
+                        if ln.startswith("RESULT")][0])
+    # identical replicated logits on both ranks (digest covers every value)
+    assert results[0].split()[2:] == results[1].split()[2:], results
+
+    # semantic parity with a single-process forward on this process's mesh
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fraud_detection_tpu.models.llm import (MODEL_AXIS, TransformerConfig,
+                                                forward, init_params,
+                                                shard_params)
+
+    cfg = TransformerConfig(d_model=32, n_heads=8, n_layers=2, d_ff=64,
+                            max_seq=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = Mesh(np.array(jax.devices()[:8]), (MODEL_AXIS,))
+    toks = jnp.asarray(np.arange(16, dtype=np.int32)[None, :] % 250)
+    logits = jax.jit(lambda p, t: forward(p, t, cfg)[0])(
+        shard_params(params, cfg, mesh), toks)
+    want = [float(v) for v in np.asarray(logits)[0, -1, :5]]
+    got = [float(x) for x in results[0].split("|")[1].split()]
+    np.testing.assert_allclose(got, want, atol=5e-3)
