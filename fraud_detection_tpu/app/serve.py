@@ -99,11 +99,21 @@ def main(argv=None) -> int:
     explain_hook = None
     if args.explain != "off":
         from fraud_detection_tpu.explain import make_stream_explain_hook
+        from fraud_detection_tpu.utils.config import LLMConfig
 
-        # LLM_TEMPERATURE is the reference's env surface for analysis
-        # sampling; honor it for EVERY backend, defaulting to deterministic
-        # greedy decoding when unset.
-        temp = float(os.getenv("LLM_TEMPERATURE", "0.0"))
+        # LLM_* parses in ONE place (LLMConfig.from_env); malformed values
+        # fail like every other config error, not with a raw traceback.
+        try:
+            llm_cfg = LLMConfig.from_env()
+        except ValueError as e:
+            raise SystemExit(f"bad LLM_* environment value: {e}")
+        # Temperature: an explicit LLM_TEMPERATURE wins for every backend;
+        # unset, deepseek keeps the reference agent's 1.0 default
+        # (utils/agent_api.py semantics) while local backends default to
+        # deterministic greedy analyses.
+        temp = (llm_cfg.temperature
+                if args.explain == "deepseek" or "LLM_TEMPERATURE" in os.environ
+                else 0.0)
         if args.explain == "canned":
             from fraud_detection_tpu.explain import CannedBackend
 
@@ -115,9 +125,6 @@ def main(argv=None) -> int:
 
             backend = OnPodBackend.from_hf_checkpoint(args.explain[len("onpod:"):])
         elif args.explain == "deepseek":
-            from fraud_detection_tpu.utils.config import LLMConfig
-
-            llm_cfg = LLMConfig.from_env()
             if not llm_cfg.api_key:
                 raise SystemExit("--explain deepseek needs DEEPSEEK_API_KEY")
             backend = llm_cfg.make_backend()

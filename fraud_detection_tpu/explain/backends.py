@@ -46,15 +46,21 @@ class BackendError(RuntimeError):
     """Raised when a backend exhausts retries or gets a malformed response."""
 
 
+def frame_prompt(prompt: str, system: Optional[str] = None) -> List[ChatMessage]:
+    """THE message assembly for a bare prompt — single and batched paths
+    share it so their framed inputs cannot drift apart (the parity
+    OnPodBackend.generate_batch documents)."""
+    return [{"role": "system",
+             "content": system if system is not None else DEFAULT_SYSTEM_PROMPT},
+            {"role": "user", "content": prompt}]
+
+
 @dataclass
 class _GenerateMixin:
     def generate(self, prompt: str, *, temperature: float = 1.0,
                  max_tokens: int = 1000, system: Optional[str] = None) -> str:
-        messages: List[ChatMessage] = []
-        messages.append({"role": "system",
-                         "content": system if system is not None else DEFAULT_SYSTEM_PROMPT})
-        messages.append({"role": "user", "content": prompt})
-        return self.chat(messages, temperature=temperature, max_tokens=max_tokens)
+        return self.chat(frame_prompt(prompt, system),
+                         temperature=temperature, max_tokens=max_tokens)
 
 
 @dataclass

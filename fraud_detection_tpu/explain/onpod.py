@@ -58,17 +58,12 @@ class OnPodBackend(_GenerateMixin):
         (``_GenerateMixin.generate`` -> ``chat`` -> ``flatten_chat``) — an
         instruction-tuned checkpoint must see identical inputs whether a
         batch or a single call produced them (round-3 review finding)."""
-        framed = [flatten_chat(self._frame(p)) for p in prompts]
+        from fraud_detection_tpu.explain.backends import frame_prompt
+
+        framed = [flatten_chat(frame_prompt(p)) for p in prompts]
         if self.generate_batch_fn is not None:
             return self.generate_batch_fn(framed, temperature, max_tokens)
         return [self.generate_fn(p, temperature, max_tokens) for p in framed]
-
-    @staticmethod
-    def _frame(prompt: str) -> Sequence[ChatMessage]:
-        from fraud_detection_tpu.explain.backends import DEFAULT_SYSTEM_PROMPT
-
-        return [{"role": "system", "content": DEFAULT_SYSTEM_PROMPT},
-                {"role": "user", "content": prompt}]
 
     @classmethod
     def from_model(cls, lm, *, mesh=None) -> "OnPodBackend":

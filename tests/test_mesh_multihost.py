@@ -84,6 +84,44 @@ def test_global_batch_from_local_single_process(ndim):
     assert shard_rows_count == {n // mesh.shape[DATA_AXIS]}
 
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_two_process(child_src: str, timeout: float = 240):
+    """Launch ``child_src`` as TWO jax.distributed processes (4 CPU devices
+    each, one rendezvous port) and return each process's RESULT line. The
+    ONE copy of the subprocess scaffold — port allocation, env assembly,
+    communicate/kill teardown — which had grown to four verbatim copies
+    (round-3 review)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", child_src], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            p.kill()
+    lines = []
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+        lines.append([ln for ln in out.splitlines()
+                      if ln.startswith("RESULT")][0])
+    return lines
+
+
 _CHILD = r'''
 import os, sys
 sys.path.insert(0, {repo!r})
@@ -108,31 +146,7 @@ def test_two_process_rendezvous_and_global_batch(tmp_path):
     """Real jax.distributed: 2 processes x 4 CPU devices -> one 8-device
     mesh; per-process rows assemble into the global batch and a jitted
     cross-process reduction sees all of them."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.update(JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid))
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _CHILD.format(repo=os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))))],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            p.kill()
-    for rc, out, err in outs:
-        assert rc == 0, err[-2000:]
-        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][0]
+    for line in _run_two_process(_CHILD.format(repo=_REPO)):
         # 8-device data mesh; sum = 4 rows * 3 cols * pid summed over pids
         assert "'data': 8" in line and "12.0" in line and "(8, 3)" in line
 
@@ -171,33 +185,8 @@ def test_two_process_tree_training_parity(tmp_path):
     the DCN leg of SURVEY.md SS2.4). Both processes must produce the SAME
     tree bit-for-bit, and its predictions must agree with a single-process
     fit of the same data."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.update(JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid))
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _TRAIN_CHILD.format(repo=repo)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=300)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            p.kill()
-    results = []
-    for rc, out, err in outs:
-        assert rc == 0, err[-2000:]
-        results.append([ln for ln in out.splitlines()
-                        if ln.startswith("RESULT")][0].split())
+    results = [line.split() for line in
+               _run_two_process(_TRAIN_CHILD.format(repo=_REPO), timeout=300)]
     # Same tree bit-for-bit on BOTH processes (replicated outputs — this is
     # the hard guarantee: each process ran the same global computation).
     assert results[0][2:] == results[1][2:], results
@@ -251,33 +240,7 @@ def test_two_process_llm_tensor_parallel_forward():
     forward whose head/ffw contractions reduce over gloo, and must see the
     SAME replicated logits — the multi-host analogue of the dryrun's tp leg
     (SURVEY.md SS2.4 comm backend; the reference's NCCL/MPI role)."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.update(JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid))
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _LLM_TP_CHILD.format(repo=repo)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            p.kill()
-    results = []
-    for rc, out, err in outs:
-        assert rc == 0, err[-2000:]
-        results.append([ln for ln in out.splitlines()
-                        if ln.startswith("RESULT")][0])
+    results = _run_two_process(_LLM_TP_CHILD.format(repo=_REPO))
     # identical replicated logits on both ranks (digest covers every value)
     assert results[0].split()[2:] == results[1].split()[2:], results
 
@@ -299,3 +262,57 @@ def test_two_process_llm_tensor_parallel_forward():
     want = [float(v) for v in np.asarray(logits)[0, -1, :5]]
     got = [float(x) for x in results[0].split("|")[1].split()]
     np.testing.assert_allclose(got, want, atol=5e-3)
+
+
+_LLM_SP_CHILD = '''
+import os, sys
+sys.path.insert(0, "{repo}")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from fraud_detection_tpu.parallel.mesh import initialize_distributed
+assert initialize_distributed()
+from jax.sharding import Mesh
+from fraud_detection_tpu.models.llm import SEQ_AXIS, TransformerConfig, forward, init_params
+cfg = TransformerConfig(d_model=32, n_heads=2, n_layers=2, d_ff=64, max_seq=64)
+params = init_params(jax.random.PRNGKey(0), cfg)
+mesh = Mesh(np.array(jax.devices()).reshape(8), (SEQ_AXIS,))
+toks = (np.arange(32, dtype=np.int32)[None, :] * 7) % 250
+logits, _ = forward(params, toks, cfg, seq_mesh=mesh)
+shards = sorted(logits.addressable_shards, key=lambda s: s.index[1].start)
+local = np.concatenate([np.asarray(s.data) for s in shards], axis=1)
+start = shards[0].index[1].start
+sample = " ".join("%.4f" % v for v in local[0, -1, :5])
+print("RESULT", os.environ["JAX_PROCESS_ID"], start, local.shape[1], "|",
+      sample, flush=True)
+'''
+
+
+def test_two_process_llm_ring_attention_forward():
+    """Ring-attention sequence parallelism ALSO crosses the process
+    boundary: the K/V ppermute rotation rides gloo between two processes,
+    each holding half the sequence. Every rank's local logit slice must
+    match the corresponding positions of a single-process forward — exact
+    causal attention, distributed over hosts (the long-transcript layout at
+    multi-host scale)."""
+    got = {}
+    for line in _run_two_process(_LLM_SP_CHILD.format(repo=_REPO)):
+        head, sample = line.split("|")
+        _, pid, start, n_local = head.split()
+        got[int(start)] = (int(n_local), [float(x) for x in sample.split()])
+    # the two ranks hold disjoint halves covering the sequence
+    assert sorted(got) == [0, 16] and all(n == 16 for n, _ in got.values())
+
+    # single-process reference: rank r's last local position is 15 / 31
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.models.llm import TransformerConfig, forward, init_params
+
+    cfg = TransformerConfig(d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                            max_seq=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray((np.arange(32, dtype=np.int32)[None, :] * 7) % 250)
+    ref = np.asarray(forward(params, toks, cfg)[0])
+    for start, (n_local, sample) in got.items():
+        np.testing.assert_allclose(sample, ref[0, start + n_local - 1, :5],
+                                   atol=5e-3)
