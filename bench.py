@@ -371,6 +371,14 @@ def _gemma2b_synthetic_dir() -> str:
     return cache
 
 
+def _tree_bytes(params) -> int:
+    """Total leaf bytes of a param pytree (handles Q8's int8+scale leaves)."""
+    import jax
+
+    return int(sum(np.prod(l.shape) * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(params)))
+
+
 def _llm_flops_per_token(cfg) -> float:
     """Matmul FLOPs per token (2 MACs per weight element): qkvo + gated mlp
     per layer, plus the d_model x vocab output head. Embedding lookup is a
@@ -428,8 +436,7 @@ def llm_bench() -> dict:
             meta["fallback_from_gemma2b"] = fallback_err
 
     n_params = int(sum(np.prod(x.shape) for x in model.params.values()))
-    param_bytes = int(sum(np.prod(x.shape) * x.dtype.itemsize
-                          for x in model.params.values()))
+    param_bytes = _tree_bytes(model.params)
     flops_tok = _llm_flops_per_token(cfg)
     meta.update({"params": n_params, "n_layers": cfg.n_layers,
                  "d_model": cfg.d_model, "vocab": cfg.vocab_size,
@@ -554,6 +561,32 @@ def llm_bench() -> dict:
     backend = OnPodBackend.from_model(model)
     replies = backend.generate_batch(prompts[:2], temperature=0.0, max_tokens=8)
     assert len(replies) == 2          # the explain seam stays wired
+
+    # int8 weight-only decode (models/llm.py quantize_params): decode is
+    # weight-streaming bound, so halving the bytes moves tokens/sec — the
+    # convert+scale fuses into each dot's operand load. Measured on the 2B
+    # model: 111 -> 182 tok/s single stream, 14.2 -> 21.2 explanations/sec
+    # at B=8. BENCH_LLM_Q8=0 skips (the quantize + recompile adds ~2 min).
+    if os.environ.get("BENCH_LLM_Q8", "1") != "0" and scale == "gemma2b":
+        qmodel = model.quantized()
+        jax.block_until_ready(qmodel.params)
+        q_bytes = _tree_bytes(qmodel.params)
+        qmodel.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
+        t0 = time.perf_counter()
+        out_q = qmodel.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
+        qdt = time.perf_counter() - t0
+        emitted_q = _emitted(out_q)
+        line["decode_int8_tok_per_s"] = round(emitted_q / qdt, 1)
+        if hbm_peak:
+            line["decode_int8_pct_hbm_peak"] = round(
+                100 * q_bytes * emitted_q / qdt / hbm_peak, 1)
+        qmodel.generate_tokens_batch(tok_prompts, max_new_tokens=n_new)
+        t0 = time.perf_counter()
+        out_qb = qmodel.generate_tokens_batch(tok_prompts, max_new_tokens=n_new)
+        qbdt = time.perf_counter() - t0
+        line["batch_decode_int8_tok_per_s"] = round(
+            sum(_emitted(r) for r in np.asarray(out_qb)) / qbdt, 1)
+        line["explanations_int8_per_s"] = round(B / qbdt, 2)
     return line
 
 
