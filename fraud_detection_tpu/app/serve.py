@@ -69,8 +69,10 @@ def main(argv=None) -> int:
                     help="attach LLM analyses to flagged messages, batched "
                          "per micro-batch: 'off' | 'canned' (offline stub) | "
                          "'onpod:<hf checkpoint dir>' (zero-egress, "
-                         "checkpoint/hf_convert.py) | 'deepseek' (env "
-                         "DEEPSEEK_API_KEY, the reference's backend)")
+                         "checkpoint/hf_convert.py; 'onpod-int8:<dir>' adds "
+                         "weight-only int8 — ~1.5x explanations/sec) | "
+                         "'deepseek' (env DEEPSEEK_API_KEY, the reference's "
+                         "backend)")
     ap.add_argument("--explain-tokens", type=int, default=128,
                     help="max new tokens per analysis (--explain)")
     args = ap.parse_args(argv)
@@ -120,10 +122,12 @@ def main(argv=None) -> int:
             backend = CannedBackend(responses=[
                 "(offline analysis stub — run --explain onpod:<dir> or "
                 "--explain deepseek for a real model)"])
-        elif args.explain.startswith("onpod:"):
+        elif args.explain.startswith(("onpod:", "onpod-int8:")):
             from fraud_detection_tpu.explain import OnPodBackend
 
-            backend = OnPodBackend.from_hf_checkpoint(args.explain[len("onpod:"):])
+            spec, _, ckpt = args.explain.partition(":")
+            backend = OnPodBackend.from_hf_checkpoint(
+                ckpt, int8=spec == "onpod-int8")
         elif args.explain == "deepseek":
             if not llm_cfg.api_key:
                 raise SystemExit("--explain deepseek needs DEEPSEEK_API_KEY")

@@ -339,3 +339,33 @@ def test_stream_explain_hook_keeps_partial_results_per_row():
     hook = make_stream_explain_hook(FlakyGenerate())
     out = hook(["scam a", "scam b", "scam c"], [1, 1, 1], [0.9, 0.9, 0.9])
     assert out == ["ok1", None, "ok3"]
+
+
+def test_from_hf_checkpoint_int8(tmp_path):
+    """onpod int8 loading: quantized params behind the same backend API,
+    refusing the unimplemented int8+mesh combination."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+    try:
+        from convert_hf_checkpoint import make_synthetic_checkpoint
+    finally:
+        sys.path.pop(0)
+
+    from fraud_detection_tpu.explain import OnPodBackend
+
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    make_synthetic_checkpoint(d)
+    be = OnPodBackend.from_hf_checkpoint(d, int8=True, tokenizer="byte")
+    out = be.generate_batch(["why is this a scam?"], max_tokens=6)
+    assert len(out) == 1 and isinstance(out[0], str)
+
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    with pytest.raises(NotImplementedError, match="int8"):
+        OnPodBackend.from_hf_checkpoint(
+            d, int8=True, tokenizer="byte",
+            mesh=Mesh(np.array(jax.devices()[:2]), ("model",)))
