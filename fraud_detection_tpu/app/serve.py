@@ -219,6 +219,12 @@ def main(argv=None) -> int:
                         engine.consumer.close()
             except BaseException as e:  # noqa: BLE001 — surfaced via exit code
                 errors[i] = e
+                # Immediately, not at shutdown: with --kafka the survivors
+                # run indefinitely and a silent 1/N capacity loss would
+                # otherwise only surface at Ctrl-C.
+                print(f"worker {i} died: {e!r} (survivors keep their "
+                      f"partitions; exit code will be nonzero)",
+                      file=sys.stderr, flush=True)
 
         threads = [threading.Thread(target=run_worker, args=(i,), daemon=True)
                    for i in range(args.workers)]

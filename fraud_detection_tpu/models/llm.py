@@ -193,6 +193,17 @@ def quantize_params(params: Params, *, include_embed: bool = True) -> Params:
         if axes is None or (suffix in ("embed", "lm_head") and not include_embed):
             out[name] = w
             continue
+        sharding = getattr(w, "sharding", None)
+        if (sharding is not None and hasattr(sharding, "device_set")
+                and len(sharding.device_set) > 1
+                and not sharding.is_fully_replicated):
+            # Same refusal as shard_params, from the other direction:
+            # shard-then-quantize would produce Q8 leaves with unvalidated
+            # scale shardings (quantize FIRST, serve single-chip).
+            raise NotImplementedError(
+                f"{name} is sharded over {len(sharding.device_set)} devices; "
+                "int8 quantization of tensor-parallel params is not "
+                "implemented — quantize before sharding, on one chip")
         wf = jnp.asarray(w).astype(jnp.float32)
         absmax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
         scale = jnp.maximum(absmax, 1e-8) / 127.0

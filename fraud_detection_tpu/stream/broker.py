@@ -109,12 +109,16 @@ class _GroupState:
 class InProcessBroker:
     """Thread-safe partitioned topic store with Kafka-ish offset semantics."""
 
-    def __init__(self, num_partitions: int = 3, session_timeout: float = 30.0):
+    def __init__(self, num_partitions: int = 3, session_timeout: float = 300.0):
         self.num_partitions = num_partitions
-        # Members that neither polled nor closed within this window are
-        # evicted at the next group operation (zombie crash recovery); the
-        # supervised engine path closes consumers explicitly, so eviction is
-        # the backstop, not the common path.
+        # Members that neither polled nor committed within this window are
+        # evicted at the next group operation (zombie crash recovery). This
+        # models Kafka's max.poll.interval.ms (default 300s) rather than its
+        # heartbeat-thread session timeout: liveness here is poll/commit
+        # activity, and a worker legitimately goes quiet for a whole
+        # micro-batch of scoring + batched LLM explanations (tens of seconds
+        # at bench rates). The supervised engine path closes consumers
+        # explicitly, so eviction is the backstop, not the common path.
         self.session_timeout = session_timeout
         self._topics: Dict[str, List[List[Message]]] = {}
         # Group-durable committed offsets: (group, topic, partition) -> next
@@ -427,8 +431,18 @@ class InProcessConsumer:
         return dict(self._committed)
 
     def seek_to_committed(self) -> None:
-        """Simulate a restart: resume from the last committed offsets."""
-        self._position = dict(self._committed)
+        """Simulate a restart: resume every owned partition from the GROUP's
+        durable offsets. (Local ``_committed`` can never exceed these:
+        ``_write_through`` pushes each commit to the broker immediately and
+        fencing stops other members advancing an owned partition — so the
+        group map IS the committed truth, including for a fresh consumer
+        that committed nothing this session, which the old
+        ``dict(_committed)`` rewound to 0.)"""
+        with self._region, self.broker._lock:
+            self._refresh_locked()
+            offsets = self.broker._group_offsets
+            self._position = {key: offsets.get((self.group_id, *key), 0)
+                              for key in self._owned}
 
     def close(self) -> None:
         if not self._closed:

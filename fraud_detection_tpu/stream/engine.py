@@ -29,9 +29,13 @@ import numpy as np
 
 from fraud_detection_tpu.explain.prompts import label_name
 from fraud_detection_tpu.models.pipeline import ServingPipeline
-from fraud_detection_tpu.stream.broker import Consumer, Message, Producer
+from fraud_detection_tpu.stream.broker import (CommitFailedError, Consumer,
+                                               Message, Producer)
+from fraud_detection_tpu.utils import get_logger
 from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
 from fraud_detection_tpu.utils.tracing import Tracer
+
+log = get_logger("stream.engine")
 
 # Output wire-format fast path: fixed frame, %.6f confidence (same 6-decimal
 # precision as the dict path's round(confidence, 6)).
@@ -95,6 +99,7 @@ class StreamStats:
     malformed: int = 0
     batches: int = 0
     commits_skipped: int = 0  # producer didn't drain; offsets left uncommitted
+    rebalanced_commits: int = 0  # commit fenced by a group rebalance (routine)
     restarts: int = 0         # supervised engine rebuilds (run_supervised)
     elapsed: float = 0.0
     batch_latency_sum: float = 0.0
@@ -142,6 +147,7 @@ class StreamStats:
             "malformed": self.malformed,
             "batches": self.batches,
             "commits_skipped": self.commits_skipped,
+            "rebalanced_commits": self.rebalanced_commits,
             "restarts": self.restarts,
             "elapsed_sec": round(self.elapsed, 4),
             "msgs_per_sec": round(self.msgs_per_sec, 1),
@@ -455,7 +461,18 @@ class StreamingClassifier:
             self._flush_failed = True
             self._running = False
             return 0
-        self.consumer.commit_offsets(inflight.offsets)
+        try:
+            self.consumer.commit_offsets(inflight.offsets)
+        except CommitFailedError as e:
+            # The group rebalanced with this batch in flight: its outputs are
+            # already produced, the commit is fenced, and the partition's new
+            # owner will reprocess — standard Kafka at-least-once. This is a
+            # ROUTINE event for N workers in one group (every join/leave
+            # re-deals partitions), so the engine carries on polling under
+            # its refreshed assignment instead of dying; duplicated outputs
+            # are the documented delivery semantics, not a failure.
+            self.stats.rebalanced_commits += 1
+            log.info("commit fenced by rebalance (batch stays at-least-once): %s", e)
 
         # Active processing latency: dispatch-side host work + this finish
         # leg (device wait, produce, flush, commit). Excludes time the batch
@@ -652,6 +669,7 @@ def _merge_stats(total: StreamStats, part: StreamStats) -> None:
     total.malformed += part.malformed
     total.batches += part.batches
     total.commits_skipped += part.commits_skipped
+    total.rebalanced_commits += part.rebalanced_commits
     total.elapsed += part.elapsed
     # Sum/max merge exactly; the percentile reservoir merges by samples (an
     # incarnation that overflowed its reservoir contributes its subsample —

@@ -18,13 +18,39 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from fraud_detection_tpu.stream.broker import Message
+from fraud_detection_tpu.stream.broker import CommitFailedError, Message
 from fraud_detection_tpu.utils.config import KafkaConfig
 
 try:  # pragma: no cover - exercised only where the wheel exists
     import confluent_kafka as _ck
 except ImportError:  # pragma: no cover
     _ck = None
+
+# Rebalance-class commit failures must surface as the SAME CommitFailedError
+# the in-process broker raises — the engine treats that as a routine fenced
+# commit (keep polling under the refreshed assignment) while any other
+# commit error stays fatal. Without this translation the engine's
+# rebalance survival would work in tests and die against real Kafka.
+_REBALANCE_CODE_NAMES = ("ILLEGAL_GENERATION", "UNKNOWN_MEMBER_ID",
+                         "REBALANCE_IN_PROGRESS", "_STATE")
+
+
+def _rebalance_codes():
+    ke = getattr(_ck, "KafkaError", None)
+    return {getattr(ke, n) for n in _REBALANCE_CODE_NAMES
+            if ke is not None and hasattr(ke, n)}
+
+
+def _translate_commit_error(e: Exception) -> None:
+    """Raise CommitFailedError for fenced commits; re-raise anything else."""
+    kafka_exc = getattr(_ck, "KafkaException", None)
+    if kafka_exc is not None and isinstance(e, kafka_exc):
+        err = e.args[0] if e.args else None
+        code = err.code() if hasattr(err, "code") else None
+        if code in _rebalance_codes():
+            raise CommitFailedError(
+                f"commit fenced by group rebalance: {e}") from e
+    raise e
 
 
 def kafka_available() -> bool:
@@ -79,14 +105,20 @@ class KafkaConsumer:
                 for m in msgs if m is not None and not m.error()]
 
     def commit(self) -> None:
-        self._consumer.commit(asynchronous=False)
+        try:
+            self._consumer.commit(asynchronous=False)
+        except Exception as e:  # noqa: BLE001 — translated or re-raised
+            _translate_commit_error(e)
 
     def commit_offsets(self, offsets) -> None:
         """Commit explicit next-offsets per (topic, partition) — the pipelined
         engine's per-batch commit (see broker.Consumer.commit_offsets)."""
         tps = [_ck.TopicPartition(topic, part, off)
                for (topic, part), off in offsets.items()]
-        self._consumer.commit(offsets=tps, asynchronous=False)
+        try:
+            self._consumer.commit(offsets=tps, asynchronous=False)
+        except Exception as e:  # noqa: BLE001 — translated or re-raised
+            _translate_commit_error(e)
 
     def close(self) -> None:
         self._consumer.close()

@@ -85,12 +85,31 @@ class FakeTopicPartition:
         self.topic, self.partition, self.offset = topic, partition, offset
 
 
+class FakeKafkaError:
+    ILLEGAL_GENERATION = 22
+    UNKNOWN_MEMBER_ID = 25
+    REBALANCE_IN_PROGRESS = 27
+    _STATE = -172
+
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+class FakeKafkaException(Exception):
+    pass
+
+
 @pytest.fixture()
 def kafka_mod(monkeypatch):
     fake = types.ModuleType("confluent_kafka")
     fake.Consumer = FakeConsumer
     fake.Producer = FakeProducer
     fake.TopicPartition = FakeTopicPartition
+    fake.KafkaError = FakeKafkaError
+    fake.KafkaException = FakeKafkaException
     monkeypatch.setitem(sys.modules, "confluent_kafka", fake)
     import fraud_detection_tpu.stream.kafka as kmod
 
@@ -246,3 +265,30 @@ def test_engine_end_to_end_over_stubbed_kafka(kafka_mod):
     assert commits, "no offsets committed"
     tps = [tp for offsets, _ in commits for tp in offsets]
     assert {(tp.topic, tp.partition) for tp in tps} <= {("raw", 0), ("raw", 1), ("raw", 2)}
+
+
+def test_commit_rebalance_error_translates(kafka_mod):
+    """A fenced commit against real Kafka must raise the SAME
+    CommitFailedError the in-process broker uses — the engine treats that as
+    a routine rebalance (round-3 full-round review: without the translation,
+    rebalance survival worked in tests and died in production)."""
+    from fraud_detection_tpu.stream.broker import CommitFailedError
+
+    c = kafka_mod.KafkaConsumer(config=CFG)
+
+    def fenced(offsets=None, asynchronous=True):
+        raise FakeKafkaException(FakeKafkaError(FakeKafkaError.ILLEGAL_GENERATION))
+
+    c._consumer.commit = fenced
+    with pytest.raises(CommitFailedError, match="fenced"):
+        c.commit_offsets({("raw", 0): 5})
+    with pytest.raises(CommitFailedError, match="fenced"):
+        c.commit()
+
+    # non-rebalance commit errors stay fatal, untranslated
+    def broken(offsets=None, asynchronous=True):
+        raise FakeKafkaException(FakeKafkaError(99))
+
+    c._consumer.commit = broken
+    with pytest.raises(FakeKafkaException):
+        c.commit_offsets({("raw", 0): 5})

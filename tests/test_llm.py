@@ -376,3 +376,7 @@ def test_int8_weight_only_quantization(params):
     assert not isinstance(half.params["embed"], Q8)
     with pytest.raises(NotImplementedError, match="quantized"):
         shard_params(qlm.params, CFG, model_mesh(8))
+    # ...and from the other direction: shard-then-quantize refuses too
+    sharded = LanguageModel(CFG, shard_params(params, CFG, model_mesh(8)))
+    with pytest.raises(NotImplementedError, match="sharded"):
+        sharded.quantized()
