@@ -126,6 +126,11 @@ def main(argv=None) -> int:
             from fraud_detection_tpu.explain import OnPodBackend
 
             spec, _, ckpt = args.explain.partition(":")
+            if not ckpt or not os.path.isdir(ckpt):
+                # clean config error, like every other bad spec on this path
+                # (an EMPTY ckpt would even resolve to ./config.json)
+                raise SystemExit(
+                    f"--explain {spec}: checkpoint dir {ckpt!r} not found")
             backend = OnPodBackend.from_hf_checkpoint(
                 ckpt, int8=spec == "onpod-int8")
         elif args.explain == "deepseek":

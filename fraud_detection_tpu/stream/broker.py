@@ -400,8 +400,22 @@ class InProcessConsumer:
             # Refresh first: a rebalance prunes _position to owned partitions,
             # so this never advances group offsets for a partition whose new
             # owner is already authoritative.
+            before = dict(self._position)
             with self.broker._lock:
                 self._refresh_locked()
+            # Kafka parity with the adapter (round-3 full-round review): a
+            # commit whose uncommitted read-ahead was fenced away raises the
+            # same CommitFailedError real Kafka's commit() surfaces — silent
+            # success here while production raises is the test/prod
+            # divergence the error translation exists to eliminate.
+            lost = sorted(key for key, pos in before.items()
+                          if key not in self._owned
+                          and pos > self._committed.get(key, 0))
+            if lost:
+                raise CommitFailedError(
+                    f"group {self.group_id!r} rebalanced: member "
+                    f"{self.member_id!r} no longer owns {lost}; "
+                    "offsets stay uncommitted — the new owner reprocesses")
             self._committed.update(self._position)
             self._write_through()
 

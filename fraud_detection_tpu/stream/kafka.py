@@ -31,8 +31,11 @@ except ImportError:  # pragma: no cover
 # commit (keep polling under the refreshed assignment) while any other
 # commit error stays fatal. Without this translation the engine's
 # rebalance survival would work in tests and die against real Kafka.
+# Deliberately NOT included: _STATE ("Local: Erroneous state") — it also
+# covers fatal/terminal consumer states, and translating those would turn a
+# crash the supervisor handles into an endless uncommitted-offsets loop.
 _REBALANCE_CODE_NAMES = ("ILLEGAL_GENERATION", "UNKNOWN_MEMBER_ID",
-                         "REBALANCE_IN_PROGRESS", "_STATE")
+                         "REBALANCE_IN_PROGRESS")
 
 
 def _rebalance_codes():

@@ -305,3 +305,22 @@ def test_seek_to_committed_uses_group_offsets():
     assert c2.assignment() == [("in", 0)]
     c2.seek_to_committed()                        # "restart"
     assert c2.poll_batch(20, 0.1) == []           # group committed through 20
+
+
+def test_commit_raises_when_readahead_was_fenced():
+    """commit() (position-based) matches the Kafka adapter's semantics: if a
+    rebalance fenced away partitions this member had read ahead on without
+    committing, the commit raises instead of silently succeeding (round-3
+    full-round review: in-process silent-drop vs real-Kafka raise was a
+    test/prod divergence)."""
+    broker = InProcessBroker(num_partitions=2)
+    _feed(broker, 20)
+    a = broker.consumer(["in"], "g")
+    assert len(a.poll_batch(20, 0.5)) == 20       # read ahead on BOTH partitions
+    broker.consumer(["in"], "g")                  # B joins -> A loses one
+    with pytest.raises(CommitFailedError, match="no longer owns"):
+        a.commit()
+    # after acknowledging the rebalance (a poll refresh), commit succeeds for
+    # what A still owns
+    a.poll(0.01)
+    a.commit()

@@ -285,9 +285,12 @@ def test_commit_rebalance_error_translates(kafka_mod):
     with pytest.raises(CommitFailedError, match="fenced"):
         c.commit()
 
-    # non-rebalance commit errors stay fatal, untranslated
+    # non-rebalance commit errors stay fatal, untranslated — including
+    # _STATE, which also covers fatal local consumer states (translating it
+    # would loop forever on uncommitted offsets instead of crashing into
+    # the supervisor)
     def broken(offsets=None, asynchronous=True):
-        raise FakeKafkaException(FakeKafkaError(99))
+        raise FakeKafkaException(FakeKafkaError(FakeKafkaError._STATE))
 
     c._consumer.commit = broken
     with pytest.raises(FakeKafkaException):
