@@ -131,8 +131,14 @@ def main(argv=None) -> int:
                 # (an EMPTY ckpt would even resolve to ./config.json)
                 raise SystemExit(
                     f"--explain {spec}: checkpoint dir {ckpt!r} not found")
-            backend = OnPodBackend.from_hf_checkpoint(
-                ckpt, int8=spec == "onpod-int8")
+            try:
+                backend = OnPodBackend.from_hf_checkpoint(
+                    ckpt, int8=spec == "onpod-int8")
+            except (OSError, ValueError, KeyError, NotImplementedError) as e:
+                # A dir without config.json/safetensors/tokenizer is a config
+                # error, not a crash — under --supervise a raw traceback
+                # reads as a transient incarnation failure and burns restarts.
+                raise SystemExit(f"--explain {spec}: cannot load {ckpt!r}: {e}")
         elif args.explain == "deepseek":
             if not llm_cfg.api_key:
                 raise SystemExit("--explain deepseek needs DEEPSEEK_API_KEY")

@@ -130,8 +130,11 @@ def make_stream_explain_hook(backend, *, temperature: float = 0.0,
     gen_batch = getattr(backend, "generate_batch", None)
 
     def explain_batch(texts, labels, confs):
+        # "flagged" = any non-benign class: multiclass tree pipelines emit
+        # labels >= 2 (engine supports them; label_name falls back to the
+        # class id), and `lab == 1` would silently skip those rows.
         picked = [i for i, lab in enumerate(labels)
-                  if (lab == 1 or not only_scams)]
+                  if (lab != 0 or not only_scams)]
         out = [None] * len(texts)
         if picked:
             prompts = [analysis_prompt(texts[i], labels[i], confs[i])

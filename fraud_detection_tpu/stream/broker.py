@@ -217,7 +217,7 @@ class InProcessBroker:
     def _join_group(self, group_id: str, topics: Sequence[str]) -> str:
         with self._lock:
             group = self._groups.setdefault(group_id, _GroupState())
-            now = time.time()
+            now = time.monotonic()  # liveness: immune to wall-clock steps
             self._evict_expired_locked(group, now)
             member_id = f"{group_id}-{next(self._member_ids)}"
             group.members[member_id] = {"topics": tuple(topics), "seen": now,
@@ -248,7 +248,7 @@ class InProcessBroker:
         rejoins — Kafka's rejoin-after-session-expiry, minus the error
         round trip."""
         group = self._groups.setdefault(group_id, _GroupState())
-        now = time.time()
+        now = time.monotonic()  # liveness: immune to wall-clock steps
         member = group.members.get(member_id)
         if member is not None:
             member["seen"] = now
@@ -400,17 +400,23 @@ class InProcessConsumer:
             # Refresh first: a rebalance prunes _position to owned partitions,
             # so this never advances group offsets for a partition whose new
             # owner is already authoritative.
-            before = dict(self._position)
+            # BOTH maps must be snapshotted before the refresh: it prunes
+            # lost partitions from _committed too, so comparing post-refresh
+            # would read an already-committed watermark as 0 and raise
+            # spuriously for fully-committed read-ahead (fourth-pass review
+            # repro; commit_offsets always had the pre-refresh snapshot).
+            before_pos = dict(self._position)
+            before_committed = dict(self._committed)
             with self.broker._lock:
                 self._refresh_locked()
             # Kafka parity with the adapter (round-3 full-round review): a
-            # commit whose uncommitted read-ahead was fenced away raises the
+            # commit whose UNCOMMITTED read-ahead was fenced away raises the
             # same CommitFailedError real Kafka's commit() surfaces — silent
             # success here while production raises is the test/prod
             # divergence the error translation exists to eliminate.
-            lost = sorted(key for key, pos in before.items()
+            lost = sorted(key for key, pos in before_pos.items()
                           if key not in self._owned
-                          and pos > self._committed.get(key, 0))
+                          and pos > before_committed.get(key, 0))
             if lost:
                 raise CommitFailedError(
                     f"group {self.group_id!r} rebalanced: member "

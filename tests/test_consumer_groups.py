@@ -324,3 +324,22 @@ def test_commit_raises_when_readahead_was_fenced():
     # what A still owns
     a.poll(0.01)
     a.commit()
+
+
+def test_commit_succeeds_when_lost_readahead_was_committed():
+    """The fenced-commit raise is only for UNCOMMITTED read-ahead: losing a
+    partition whose progress was fully committed beforehand must commit
+    cleanly (fourth-pass review repro — comparing against the post-refresh
+    committed map read an already-committed watermark as 0 and raised
+    spuriously, aborting the still-owned partitions' progress too)."""
+    broker = InProcessBroker(num_partitions=2)
+    _feed(broker, 20)
+    a = broker.consumer(["in"], "g")
+    assert len(a.poll_batch(20, 0.5)) == 20
+    a.commit()                                    # everything durably committed
+    broker.consumer(["in"], "g")                  # B joins: A loses a partition
+    a.commit()                                    # nothing uncommitted: no raise
+    with broker._lock:
+        committed = {p: broker._group_offsets.get(("g", "in", p), 0)
+                     for p in range(2)}
+    assert sum(committed.values()) == 20          # group watermarks intact

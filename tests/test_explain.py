@@ -280,6 +280,10 @@ def test_make_stream_explain_hook_selection_and_fallback():
     hook = make_stream_explain_hook(canned, max_tokens=17)
     out = hook(["scam one", "benign", "scam two"], [1, 0, 1], [0.9, 0.1, 0.8])
     assert out[1] is None and out[0] == "analysis A" and out[2] == "analysis B"
+    # multiclass: any non-benign class counts as flagged (lab != 0)
+    multi = CannedBackend(responses=["mc"])
+    out_mc = make_stream_explain_hook(multi)(["a", "b"], [2, 0], [0.7, 0.3])
+    assert out_mc == ["mc", None]
     assert all(c["max_tokens"] == 17 for c in canned.calls)
     assert "scam one" in canned.calls[0]["messages"][-1]["content"]
 
