@@ -331,8 +331,16 @@ class InProcessConsumer:
                   else offsets.get((self.group_id, *key), 0))
             for key in owned}
         self._acquired = dict(acquired)
-        self._committed = {key: off for key, off in self._committed.items()
-                           if key in owned}
+        # Seed _committed to the group watermark wherever the position was
+        # seeded from it: "uncommitted read-ahead" must mean LOCAL
+        # consumption beyond the committed point — without the seed, a
+        # group-resumed position on a never-read partition looked like
+        # read-ahead and commit() raised spuriously after losing it
+        # (fifth-pass review repro).
+        self._committed = {
+            key: max(self._committed.get(key, 0),
+                     offsets.get((self.group_id, *key), 0))
+            for key in owned}
         self._owned = set(owned)
         self._generation = gen
 

@@ -513,6 +513,13 @@ class StreamingClassifier:
             # resetting _running/_flush_failed before its RaceError fired
             # would corrupt the active run's abort logic.
             self._running = True
+            if self._stopped:
+                # stop() raced between the latch check and the _running
+                # write (its _running=False just got overwritten) — honor
+                # it; _stopped is monotonic, so this re-check closes the
+                # window (fifth-pass review).
+                self._running = False
+                return self.stats
             self._flush_failed = False
             started = time.perf_counter()
             idle_since: Optional[float] = None

@@ -343,3 +343,21 @@ def test_commit_succeeds_when_lost_readahead_was_committed():
         committed = {p: broker._group_offsets.get(("g", "in", p), 0)
                      for p in range(2)}
     assert sum(committed.values()) == 20          # group watermarks intact
+
+
+def test_commit_tolerates_group_seeded_unread_partition():
+    """A position seeded from the GROUP's offsets on a never-read partition
+    is not read-ahead: losing that partition must not fail commit()
+    (fifth-pass review repro — _committed wasn't seeded alongside _position,
+    so the group watermark itself read as uncommitted)."""
+    broker = InProcessBroker(num_partitions=2)
+    _feed(broker, 20)
+    seeder = broker.consumer(["in"], "g")
+    seeder.poll_batch(20, 0.5)
+    seeder.commit()
+    seeder.close()                                # group watermarks now set
+
+    a = broker.consumer(["in"], "g")
+    assert a.poll(0.05) is None                   # adopts seeded positions, reads nothing
+    broker.consumer(["in"], "g")                  # B joins: A loses a partition
+    a.commit()                                    # nothing locally read: no raise

@@ -143,7 +143,9 @@ def test_engine_stops_when_producer_cannot_deliver(pipeline):
     assert stats.commits_skipped == 1  # stopped after the first failed batch
     assert stats.batches == 0          # a lost batch is NOT counted as done
     assert stats.processed == 0        # (restart re-drives it: at-least-once)
-    assert consumer.committed_offsets() == {}  # no offsets durably committed
+    # no offsets durably committed (owned partitions seed at the group
+    # watermark, 0 here — zero means nothing committed)
+    assert all(off == 0 for off in consumer.committed_offsets().values())
 
 
 def test_group_offsets_survive_consumer_restart(pipeline):
