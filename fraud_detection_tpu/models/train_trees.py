@@ -458,19 +458,23 @@ def _drain_lists_to_host(lists, n_host: int) -> int:
     return len(lists[0])
 
 
-# Device bin matrices validated in-range once (they are immutable on
-# device). jax arrays are unhashable, so the cache is id-keyed with a
-# weakref.finalize that evicts the id when the array is collected (before
+# Device bin matrices are immutable, so their (min, max) is FETCHED once per
+# array — but the RANGE CHECK still runs per fit, against that fit's n_bins
+# (a cached pass/fail would silently skip validation when a later fit uses a
+# smaller n_bins). jax arrays are unhashable, so the cache is id-keyed with
+# a weakref.finalize that evicts the id when the array is collected (before
 # CPython can recycle it).
-_VALIDATED_BIN_IDS: set = set()
+_VALIDATED_BIN_RANGE: dict = {}   # id(array) -> (lo, hi)
 
 
-def _mark_bins_validated(x) -> None:
+def _cache_bins_range(x, lo: int, hi: int) -> None:
+    if id(x) in _VALIDATED_BIN_RANGE:
+        return  # one finalizer per array, not one per fit
     try:
-        weakref.finalize(x, _VALIDATED_BIN_IDS.discard, id(x))
+        weakref.finalize(x, _VALIDATED_BIN_RANGE.pop, id(x), None)
     except TypeError:
-        return  # not weakref-able: validate on every call instead
-    _VALIDATED_BIN_IDS.add(id(x))
+        return  # not weakref-able: fetch on every call instead
+    _VALIDATED_BIN_RANGE[id(x)] = (lo, hi)
 
 
 def _prepare_inputs(X, y, num_classes, cfg, edges, mesh):
@@ -525,8 +529,8 @@ def _prepare_inputs(X, y, num_classes, cfg, edges, mesh):
         # 0.6s DT figure by the tunnel RTT (fifth-pass review).
         if isinstance(X, np.ndarray):
             lo, hi = int(X.min()), int(X.max())
-        elif id(X) in _VALIDATED_BIN_IDS:
-            lo, hi = 0, 0  # previously validated in-range
+        elif id(X) in _VALIDATED_BIN_RANGE:
+            lo, hi = _VALIDATED_BIN_RANGE[id(X)]  # fetched once; checked below
         else:
             lo, hi = (int(v) for v in
                       jax.device_get(jnp.stack([bins.min(), bins.max()])))
@@ -535,7 +539,7 @@ def _prepare_inputs(X, y, num_classes, cfg, edges, mesh):
                 f"pre-binned X has ids in [{lo}, {hi}] but n_bins={cfg.n_bins}; "
                 "integer X must contain bin_rows_host output, not raw features")
         if not isinstance(X, np.ndarray):
-            _mark_bins_validated(X)
+            _cache_bins_range(X, lo, hi)
     else:
         bins = apply_bins(Xd, jnp.asarray(edges))
     stats = jax.nn.one_hot(yd.astype(jnp.int32), num_classes, dtype=jnp.float32)

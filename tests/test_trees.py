@@ -325,3 +325,24 @@ def test_prebinned_guards_reject_garbage():
     wide = np.tile(np.linspace(0, 1, 200, dtype=np.float32)[:, None], (1, 8))
     with pytest.raises(ValueError, match="int8 range"):
         bin_rows_host(X, quantile_bin_edges(wide, 256))
+
+
+def test_cached_bin_range_rechecks_against_each_fits_n_bins():
+    """The validation cache stores the fetched (lo, hi), NOT a pass verdict:
+    refitting the same device array under a smaller n_bins must still raise
+    (sixth-pass review — a cached pass silently re-opened the garbage-
+    histogram hole the validation exists to close)."""
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.models.train_trees import (
+        TreeTrainConfig, bin_rows_host, fit_decision_tree, quantile_bin_edges)
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(0, 1, (300, 16)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    edges32 = quantile_bin_edges(X, 32)
+    dev = jnp.asarray(bin_rows_host(X, edges32))       # ids up to 31
+    fit_decision_tree(dev, y, edges=edges32)           # validates, caches range
+    small = TreeTrainConfig(n_bins=16)
+    with pytest.raises(ValueError, match="n_bins=16"):
+        fit_decision_tree(dev, y, edges=edges32[:, :15], config=small)
