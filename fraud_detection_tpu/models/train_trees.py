@@ -145,9 +145,13 @@ def _xgb_gain(left: jax.Array, total: jax.Array, lam: float, min_child_weight: f
 
 
 
-def _feature_mask(mask_keys_level, width: int, f: int):
+def _feature_mask(mask_keys_level, width: int, f: int, f_padded: int):
     """Per-node Bernoulli feature subsets (expected size sqrt(F)), batched
-    over a leading tree axis: mask_keys_level (T, key) -> (T, width, f)."""
+    over a leading tree axis: mask_keys_level (T, key) -> (T, width, f_padded).
+
+    The draw runs over the TRUE feature count ``f`` (the subset probability
+    and the PRNG stream must not depend on tile-alignment padding); padded
+    feature columns are masked False so they can never be selected."""
     p_keep = jnp.sqrt(jnp.float32(f)) / f
     mask = jax.vmap(
         lambda key: jax.random.bernoulli(key, p_keep, (width, f))
@@ -155,7 +159,51 @@ def _feature_mask(mask_keys_level, width: int, f: int):
     # Bias-free fallback: a node that drew an empty subset (probability
     # ~(1-p)^F, astronomically rare) considers all features.
     empty = ~mask.any(axis=2)
-    return mask | empty[:, :, None]
+    mask = mask | empty[:, :, None]
+    if f_padded != f:
+        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, f_padded - f)))
+    return mask
+
+
+
+def _node_totals(stats, seg_node, width: int):
+    """Per-node stat totals as a one-hot matmul instead of segment_sum:
+    XLA lowers segment_sum to a serial scatter-add (~10ms for 100k rows on
+    TPU) while the (L+1, N) @ (N, K) contraction is trivial MXU work.
+    HIGHEST precision keeps f32-faithful accumulation: exact for the integer
+    gini stats, ulp-level for xgb grad/hess. The overflow segment (rows with
+    seg_node == width) is computed and sliced away, same as the scatter
+    formulation."""
+    onehot = (seg_node[None, :] == jnp.arange(width + 1)[:, None]).astype(
+        stats.dtype)                                       # (L+1, N)
+    return jax.lax.dot_general(
+        onehot, stats, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)[:-1]          # (L, K)
+
+
+def _child_totals(hist, totals, best_f, best_b, do_split):
+    """Next level's per-node totals from this level's histogram: the left
+    child's stats are the cumulative histogram of the parent's chosen
+    feature at the chosen bin; the right child's are the complement. Heap
+    order interleaves (left, right) per parent; children of non-split
+    parents get zeros (no rows ever route there — matches the scanned
+    totals). Supports an optional leading tree axis.
+
+    hist (..., L, F, NB, K); totals (..., L, K); best_f/best_b/do_split
+    (..., L) -> (..., 2L, K)."""
+    f_axis = hist.ndim - 3
+    hist_f = jnp.take_along_axis(
+        hist, best_f[..., None, None, None], axis=f_axis)
+    hist_f = jnp.squeeze(hist_f, axis=f_axis)             # (..., L, NB, K)
+    cum_f = jnp.cumsum(hist_f, axis=-2)
+    left = jnp.take_along_axis(
+        cum_f, best_b[..., None, None], axis=-2)
+    left = jnp.squeeze(left, axis=-2)                     # (..., L, K)
+    right = totals - left
+    pair = jnp.stack([left, right], axis=-2)              # (..., L, 2, K)
+    pair = pair * do_split[..., None, None]
+    shape = pair.shape[:-3] + (2 * pair.shape[-3], pair.shape[-1])
+    return pair.reshape(shape)
 
 
 def _select_splits(hist, totals, mask, cfg: TreeTrainConfig):
@@ -229,7 +277,8 @@ class TreeTrainConfig:
                                jax.default_backend() == "tpu")
 
 
-def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfig):
+def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfig,
+                true_features: Optional[int] = None):
     """Grow one tree. All shapes static; python loop over levels unrolls in jit.
 
     bins: (N, F) int32; stats: (N, K) per-row statistics (class one-hots for
@@ -237,9 +286,14 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
     row_weights: (N,) 0/1-ish activity weights; feature_mask_keys: PRNG key
     per level for Bernoulli feature subsets, or None for all features.
 
+    ``true_features``: the pre-padding feature count — the Bernoulli
+    feature-subset draw must not see tile-alignment padding (subset
+    probability and PRNG stream follow the real F).
+
     Returns flat arrays (M,) feature/threshold-bin/left/right + (M, K) stats.
     """
     n, f = bins.shape
+    f_true = f if true_features is None else true_features
     k = stats.shape[-1]
     nb = cfg.n_bins
     depth = cfg.max_depth
@@ -255,6 +309,18 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
     node = jnp.zeros((n,), jnp.int32)  # heap position per row
     active = row_weights > 0
 
+    # Gini statistics are one-hot class counts times small-integer weights:
+    # the histogram runs as ONE exact int8 MXU pass (vs two bf16 passes for
+    # float grad/hess stats), and node totals are DERIVED instead of scanned:
+    # level 0's from the histogram (feature 0's bins partition the root's
+    # rows), deeper levels' from the parent's cumulative stats at its chosen
+    # split (left child = cum[f*, b*]; right = parent - left) — sibling
+    # arithmetic that removes every per-level segment-sum sweep including
+    # the leaf level's. All quantities are exact integers, so the derived
+    # totals are bit-equal to the XLA path's scanned ones.
+    exact = bool(cfg.use_pallas) and cfg.criterion == "gini"
+    carried = None   # exact path: totals for this level, derived at l-1
+
     for level in range(depth + 1):
         offset = 2 ** level - 1
         width = 2 ** level
@@ -262,8 +328,14 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
         seg_valid = active & (local >= 0) & (local < width)
         # Inactive rows route to an overflow segment that is sliced away.
         seg_node = jnp.where(seg_valid, local, width)
-        totals = jax.ops.segment_sum(stats, seg_node, num_segments=width + 1)[:-1]
-        node_stats = node_stats.at[offset : offset + width].set(totals)
+
+        if level == depth:
+            # Deepest level grows no splits: only the leaf totals are needed
+            # — derived on the exact path, one cheap scan on the float path.
+            totals = (carried if exact and carried is not None
+                      else _node_totals(stats, seg_node, width))
+            node_stats = node_stats.at[offset : offset + width].set(totals)
+            break
 
         if cfg.use_pallas:
             # The Pallas MXU histogram serves every trainer — feature masks
@@ -274,7 +346,8 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
 
             hist = node_feature_bin_histogram(
                 bins, jnp.where(seg_valid, local, width), stats,
-                n_nodes=width, n_bins=nb, interpret=auto_interpret())
+                n_nodes=width, n_bins=nb, interpret=auto_interpret(),
+                exact_int8=exact)
         else:
             def hist_one_feature(fbins):
                 seg = jnp.where(seg_valid, local * nb + fbins, width * nb)
@@ -282,8 +355,11 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
             hist = jax.vmap(hist_one_feature, in_axes=1)(bins)      # (F, L*NB, K)
             hist = hist.reshape(f, width, nb, k).transpose(1, 0, 2, 3)  # (L,F,NB,K)
 
-        if level == depth:
-            break  # deepest level: leaves only
+        if exact:
+            totals = (hist[:, 0].sum(axis=1) if carried is None else carried)
+        else:
+            totals = _node_totals(stats, seg_node, width)
+        node_stats = node_stats.at[offset : offset + width].set(totals)
 
         if cfg.use_pallas and feature_mask_keys is None:
             best_f, best_b, best_gain = best_splits(
@@ -292,7 +368,8 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
                 interpret=auto_interpret())
         else:
             mask = (None if feature_mask_keys is None
-                    else _feature_mask(feature_mask_keys[level][None], width, f))
+                    else _feature_mask(feature_mask_keys[level][None], width,
+                                       f_true, f))
             bf, bb, bg = _select_splits(hist[None], totals[None], mask, cfg)
             best_f, best_b, best_gain = bf[0], bb[0], bg[0]
         do_split = best_gain > cfg.min_info_gain
@@ -303,6 +380,9 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
         left_child = left_child.at[pos].set(jnp.where(do_split, 2 * pos + 1, -1))
         right_child = right_child.at[pos].set(jnp.where(do_split, 2 * pos + 2, -1))
 
+        if exact:
+            carried = _child_totals(hist, totals, best_f, best_b, do_split)
+
         node1, active1 = _route_rows(
             bins, local[None], seg_valid[None], node[None],
             best_f[None], best_b[None], do_split[None], width)
@@ -311,16 +391,17 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
     return feature, split_bin, left_child, right_child, node_stats
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_feature_mask"))
+@partial(jax.jit, static_argnames=("cfg", "use_feature_mask", "true_features"))
 def _build_tree_jit(bins, stats, row_weights, mask_keys, cfg: TreeTrainConfig,
-                    use_feature_mask: bool):
+                    use_feature_mask: bool, true_features: Optional[int] = None):
     keys = mask_keys if use_feature_mask else None
-    return _build_tree(bins, stats, row_weights, keys, cfg)
+    return _build_tree(bins, stats, row_weights, keys, cfg, true_features)
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_feature_mask"))
+@partial(jax.jit, static_argnames=("cfg", "use_feature_mask", "true_features"))
 def _build_tree_chunk(bins, stats, row_weights, mask_keys,
-                      cfg: TreeTrainConfig, use_feature_mask: bool):
+                      cfg: TreeTrainConfig, use_feature_mask: bool,
+                      true_features: Optional[int] = None):
     """A chunk of independent trees in ONE program.
 
     Pallas path: all trees per level go through ONE fused multi-tree
@@ -337,17 +418,19 @@ def _build_tree_chunk(bins, stats, row_weights, mask_keys,
     if cfg.use_pallas:
         return _build_forest_chunk_pallas(
             bins, stats, row_weights,
-            mask_keys if use_feature_mask else None, cfg)
+            mask_keys if use_feature_mask else None, cfg, true_features)
     outs = [
         _build_tree(bins, stats, row_weights[i],
-                    mask_keys[i] if use_feature_mask else None, cfg)
+                    mask_keys[i] if use_feature_mask else None, cfg,
+                    true_features)
         for i in range(row_weights.shape[0])
     ]
     return tuple(jnp.stack(parts) for parts in zip(*outs))
 
 
 def _build_forest_chunk_pallas(bins, stats, row_weights, mask_keys,
-                               cfg: TreeTrainConfig):
+                               cfg: TreeTrainConfig,
+                               true_features: Optional[int] = None):
     """Batched level-wise builder: every per-row/per-node array carries a
     leading tree axis, and the per-level histogram is one
     ``node_feature_bin_histogram_multi`` call for the whole chunk. Math is
@@ -372,6 +455,18 @@ def _build_forest_chunk_pallas(bins, stats, row_weights, mask_keys,
 
     node = jnp.zeros((t, n), jnp.int32)
     active = row_weights > 0
+    # Gini chunks (the forest's only criterion) qualify for the exact int8
+    # MXU pass: one-hot class stats x Poisson weights, products < 128.
+    exact = cfg.criterion == "gini"
+
+    def seg_totals(locals_masked, width):
+        # per-tree totals via the one-hot matmul (segment_sum scatters are
+        # ~10ms per call at bench scale; this is trivial MXU work)
+        return jax.vmap(
+            lambda loc, w: _node_totals(stats * w[:, None], loc, width)
+        )(locals_masked, row_weights)                           # (T, L, K)
+
+    carried = None   # exact path: this level's totals, derived at l-1
 
     for level in range(depth + 1):
         offset = 2 ** level - 1
@@ -379,22 +474,29 @@ def _build_forest_chunk_pallas(bins, stats, row_weights, mask_keys,
         local = node - offset                                   # (T, N)
         seg_valid = active & (local >= 0) & (local < width)
         locals_masked = jnp.where(seg_valid, local, width)
-        # exact per-tree totals (cheap per-node scatter, same as _build_tree)
-        totals = jax.vmap(
-            lambda loc, w: jax.ops.segment_sum(
-                stats * w[:, None], loc, num_segments=width + 1)[:-1]
-        )(locals_masked, row_weights)                           # (T, L, K)
-        node_stats = node_stats.at[:, offset : offset + width].set(totals)
+
+        if level == depth:
+            # Leaves only: derived totals (exact path) skip the final scan.
+            totals = (carried if exact and carried is not None
+                      else seg_totals(locals_masked, width))
+            node_stats = node_stats.at[:, offset : offset + width].set(totals)
+            break
 
         hist = node_feature_bin_histogram_multi(
             bins, locals_masked, row_weights, stats,
-            n_nodes=width, n_bins=nb, interpret=auto_interpret())
-
-        if level == depth:
-            break
+            n_nodes=width, n_bins=nb, interpret=auto_interpret(),
+            exact_int8=exact)
+        if exact:
+            totals = (hist[:, :, 0].sum(axis=2) if carried is None
+                      else carried)                             # (T, L, K)
+        else:
+            totals = seg_totals(locals_masked, width)
+        node_stats = node_stats.at[:, offset : offset + width].set(totals)
 
         mask = (None if mask_keys is None
-                else _feature_mask(mask_keys[:, level], width, f))
+                else _feature_mask(mask_keys[:, level], width,
+                                   f if true_features is None else true_features,
+                                   f))
         best_f, best_b, best_gain = _select_splits(hist, totals, mask, cfg)
         do_split = best_gain > cfg.min_info_gain
 
@@ -405,6 +507,9 @@ def _build_forest_chunk_pallas(bins, stats, row_weights, mask_keys,
             jnp.where(do_split, 2 * pos + 1, -1))
         right_child = right_child.at[:, pos].set(
             jnp.where(do_split, 2 * pos + 2, -1))
+
+        if exact:
+            carried = _child_totals(hist, totals, best_f, best_b, do_split)
 
         node, active = _route_rows(bins, local, seg_valid, node,
                                    best_f, best_b, do_split, width)
@@ -542,6 +647,25 @@ def _prepare_inputs(X, y, num_classes, cfg, edges, mesh):
             _cache_bins_range(X, lo, hi)
     else:
         bins = apply_bins(Xd, jnp.asarray(edges))
+    if mesh is None:
+        # Pre-pad rows/features to the Pallas tile grid ONCE: the kernel
+        # wrapper otherwise re-pads (a full-matrix HBM copy) on every one of
+        # the depth x rounds histogram calls. Padded rows carry weight 0 (so
+        # every histogram sees nothing); padded features produce all-rows-in-
+        # bin-0 columns whose split candidates are all invalid (empty right
+        # child), so first-occurrence argmax never selects them. Applied on
+        # the XLA path too (not just use_pallas): the forest PRNG draw
+        # shapes follow the padded row/feature counts, and the two paths
+        # must consume identical streams to build identical forests.
+        from fraud_detection_tpu.ops.histogram import FEATURE_TILE, ROW_TILE
+
+        n_rows, n_feat = bins.shape
+        pad_n = (-n_rows) % ROW_TILE
+        pad_f = (-n_feat) % FEATURE_TILE
+        if pad_n or pad_f:
+            bins = jnp.pad(bins, ((0, pad_n), (0, pad_f)))
+            yd = jnp.pad(yd, (0, pad_n))
+            weights = jnp.pad(weights, (0, pad_n))
     stats = jax.nn.one_hot(yd.astype(jnp.int32), num_classes, dtype=jnp.float32)
     return edges, bins, yd, stats, weights, n
 
@@ -554,11 +678,13 @@ def fit_decision_tree(
     cfg = resolve_config(config, mesh)
     edges, bins, _, stats, weights, _ = _prepare_inputs(X, y, num_classes, cfg, edges, mesh)
     dummy_keys = jax.random.split(jax.random.PRNGKey(0), cfg.max_depth + 1)
-    feat, sbin, left, right, node_stats = _build_tree_jit(
-        bins, stats, weights, dummy_keys, cfg, False)
+    out = _build_tree_jit(bins, stats, weights, dummy_keys, cfg, False)
+    # ONE batched transfer: five sequential np.asarray pulls cost five
+    # host<->device round-trips, which dominate the fit wall-clock when the
+    # device is behind a remote tunnel (~100ms RTT each).
+    feat, sbin, left, right, node_stats = jax.device_get(out)
     return _assemble(
-        [np.asarray(feat)], [np.asarray(sbin)], [np.asarray(left)],
-        [np.asarray(right)], [np.asarray(node_stats)],
+        [feat], [sbin], [left], [right], [node_stats],
         edges, np.ones(1), "decision_tree", cfg)
 
 
@@ -606,9 +732,12 @@ def fit_random_forest(
 
         if checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        # bootstrap_rows: the Poisson draw runs over the PADDED row count,
+        # so the padded shape is part of the PRNG stream identity — a
+        # snapshot from a run with different padding must refuse to resume.
         extra = {"seed": seed, "tree_chunk": tree_chunk,
                  "feature_subset": feature_subset, "num_classes": num_classes,
-                 **ts.mesh_extra(mesh)}
+                 "bootstrap_rows": n_padded, **ts.mesh_extra(mesh)}
         fingerprint = ts.data_fingerprint(
             cfg.__dict__, edges, n, y=np.asarray(y), extra=extra)
 
@@ -656,7 +785,8 @@ def fit_random_forest(
         weights = weights * base_weights[None, :]  # zero out mesh padding rows
         mask_keys = jax.random.split(mkey, tree_chunk * (cfg.max_depth + 1)).reshape(
             tree_chunk, cfg.max_depth + 1, -1)
-        f_, b_, l_, r_, s_ = build(bins, stats, weights, mask_keys, cfg, feature_subset)
+        f_, b_, l_, r_, s_ = build(bins, stats, weights, mask_keys, cfg,
+                                   feature_subset, edges.shape[0])
         if need != tree_chunk:
             f_, b_, l_, r_, s_ = (f_[:need], b_[:need], l_[:need],
                                   r_[:need], s_[:need])
