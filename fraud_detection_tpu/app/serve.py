@@ -188,7 +188,10 @@ def main(argv=None) -> int:
     if args.workers > 1:
         # Horizontal scale-out: N engines, ONE group — the broker (in-process
         # or Kafka) deals each a disjoint partition subset; a worker's exit
-        # rebalances its partitions to the survivors. Workers share the
+        # rebalances ONLY its partitions to the survivors (balanced-sticky
+        # assignor — uninvolved survivors keep theirs, so their in-flight
+        # commits are not fenced and the merged counts carry no rebalance
+        # duplicates on the common exit path). Workers share the
         # pipeline (scoring is jitted + thread-safe; the engine serializes
         # its own consumer). --max-messages was already rejected up top.
         import threading

@@ -152,10 +152,20 @@ def make_stream_explain_hook(backend, *, temperature: float = 0.0,
                     log.warning("explanation backend failed for a %d-row "
                                 "batch: %r", len(picked), e)
                     return out
-                if len(replies) != len(picked):  # zip would silently drop rows
-                    raise ValueError(
-                        f"backend returned {len(replies)} analyses for "
-                        f"{len(picked)} prompts")
+                if len(replies) != len(picked):
+                    # Same degraded mode as every other backend failure: a
+                    # count mismatch is a backend bug, but raising here kills
+                    # the engine's finish leg (and under --supervise a
+                    # deterministic bug would burn every restart) while the
+                    # documented contract is "annotation only, classification
+                    # never halts". zip would silently MISALIGN rows, so the
+                    # whole batch goes out unannotated instead (round-3
+                    # advisor finding).
+                    log.warning(
+                        "explanation backend returned %d analyses for %d "
+                        "prompts; dropping the batch's annotations",
+                        len(replies), len(picked))
+                    return out
                 for i, reply in zip(picked, replies):
                     out[i] = reply
             else:

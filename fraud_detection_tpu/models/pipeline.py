@@ -23,7 +23,6 @@ from fraud_detection_tpu.featurize.text import StopWordFilter
 from fraud_detection_tpu.featurize.tfidf import (
     HashingTfIdfFeaturizer,
     VocabTfIdfFeaturizer,
-    tfidf_dense,
 )
 from fraud_detection_tpu.models import linear as linear_mod
 from fraud_detection_tpu.models import trees as trees_mod
@@ -287,10 +286,15 @@ class ServingPipeline:
 
 
 @partial(jax.jit, static_argnames=("binary",))
+@partial(jax.jit, static_argnames=("binary",))
 def _tree_prob_encoded(ensemble: TreeEnsemble, ids, counts, idf, binary: bool):
-    """Hashed sparse rows -> dense TF-IDF -> ensemble traversal, one program
-    (the tree analogue of linear.prob_encoded, for the raw-JSON fast path)."""
-    proba = trees_mod.predict_proba(ensemble, tfidf_dense(ids, counts, idf))
+    """Hashed sparse rows -> scatter-free ensemble traversal, ONE compiled
+    program (the tree analogue of linear.prob_encoded, for the raw-JSON fast
+    path). The traversal reads each node's split-feature value directly from
+    the row's term list (models/trees.py _leaf_indices_encoded) — the old
+    densify-then-gather formulation paid a (B, 10000) XLA scatter per chunk,
+    the single most expensive op on the tree serving path."""
+    proba = trees_mod.predict_proba_encoded(ensemble, ids, counts, idf)
     return proba[:, 1] if binary else proba
 
 

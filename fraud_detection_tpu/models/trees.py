@@ -145,10 +145,38 @@ def _leaf_indices(x, feature, threshold, left, right, max_depth: int):
     return per_row(x, feature, threshold, left, right, max_depth)
 
 
-def predict_proba(ensemble: TreeEnsemble, x: jax.Array) -> jax.Array:
-    """(B, F) dense features -> (B, C) class probabilities (Spark semantics)."""
-    idx = _leaf_indices(x, ensemble.feature, ensemble.threshold,
-                        ensemble.left, ensemble.right, ensemble.max_depth)  # (B, T)
+@partial(jax.jit, static_argnames=("max_depth",))
+def _leaf_indices_encoded(ids, counts, idf, feature, threshold, left, right,
+                          max_depth: int):
+    """Hashed sparse rows (B, W) -> (B, T) leaf indices WITHOUT densifying.
+
+    A depth-5 tree reads at most 31 distinct features per row, so
+    materializing the (B, F) dense TF-IDF matrix (an XLA scatter — slow,
+    serialized on TPU) just to gather a handful of values back is the wrong
+    shape. Instead the value of the current node's split feature is computed
+    on demand from the row's term list: sum of counts whose hashed id equals
+    the feature, scaled by its IDF — identical math to the dense path
+    (absent features read 0 both ways; padded term slots carry count 0)."""
+
+    def one_row(ids_row, counts_row):
+        def one_tree(feat, thr, l, r):
+            def body(_, idx):
+                f = jnp.maximum(feat[idx], 0)    # leaves carry -1; unused
+                val = jnp.sum(
+                    jnp.where(ids_row == f, counts_row, 0.0)) * idf[f]
+                is_leaf = l[idx] < 0
+                nxt = jnp.where(val <= thr[idx], l[idx], r[idx])
+                return jnp.where(is_leaf, idx, nxt)
+
+            return jax.lax.fori_loop(0, max_depth, body, jnp.int32(0))
+
+        return jax.vmap(one_tree)(feature, threshold, left, right)
+
+    return jax.vmap(one_row)(ids, counts.astype(jnp.float32))
+
+
+def _proba_from_leaf_indices(ensemble: TreeEnsemble, idx: jax.Array) -> jax.Array:
+    """(B, T) leaf indices -> (B, C) class probabilities (Spark semantics)."""
     payload = jnp.take_along_axis(
         ensemble.leaf[None], idx[:, :, None, None], axis=2)[:, :, 0, :]  # (B, T, C)
 
@@ -166,6 +194,23 @@ def predict_proba(ensemble: TreeEnsemble, x: jax.Array) -> jax.Array:
     weighted = per_tree * ensemble.tree_weights[None, :, None]
     raw = weighted.sum(axis=1)
     return raw / jnp.maximum(raw.sum(-1, keepdims=True), 1e-12)
+
+
+def predict_proba(ensemble: TreeEnsemble, x: jax.Array) -> jax.Array:
+    """(B, F) dense features -> (B, C) class probabilities (Spark semantics)."""
+    idx = _leaf_indices(x, ensemble.feature, ensemble.threshold,
+                        ensemble.left, ensemble.right, ensemble.max_depth)  # (B, T)
+    return _proba_from_leaf_indices(ensemble, idx)
+
+
+def predict_proba_encoded(ensemble: TreeEnsemble, ids, counts, idf) -> jax.Array:
+    """Hashed sparse rows -> (B, C) probabilities via the scatter-free
+    traversal (the serving fast path; bit-consistent with predict_proba on
+    the densified rows)."""
+    idx = _leaf_indices_encoded(ids, counts, idf, ensemble.feature,
+                                ensemble.threshold, ensemble.left,
+                                ensemble.right, ensemble.max_depth)
+    return _proba_from_leaf_indices(ensemble, idx)
 
 
 def predict(ensemble: TreeEnsemble, x: jax.Array) -> tuple[jax.Array, jax.Array]:

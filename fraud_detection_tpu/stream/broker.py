@@ -18,7 +18,7 @@ consumer offsets advance only on commit (the reference never commits — Q2 —
 and reprocesses from earliest on every restart; this engine commits after
 produce, deliberately fixing that and documenting the difference), and
 consumer-GROUP partition assignment: members of one group own disjoint
-partition subsets (round-robin assignor), rebalanced on join/leave/eviction,
+partition subsets (balanced-sticky assignor), rebalanced on join/leave/eviction,
 with commits rejected for partitions the member no longer owns
 (``CommitFailedError``, like Kafka on a stale generation). The reference
 creates its topics with ``--partitions 3`` and a consumer group
@@ -192,11 +192,16 @@ class InProcessBroker:
         return bool(stale)
 
     def _rebalance_locked(self, group: _GroupState) -> None:
-        """Round-robin assignor: each subscribed topic's partitions dealt out
-        over that topic's subscribers in join order. Bumps the generation —
-        every member notices on its next poll and refreshes its owned set.
-        Partitions that change hands get their acquisition generation
-        restamped; continuously-owned ones keep it."""
+        """Balanced-sticky assignor (Kafka's sticky strategy): every member
+        keeps the partitions it already owns up to its fair share; only
+        orphaned partitions (owner left/evicted) and the excess above a
+        shrunken share move. A pure round-robin re-deal shuffled partitions
+        between UNINVOLVED survivors on every member exit, fencing their
+        in-flight commits and forcing reprocessing (round-3 advisor finding
+        on serve --workers). Bumps the generation — every member notices on
+        its next poll and refreshes its owned set. Partitions that change
+        hands get their acquisition generation restamped; continuously-owned
+        ones keep it."""
         old_owner = {pair: m for m, pairs in group.assignment.items()
                      for pair in pairs}
         group.generation += 1
@@ -206,12 +211,29 @@ class InProcessBroker:
         acquired: Dict[tuple, int] = {}
         for topic in topics:
             subs = [m for m in members if topic in group.members[m]["topics"]]
-            for p in range(self.num_partitions):
-                owner, pair = subs[p % len(subs)], (topic, p)
-                group.assignment[owner].add(pair)
-                acquired[pair] = (group.acquired.get(pair, group.generation)
-                                  if old_owner.get(pair) == owner
-                                  else group.generation)
+            pairs = [(topic, p) for p in range(self.num_partitions)]
+            base, extra = divmod(len(pairs), len(subs))
+            target = {m: base + (1 if i < extra else 0)
+                      for i, m in enumerate(subs)}
+            kept: Dict[str, list] = {m: [] for m in subs}
+            pool = []
+            for pair in pairs:           # partition order -> deterministic
+                m = old_owner.get(pair)
+                if m in target and len(kept[m]) < target[m]:
+                    kept[m].append(pair)
+                else:
+                    pool.append(pair)
+            for m in subs:               # join order -> deterministic
+                take = target[m] - len(kept[m])
+                if take > 0:
+                    kept[m].extend(pool[:take])
+                    del pool[:take]
+            for m in subs:
+                for pair in kept[m]:
+                    group.assignment[m].add(pair)
+                    acquired[pair] = (group.acquired.get(pair, group.generation)
+                                      if old_owner.get(pair) == m
+                                      else group.generation)
         group.acquired = acquired
 
     def _join_group(self, group_id: str, topics: Sequence[str]) -> str:
@@ -415,15 +437,23 @@ class InProcessConsumer:
             # repro; commit_offsets always had the pre-refresh snapshot).
             before_pos = dict(self._position)
             before_committed = dict(self._committed)
+            before_acq = dict(self._acquired)
             with self.broker._lock:
                 self._refresh_locked()
             # Kafka parity with the adapter (round-3 full-round review): a
             # commit whose UNCOMMITTED read-ahead was fenced away raises the
             # same CommitFailedError real Kafka's commit() surfaces — silent
             # success here while production raises is the test/prod
-            # divergence the error translation exists to eliminate.
+            # divergence the error translation exists to eliminate. A
+            # partition that bounced away AND BACK between polls is owned
+            # again but restamped (new acquisition generation, position
+            # reset to the group watermark): its old tenure's read-ahead is
+            # equally gone, and real Kafka raises on the stale generation —
+            # so restamped keys fence exactly like lost ones (round-3
+            # advisor finding).
             lost = sorted(key for key, pos in before_pos.items()
-                          if key not in self._owned
+                          if (key not in self._owned
+                              or self._acquired.get(key) != before_acq.get(key))
                           and pos > before_committed.get(key, 0))
             if lost:
                 raise CommitFailedError(

@@ -11,6 +11,7 @@ zombie eviction via the session timeout.
 
 import json
 import threading
+import time
 
 import pytest
 
@@ -361,3 +362,31 @@ def test_commit_tolerates_group_seeded_unread_partition():
     assert a.poll(0.05) is None                   # adopts seeded positions, reads nothing
     broker.consumer(["in"], "g")                  # B joins: A loses a partition
     a.commit()                                    # nothing locally read: no raise
+
+
+def test_commit_fences_partition_that_bounced_away_and_back():
+    """A partition that left and returned between polls is owned again but
+    restamped — its old tenure's uncommitted read-ahead was discarded, so
+    commit() must raise like real Kafka does on a stale generation, not
+    silently succeed (round-3 advisor finding)."""
+    broker = InProcessBroker(num_partitions=1, session_timeout=0.05)
+    producer = broker.producer()
+    for i in range(4):
+        producer.produce("t", f"m{i}".encode(), key=str(i).encode())
+
+    c1 = broker.consumer(["t"], "g")
+    msgs = []
+    while len(msgs) < 4:
+        m = c1.poll(0.2)
+        assert m is not None
+        msgs.append(m)                      # read-ahead, nothing committed
+
+    time.sleep(0.12)                        # c1 exceeds the session timeout
+    c2 = broker.consumer(["t"], "g")
+    while c2.poll(0.05) is None:            # triggers c1's eviction + rebalance
+        pass
+    assert broker.group_assignment("g") == {c2.member_id: [("t", 0)]}
+    c2.close()                              # partition returns to c1 on rejoin
+
+    with pytest.raises(CommitFailedError):
+        c1.commit()                         # reacquired, but restamped

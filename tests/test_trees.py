@@ -346,3 +346,35 @@ def test_cached_bin_range_rechecks_against_each_fits_n_bins():
     small = TreeTrainConfig(n_bins=16)
     with pytest.raises(ValueError, match="n_bins=16"):
         fit_decision_tree(dev, y, edges=edges32[:, :15], config=small)
+
+
+def test_encoded_traversal_matches_dense_path():
+    """predict_proba_encoded (the scatter-free serving path) must agree with
+    predict_proba on the densified rows for every ensemble kind — same split
+    comparisons, so identical leaf routing."""
+    from fraud_detection_tpu.data import generate_corpus
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+    from fraud_detection_tpu.models.trees import predict_proba, predict_proba_encoded
+
+    corpus = generate_corpus(n=300, seed=21)
+    texts = [d.text for d in corpus]
+    y = np.asarray([d.label for d in corpus])
+    feat = HashingTfIdfFeaturizer(num_features=1024)
+    feat.fit_idf(texts)
+    X = np.asarray(feat.featurize_dense(texts))
+    enc = feat.encode(texts)
+    idf = feat.idf_array()
+
+    cfg = TreeTrainConfig(max_depth=4)
+    models = [
+        fit_decision_tree(X, y, config=cfg),
+        fit_random_forest(X, y, n_trees=6, tree_chunk=3, config=cfg),
+        fit_gradient_boosting(X, y, n_rounds=6,
+                              config=TreeTrainConfig(max_depth=4, criterion="xgb")),
+    ]
+    for m in models:
+        dense = np.asarray(predict_proba(m, jnp.asarray(X)))
+        sparse = np.asarray(predict_proba_encoded(
+            m, jnp.asarray(enc.ids), jnp.asarray(enc.counts), jnp.asarray(idf)))
+        np.testing.assert_allclose(sparse, dense, rtol=1e-5, atol=1e-6,
+                                   err_msg=m.kind)
