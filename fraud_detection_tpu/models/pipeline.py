@@ -286,7 +286,6 @@ class ServingPipeline:
 
 
 @partial(jax.jit, static_argnames=("binary",))
-@partial(jax.jit, static_argnames=("binary",))
 def _tree_prob_encoded(ensemble: TreeEnsemble, ids, counts, idf, binary: bool):
     """Hashed sparse rows -> scatter-free ensemble traversal, ONE compiled
     program (the tree analogue of linear.prob_encoded, for the raw-JSON fast

@@ -166,14 +166,25 @@ def _feature_mask(mask_keys_level, width: int, f: int, f_padded: int):
 
 
 
-def _node_totals(stats, seg_node, width: int):
+def _node_totals(stats, seg_node, width: int, batch_factor: int = 1):
     """Per-node stat totals as a one-hot matmul instead of segment_sum:
     XLA lowers segment_sum to a serial scatter-add (~10ms for 100k rows on
     TPU) while the (L+1, N) @ (N, K) contraction is trivial MXU work.
     HIGHEST precision keeps f32-faithful accumulation: exact for the integer
     gini stats, ulp-level for xgb grad/hess. The overflow segment (rows with
     seg_node == width) is computed and sliced away, same as the scatter
-    formulation."""
+    formulation.
+
+    The dense one-hot transient is (width+1, N) f32 — fine at the default
+    depth 5 (width <= 32) but growing as 2^depth * N; above a ~256MB
+    threshold (e.g. depth 10 at 1M rows would be ~4GB) this falls back to
+    the segment_sum formulation it replaced, trading the MXU win for
+    bounded memory. ``batch_factor``: callers that vmap this over a tree
+    chunk pass the chunk width so the threshold sees the REAL materialized
+    size (T, width+1, N), not the per-tree slice."""
+    n = stats.shape[0]
+    if batch_factor * (width + 1) * n * 4 > 256 * 1024 * 1024:
+        return jax.ops.segment_sum(stats, seg_node, num_segments=width + 1)[:-1]
     onehot = (seg_node[None, :] == jnp.arange(width + 1)[:, None]).astype(
         stats.dtype)                                       # (L+1, N)
     return jax.lax.dot_general(
@@ -463,7 +474,8 @@ def _build_forest_chunk_pallas(bins, stats, row_weights, mask_keys,
         # per-tree totals via the one-hot matmul (segment_sum scatters are
         # ~10ms per call at bench scale; this is trivial MXU work)
         return jax.vmap(
-            lambda loc, w: _node_totals(stats * w[:, None], loc, width)
+            lambda loc, w: _node_totals(stats * w[:, None], loc, width,
+                                        batch_factor=t)
         )(locals_masked, row_weights)                           # (T, L, K)
 
     carried = None   # exact path: this level's totals, derived at l-1

@@ -133,10 +133,13 @@ def _hist_kernel_multi(bins_ref, b_of_c_ref, locals_ref, weights_ref,
     if exact_int8:
         # stats*w <= 127 (one-hot class counts x Poisson weights) — the
         # trainer guarantees the range, so the int8 cast is exact and the
-        # contraction is ONE int8 MXU pass accumulating exact int32.
+        # contraction is ONE int8 MXU pass accumulating exact int32. The
+        # clip saturates (instead of silently wrapping to negative counts)
+        # if a future caller breaks the contract; the jitted wrapper
+        # additionally reports the violation (jax.debug.print).
         out_ref[:] += jax.lax.dot_general(
-            ns.astype(jnp.int8), eq.astype(jnp.int8), dims,
-            preferred_element_type=jnp.int32)
+            jnp.clip(ns, 0.0, 127.0).astype(jnp.int8), eq.astype(jnp.int8),
+            dims, preferred_element_type=jnp.int32)
         return
 
     multihot = eq.astype(jnp.bfloat16)
@@ -193,6 +196,27 @@ def node_feature_bin_histogram_multi(
         stats.T.astype(jnp.float32))
     b_of_c = (jnp.arange(feature_tile * n_bins, dtype=jnp.int32)
               // feature_tile)[None, :]
+
+    if exact_int8:
+        # Loud contract check: the int8 MXU path is exact only for
+        # stats*weight products in [0, 127]. The exact per-row bound
+        # max_r(max_k stats[k,r] * max_t w[t,r]) is as cheap as the global
+        # maxima and never false-positives across rows; negatives violate
+        # the non-negativity half of the contract (the kernel clip would
+        # silently zero them). Violations print a diagnostic (the kernel
+        # saturates to [0, 127] rather than wrapping).
+        bound = jnp.max(jnp.max(stats_p, axis=0) * jnp.max(weights_p, axis=0))
+        negative = jnp.minimum(jnp.min(stats_p), jnp.min(weights_p))
+        # Negated-complement predicates so NaN operands (which compare False
+        # both ways) trip the diagnostic instead of slipping past it.
+        jax.lax.cond(
+            ~(bound <= 127.0) | ~(negative >= 0.0),
+            lambda b, neg: jax.debug.print(
+                "ops.histogram exact_int8 contract violated: per-row "
+                "stats*weight bound {b}, min operand {neg} — products are "
+                "clipped to [0, 127] (use the bf16 path for unbounded or "
+                "signed stats)", b=b, neg=neg),
+            lambda b, neg: None, bound, negative)
 
     grid = (f_pad // feature_tile, n_pad // row_tile)
     out = pl.pallas_call(
