@@ -438,21 +438,45 @@ def llm_bench() -> dict:
     fallback_err = None
     if scale == "gemma2b":
         try:
-            from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
+            from fraud_detection_tpu.checkpoint.hf_convert import (
+                has_converted_cache, load_hf_checkpoint)
 
             t0 = time.perf_counter()
             ckpt_dir = _gemma2b_synthetic_dir()
             synth_s = time.perf_counter() - t0
+            warm = has_converted_cache(ckpt_dir)
             t0 = time.perf_counter()
-            # max_seq 8192 so the optional long-context leg (BENCH_LLM_LONG=1)
-            # can run T=8192; it only sizes position validation, not buffers.
+            # max_seq 8192 so the long-context leg can run T=8192; it only
+            # sizes position validation, not buffers.
             model = load_hf_checkpoint(ckpt_dir, max_seq=8192, tokenizer="byte")
             jax.block_until_ready(model.params)
             load_s = time.perf_counter() - t0
             cfg = model.cfg
             meta = {"model": "gemma-2b-arch (synthetic weights)",
-                    "synth_checkpoint_s": round(synth_s, 1),
-                    "convert_upload_s": round(load_s, 1)}
+                    "synth_checkpoint_s": round(synth_s, 1)}
+            if warm:
+                # Converted-layout cache hit: no transpose-heavy conversion,
+                # just memmap -> device upload (round-4 verdict item 6).
+                meta["convert_cached"] = True
+                meta["reload_s"] = round(load_s, 1)
+            elif has_converted_cache(ckpt_dir):
+                # Cold convert wrote a valid cache: prove it — free the
+                # first copy, reload warm. (If the write failed, e.g. full
+                # disk, there is no cache to prove and a second label-as-
+                # warm reconversion would be mislabeled evidence.)
+                meta["convert_upload_s"] = round(load_s, 1)
+                import gc
+
+                del model
+                gc.collect()
+                t0 = time.perf_counter()
+                model = load_hf_checkpoint(ckpt_dir, max_seq=8192,
+                                           tokenizer="byte")
+                jax.block_until_ready(model.params)
+                meta["reload_s"] = round(time.perf_counter() - t0, 1)
+            else:
+                meta["convert_upload_s"] = round(load_s, 1)
+                meta["convert_cache_write_failed"] = True
         except Exception as e:  # noqa: BLE001 — 5GB synth/convert/upload can
             # fail on disk or HBM pressure; a demo-scale measurement beats an
             # empty llm object in the round artifact.

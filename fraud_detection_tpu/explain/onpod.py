@@ -91,15 +91,12 @@ class OnPodBackend(_GenerateMixin):
         ``int8=True`` applies weight-only quantization after load
         (``models/llm.py quantize_params``): ~1.5x explanations/sec on a
         2B model at >0.999 logit correlation — opt-in, because greedy
-        decodes can still differ from bf16 near ties. Mutually exclusive
-        with ``mesh`` (TP sharding of quantized params is unimplemented)."""
+        decodes can still differ from bf16 near ties. Composes with
+        ``mesh``: quantization runs on the already-sharded params (the
+        elementwise q keeps the TP layout; the scale reduction lands on its
+        output-channel sharding — models/llm.py shard_params)."""
         from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
 
-        if int8 and mesh is not None:
-            # Before the multi-GB load: this combination is guaranteed to fail.
-            raise NotImplementedError(
-                "int8 + tensor-parallel mesh is not supported "
-                "(models/llm.py shard_params)")
         lm = load_hf_checkpoint(ckpt_dir, max_seq=max_seq, mesh=mesh,
                                 tokenizer=tokenizer)
         if int8:

@@ -112,6 +112,15 @@ def predict_encoded(model: LogisticRegression, batch: EncodedBatch) -> tuple[jax
     return _predict_encoded(model, jnp.asarray(batch.ids), jnp.asarray(batch.counts))
 
 
+def prob_encoded_arrays(model: LogisticRegression, ids: jax.Array,
+                        counts: jax.Array) -> jax.Array:
+    """Device-array variant of ``prob_encoded`` for callers that place the
+    encoded rows themselves (e.g. the mesh-backed ServingPipeline, which
+    row-shards them first — jit follows the input shardings, so the same
+    compiled program serves single-chip and data-parallel)."""
+    return _prob_encoded(model, ids, counts)
+
+
 def predict_encoded_mesh(model: LogisticRegression, batch: EncodedBatch,
                          mesh) -> tuple[np.ndarray, np.ndarray]:
     """Data-parallel serving over a device mesh: the encoded batch's rows are

@@ -139,14 +139,35 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, NamedShardi
     return s
 
 
+def _scale_sharding(weight_sh: NamedSharding, scale_shape) -> NamedSharding:
+    """Sharding for a Q8 scale: the weight's spec restricted to the dims the
+    scale keeps. Scales carry singleton input dims (quantize_params reduces
+    with keepdims), so only the weight's OUTPUT dims can be sharded — e.g.
+    wq (D, H, d) @ P(None, model, None) gives its (1, H, d) scale
+    P(None, model, None), while wo (H, d, D) @ P(model, None, None) gives
+    its (1, 1, D) scale full replication."""
+    spec = list(weight_sh.spec) + [None] * (len(scale_shape) - len(weight_sh.spec))
+    restricted = tuple(None if scale_shape[i] == 1 else spec[i]
+                       for i in range(len(scale_shape)))
+    return NamedSharding(weight_sh.mesh, P(*restricted))
+
+
 def shard_params(params: Params, cfg: TransformerConfig, mesh: Mesh) -> Params:
+    """Place params (full-precision OR int8-quantized) on the mesh in the
+    Megatron TP layout. Q8 leaves shard componentwise: q follows the
+    weight's spec, the per-output-channel scale follows on its non-singleton
+    dims (``_scale_sharding``) — quantize-then-shard and shard-then-quantize
+    both land on this exact placement."""
     sh = param_shardings(cfg, mesh)
-    if any(isinstance(v, Q8) for v in params.values()):
-        raise NotImplementedError(
-            "tensor-parallel sharding of int8-quantized params needs "
-            "per-leaf scale shardings; quantize AFTER sharding decisions "
-            "(single-chip decode is the int8 win — see quantize_params)")
-    return {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    out: Params = {}
+    for k, v in params.items():
+        if isinstance(v, Q8):
+            out[k] = Q8(q=jax.device_put(v.q, sh[k]),
+                        scale=jax.device_put(
+                            v.scale, _scale_sharding(sh[k], v.scale.shape)))
+        else:
+            out[k] = jax.device_put(v, sh[k])
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -160,10 +181,11 @@ def shard_params(params: Params, cfg: TransformerConfig, mesh: Mesh) -> Params:
 class Q8:
     """Per-output-channel int8 weight: ``w ≈ q * scale``.
 
-    ``scale`` keeps q's rank with singleton input dims, so ``q * scale``
-    broadcasts back to the weight — XLA fuses the convert+multiply into the
-    consuming dot's operand load, which is what makes the HBM read int8-wide
-    instead of bf16-wide."""
+    ``scale`` keeps q's rank with singleton input dims. Consumers (``_mm``)
+    feed ``q`` to the dot through a bare int8->dtype convert and apply the
+    scale to the dot's OUTPUT — constant along every contracted dim, so the
+    move is exact, and the HBM read stays int8-wide without relying on XLA
+    to fuse an operand-side convert*scale chain."""
 
     q: jax.Array          # int8, the weight's shape
     scale: jax.Array      # f32, singleton along the weight's INPUT dims
@@ -193,17 +215,11 @@ def quantize_params(params: Params, *, include_embed: bool = True) -> Params:
         if axes is None or (suffix in ("embed", "lm_head") and not include_embed):
             out[name] = w
             continue
-        sharding = getattr(w, "sharding", None)
-        if (sharding is not None and hasattr(sharding, "device_set")
-                and len(sharding.device_set) > 1
-                and not sharding.is_fully_replicated):
-            # Same refusal as shard_params, from the other direction:
-            # shard-then-quantize would produce Q8 leaves with unvalidated
-            # scale shardings (quantize FIRST, serve single-chip).
-            raise NotImplementedError(
-                f"{name} is sharded over {len(sharding.device_set)} devices; "
-                "int8 quantization of tensor-parallel params is not "
-                "implemented — quantize before sharding, on one chip")
+        # Sharded inputs quantize in place: the elementwise q keeps the
+        # weight's sharding, and the keepdims absmax reduction lands the
+        # scale exactly on _scale_sharding's layout (reduced input dims
+        # become singletons; surviving output dims keep their spec) — GSPMD
+        # inserts the cross-shard max where an input dim was sharded.
         wf = jnp.asarray(w).astype(jnp.float32)
         absmax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
         scale = jnp.maximum(absmax, 1e-8) / 127.0
@@ -212,13 +228,21 @@ def quantize_params(params: Params, *, include_embed: bool = True) -> Params:
     return out
 
 
-def _deq(w, dtype) -> jax.Array:
-    """Materialize a (possibly quantized) weight for a matmul — on the
-    compiled path the convert+scale fuses into the dot, so no full-width
-    weight ever round-trips HBM."""
+def _mm(sub: str, x: jax.Array, w, dtype) -> jax.Array:
+    """Einsum against a possibly-quantized weight. An int8 weight enters the
+    dot as a bare int8->dtype convert — the HBM read stays int8-wide — and
+    its per-output-channel scale multiplies the dot's OUTPUT instead of the
+    operand: mathematically identical (the scale is constant along every
+    contracted dim), and it removes any reliance on XLA fusing a
+    convert*scale*convert chain into the operand load (an operand-side
+    dequant leaves a full-width scaled weight on the critical path whenever
+    that fusion declines). Scales keep singleton input dims, so they
+    broadcast directly against the output's trailing dims for every layer
+    weight; the (V, 1) head layout is handled at the logits call site."""
     if isinstance(w, Q8):
-        return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
-    return w
+        out = jnp.einsum(sub, x, w.q.astype(dtype))
+        return (out * w.scale).astype(dtype)
+    return jnp.einsum(sub, x, w)
 
 
 def _embed_rows(emb, tokens: jax.Array, dtype) -> jax.Array:
@@ -595,9 +619,9 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
     for l in range(cfg.n_layers):
         h = rms_norm(x, params[f"l{l}.ln1"], cfg.rms_eps)
-        q = jnp.einsum("btD,Dhd->bthd", h, _deq(params[f"l{l}.wq"], cfg.dtype))
-        k = jnp.einsum("btD,Dhd->bthd", h, _deq(params[f"l{l}.wk"], cfg.dtype))
-        v = jnp.einsum("btD,Dhd->bthd", h, _deq(params[f"l{l}.wv"], cfg.dtype))
+        q = _mm("btD,Dhd->bthd", h, params[f"l{l}.wq"], cfg.dtype)
+        k = _mm("btD,Dhd->bthd", h, params[f"l{l}.wk"], cfg.dtype)
+        v = _mm("btD,Dhd->bthd", h, params[f"l{l}.wv"], cfg.dtype)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
 
@@ -636,16 +660,21 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         else:
             attn = causal_attention(q, expand_kv(k), expand_kv(v), use_flash)
 
-        x = x + jnp.einsum("bthd,hdD->btD", attn,
-                           _deq(params[f"l{l}.wo"], cfg.dtype))
+        x = x + _mm("bthd,hdD->btD", attn, params[f"l{l}.wo"], cfg.dtype)
         h2 = rms_norm(x, params[f"l{l}.ln2"], cfg.rms_eps)
-        gate = act(h2 @ _deq(params[f"l{l}.w_gate"], cfg.dtype))
-        x = x + (gate * (h2 @ _deq(params[f"l{l}.w_up"], cfg.dtype))) @ _deq(
-            params[f"l{l}.w_down"], cfg.dtype)
+        gate = act(_mm("btD,DF->btF", h2, params[f"l{l}.w_gate"], cfg.dtype))
+        up = _mm("btD,DF->btF", h2, params[f"l{l}.w_up"], cfg.dtype)
+        x = x + _mm("btF,FD->btD", gate * up, params[f"l{l}.w_down"], cfg.dtype)
 
     x = rms_norm(x, params["ln_f"], cfg.rms_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["embed"]
-    logits = jnp.einsum("btD,VD->btV", x, _deq(head, cfg.dtype)).astype(jnp.float32)
+    if isinstance(head, Q8):
+        # (V, 1) per-row scale applied to the f32 logits, same output-side
+        # move as _mm — the int8 head streams at int8 width.
+        logits = (jnp.einsum("btD,VD->btV", x, head.q.astype(cfg.dtype))
+                  .astype(jnp.float32) * head.scale[:, 0])
+    else:
+        logits = jnp.einsum("btD,VD->btV", x, head).astype(jnp.float32)
     return logits, new_cache
 
 

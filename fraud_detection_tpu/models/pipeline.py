@@ -78,9 +78,10 @@ class ServingPipeline:
 
     def __init__(self, featurizer: HashingTfIdfFeaturizer,
                  model: "LogisticRegression | TreeEnsemble",
-                 fold_idf: bool = True, batch_size: int = 256):
+                 fold_idf: bool = True, batch_size: int = 256, mesh=None):
         self.featurizer = featurizer
         self.batch_size = batch_size
+        self.mesh = mesh  # data-parallel serving: rows sharded on "data"
         self.model = model
         if isinstance(model, LogisticRegression):
             # Fold IDF into the weights so the sparse fast path sees raw counts.
@@ -100,15 +101,18 @@ class ServingPipeline:
         return self._fused_model
 
     @classmethod
-    def from_checkpoint(cls, path: str, batch_size: int = 256) -> "ServingPipeline":
+    def from_checkpoint(cls, path: str, batch_size: int = 256,
+                        mesh=None) -> "ServingPipeline":
         """Load a native checkpoint directory (checkpoint/native.py layout)."""
         from fraud_detection_tpu.checkpoint.native import load_checkpoint
 
         featurizer, model = load_checkpoint(path)
-        return cls(featurizer, model, batch_size=batch_size)
+        return cls(featurizer, model, batch_size=batch_size, mesh=mesh)
 
     @classmethod
-    def from_spark_artifact(cls, artifact: SparkPipelineArtifact, batch_size: int = 256) -> "ServingPipeline":
+    def from_spark_artifact(cls, artifact: SparkPipelineArtifact,
+                            batch_size: int = 256,
+                            mesh=None) -> "ServingPipeline":
         """Serve any reference artifact shape: the shipped HashingTF +
         LogisticRegression pipeline (SURVEY.md §2.2) AND the training
         script's CountVectorizer + tree pipelines
@@ -150,7 +154,8 @@ class ServingPipeline:
             raise ValueError(
                 "artifact has no LogisticRegression or tree classifier stage "
                 f"(got {[type(s).__name__ for s in artifact.stages]})")
-        return cls(featurizer, model, fold_idf=True, batch_size=batch_size)
+        return cls(featurizer, model, fold_idf=True, batch_size=batch_size,
+                   mesh=mesh)
 
     def predict_json_async(self, values: Sequence[bytes], text_field: str = "text"
                            ) -> Optional[Tuple["PendingPrediction", np.ndarray,
@@ -219,10 +224,26 @@ class ServingPipeline:
             self.model.kind in ("gbt", "xgboost")  # boosted margins are binary
             or self.model.leaf.shape[-1] == 2)
 
+    def _device_rows(self, ids, counts):
+        """Place one encoded chunk for scoring: plain device arrays single-
+        chip, or row-sharded over the serving mesh's "data" axis. The SAME
+        jitted scoring programs serve both — jit follows input shardings and
+        GSPMD adds the final gather, so mesh-backed streaming (engine ->
+        data-parallel scoring) is a placement decision, not a second code
+        path. shard_rows pads rows to a data-axis multiple; PendingPrediction
+        already slices every chunk back to its real count."""
+        if self.mesh is None:
+            return jnp.asarray(ids), jnp.asarray(counts)
+        from fraud_detection_tpu.parallel.mesh import shard_rows
+
+        return (shard_rows(np.asarray(ids), self.mesh),
+                shard_rows(np.asarray(counts), self.mesh))
+
     def _dispatch_fused(self, enc) -> object:
         """Launch fused sparse LR scoring for one encoded chunk and start the
         async device->host fetch; shared by both predict paths."""
-        p = linear_mod.prob_encoded(self._fused_model, enc)
+        ids, counts = self._device_rows(enc.ids, enc.counts)
+        p = linear_mod.prob_encoded_arrays(self._fused_model, ids, counts)
         copy_async = getattr(p, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()  # start the device->host fetch behind the dispatch
@@ -235,9 +256,8 @@ class ServingPipeline:
             # One upload, reused every chunk (idf_array() re-transfers
             # host->device per call — poison on the latency-critical path).
             self._tree_idf = self.featurizer.idf_array()
-        p = _tree_prob_encoded(self.model, jnp.asarray(enc.ids),
-                               jnp.asarray(enc.counts),
-                               self._tree_idf, binary)
+        ids, counts = self._device_rows(enc.ids, enc.counts)
+        p = _tree_prob_encoded(self.model, ids, counts, self._tree_idf, binary)
         copy_async = getattr(p, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()  # start the device->host fetch behind the dispatch
@@ -300,7 +320,8 @@ def _tree_prob_encoded(ensemble: TreeEnsemble, ids, counts, idf, binary: bool):
 def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 7,
                             num_features: int = 10000,
                             model: str = "lr",
-                            corpus_kwargs: dict | None = None) -> ServingPipeline:
+                            corpus_kwargs: dict | None = None,
+                            mesh=None) -> ServingPipeline:
     """Train a quick model on the synthetic corpus — the shared demo/bench
     fallback pipeline (one recipe, used by bench.py and app/serve.py).
     ``model``: "lr" (default) | "dt" | "rf" | "xgb". ``corpus_kwargs`` is
@@ -326,4 +347,4 @@ def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 
         clf = fit_gradient_boosting(X, y, n_rounds=20)
     else:
         raise ValueError(f"unknown demo model {model!r}")
-    return ServingPipeline(feat, clf, batch_size=batch_size)
+    return ServingPipeline(feat, clf, batch_size=batch_size, mesh=mesh)

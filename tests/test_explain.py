@@ -348,7 +348,8 @@ def test_stream_explain_hook_keeps_partial_results_per_row():
 
 def test_from_hf_checkpoint_int8(tmp_path):
     """onpod int8 loading: quantized params behind the same backend API,
-    refusing the unimplemented int8+mesh combination."""
+    including composed with a tensor-parallel mesh (round-4 verdict item 1 —
+    the combination used to refuse)."""
     import os
     import sys
 
@@ -370,7 +371,8 @@ def test_from_hf_checkpoint_int8(tmp_path):
     import jax
     from jax.sharding import Mesh
     import numpy as np
-    with pytest.raises(NotImplementedError, match="int8"):
-        OnPodBackend.from_hf_checkpoint(
-            d, int8=True, tokenizer="byte",
-            mesh=Mesh(np.array(jax.devices()[:2]), ("model",)))
+    be_tp = OnPodBackend.from_hf_checkpoint(
+        d, int8=True, tokenizer="byte",
+        mesh=Mesh(np.array(jax.devices()[:2]), ("model",)))
+    out_tp = be_tp.generate_batch(["why is this a scam?"], max_tokens=6)
+    assert len(out_tp) == 1 and isinstance(out_tp[0], str)

@@ -11,6 +11,7 @@ exact, not approximate.
 
 import json
 import math
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -194,6 +195,19 @@ def test_load_checkpoint_dir_end_to_end(tmp_path):
     got, _ = forward(lm.params, jnp.asarray(tokens), lm.cfg)
     want = hf_forward_numpy(st, hf, tokens)
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+    # Converted-layout cache: first load wrote it; a reload must hit it and
+    # produce identical params; touching a shard invalidates the fingerprint.
+    from fraud_detection_tpu.checkpoint.hf_convert import has_converted_cache
+
+    assert has_converted_cache(str(tmp_path))
+    lm2 = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                             tokenizer="byte")
+    for k in lm.params:
+        np.testing.assert_array_equal(np.asarray(lm.params[k]),
+                                      np.asarray(lm2.params[k]))
+    os.utime(tmp_path / "model-00001.safetensors")  # bump mtime_ns
+    assert not has_converted_cache(str(tmp_path))
 
 
 def test_unknown_architecture_rejected():

@@ -374,9 +374,37 @@ def test_int8_weight_only_quantization(params):
     # embed kept full-precision on request
     half = lm.quantized(include_embed=False)
     assert not isinstance(half.params["embed"], Q8)
-    with pytest.raises(NotImplementedError, match="quantized"):
-        shard_params(qlm.params, CFG, model_mesh(8))
-    # ...and from the other direction: shard-then-quantize refuses too
-    sharded = LanguageModel(CFG, shard_params(params, CFG, model_mesh(8)))
-    with pytest.raises(NotImplementedError, match="sharded"):
-        sharded.quantized()
+
+
+def test_int8_tensor_parallel_both_orders(params):
+    """int8 x TP composes in BOTH orders (round-4 verdict item 1): the Q8
+    q-leaf follows the weight's Megatron spec, the scale its output-channel
+    restriction, and an 8-way tp forward matches the single-device quantized
+    forward bit-for-bit in f32 logits (same math, same reduction order per
+    shard up to GSPMD's deterministic collectives — tolerance covers that)."""
+    from fraud_detection_tpu.models.llm import (LanguageModel, Q8,
+                                                quantize_params, shard_params)
+
+    mesh = model_mesh(8)
+    toks = jnp.asarray(np.arange(24, dtype=np.int32)[None, :] % 250)
+    qparams = quantize_params(params)
+    want = np.asarray(forward(qparams, toks, CFG)[0])
+
+    # quantize -> shard
+    q_then_s = shard_params(qparams, CFG, mesh)
+    wq = q_then_s["l0.wq"]
+    assert isinstance(wq, Q8) and wq.q.dtype == jnp.int8
+    assert not wq.q.sharding.is_fully_replicated          # heads sharded
+    got1 = np.asarray(jax.jit(lambda p, t: forward(p, t, CFG)[0])(q_then_s, toks))
+    np.testing.assert_allclose(got1, want, rtol=2e-5, atol=2e-5)
+
+    # shard -> quantize (the onpod from_hf_checkpoint(int8=True, mesh=...)
+    # order: quantization runs on already-placed params)
+    s_then_q = quantize_params(shard_params(params, CFG, mesh))
+    got2 = np.asarray(jax.jit(lambda p, t: forward(p, t, CFG)[0])(s_then_q, toks))
+    np.testing.assert_allclose(got2, want, rtol=2e-5, atol=2e-5)
+
+    # generation end to end on the tp mesh
+    qlm = LanguageModel(CFG, q_then_s)
+    toks_out = qlm.generate_tokens(qlm.tokenizer.encode("urgent"), max_new_tokens=4)
+    assert toks_out.shape == (4,)
