@@ -539,32 +539,37 @@ def llm_bench() -> dict:
         line["prefill_mfu_pct"] = round(
             100 * prefill_tok_s * (flops_tok + attn_flops_tok(T)) / flops_peak, 1)
 
-    if os.environ.get("BENCH_LLM_LONG") == "1" and scale == "gemma2b":
-        # Long-context prefill leg (off by default: the T=8192 compile adds
-        # minutes). Measured on v5e: 20.7k tok/s @ 55.9% MFU at T=4096,
-        # 15.8k @ 45.1% at T=8192 — MFU declines with T as the O(T^2)
-        # flash-attention term (lower arithmetic intensity than the
-        # matmuls) grows against the O(T) weight term.
-        T_long = int(os.environ.get("BENCH_LLM_LONG_T", "8192"))
-        # Separate generator: drawing from `rng` here would shift the decode
-        # prompt below between runs with and without this optional leg,
-        # breaking cross-round comparability of the decode numbers.
-        toks_l = jnp.asarray(np.random.default_rng(101).integers(
-            0, 255, size=(1, T_long)), jnp.int32)
-        long_tok_s = timed_prefill_tok_s(toks_l, 4)
-        line["prefill_long_T"] = T_long
-        line["prefill_long_tok_per_s"] = round(long_tok_s, 1)
-        if flops_peak:
-            line["prefill_long_mfu_pct"] = round(
-                100 * long_tok_s * (flops_tok + attn_flops_tok(T_long))
-                / flops_peak, 1)
+    if os.environ.get("BENCH_LLM_LONG", "1") != "0" and scale == "gemma2b":
+        # Long-context prefill — DEFAULT-ON (round-4 verdict item 3: the
+        # README's long-context claims must live in the committed artifact,
+        # not prose). MFU declines with T as the O(T^2) flash-attention
+        # term (lower arithmetic intensity than the matmuls) grows against
+        # the O(T) weight term. BENCH_LLM_LONG=0 skips for quick runs.
+        line["prefill_long"] = {}
+        for T_long in (4096, 8192):
+            # Separate generator: drawing from `rng` here would shift the
+            # decode prompt below between runs with and without this leg,
+            # breaking cross-round comparability of the decode numbers.
+            toks_l = jnp.asarray(np.random.default_rng(101).integers(
+                0, 255, size=(1, T_long)), jnp.int32)
+            long_tok_s = timed_prefill_tok_s(toks_l, 4)
+            leg_l = {"tok_per_s": round(long_tok_s, 1)}
+            if flops_peak:
+                leg_l["mfu_pct"] = round(
+                    100 * long_tok_s * (flops_tok + attn_flops_tok(T_long))
+                    / flops_peak, 1)
+            line["prefill_long"][str(T_long)] = leg_l
 
     def _emitted(row) -> int:
         eos = np.flatnonzero(np.asarray(row) == cfg.EOS)
         return int(eos[0]) + 1 if eos.size else len(row)
 
     prompt = rng.integers(0, 255, size=128)
-    n_new = 64
+    # 256 decode steps (r1-r4 used 64): a generate call carries ~50ms of
+    # fixed host+tunnel overhead, which at 64 tokens suppressed the
+    # weight-streaming metric by ~15% — 256 amortizes it to ~4% and matches
+    # a realistic explanation length. decode_tokens records the change.
+    n_new = 256
     model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)  # compile
     t0 = time.perf_counter()
     out = model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
@@ -592,11 +597,15 @@ def llm_bench() -> dict:
     # (B=16 costs the same wall as B=8). Default 8 keeps the driver's run
     # short; BENCH_LLM_B raises it.
     B = int(os.environ.get("BENCH_LLM_B", "8"))
-    prompts = [f"Analyze this dialogue for scam risk (case {i}): the caller "
-               "claims to be the bank fraud department and demands immediate "
-               "gift card payment to reverse a suspicious charge. "
-               + "Customer hesitates repeatedly. " * (i % 3 + 1)
-               for i in range(B)]
+
+    def mk_prompts(nb: int):
+        return [f"Analyze this dialogue for scam risk (case {i}): the caller "
+                "claims to be the bank fraud department and demands immediate "
+                "gift card payment to reverse a suspicious charge. "
+                + "Customer hesitates repeatedly. " * (i % 3 + 1)
+                for i in range(nb)]
+
+    prompts = mk_prompts(B)
     tok_prompts = [model.tokenizer.encode(p) for p in prompts]
     model.generate_tokens_batch(tok_prompts, max_new_tokens=n_new)  # compile
     t0 = time.perf_counter()
@@ -617,6 +626,24 @@ def llm_bench() -> dict:
     replies = backend.generate_batch(prompts[:2], temperature=0.0, max_tokens=8)
     assert len(replies) == 2          # the explain seam stays wired
 
+    # Batch-decode scaling (round-4 verdict item 3: the README's B=8/16/32
+    # claim must live in the artifact): weight-streaming-bound decode
+    # amortizes ~linearly with B until attention/sampling overheads bite —
+    # the array shows where. The B=8 fields above remain the cross-round
+    # comparable headline. BENCH_LLM_SCALING=0 skips.
+    if os.environ.get("BENCH_LLM_SCALING", "1") != "0" and scale == "gemma2b":
+        line["batch_decode_scaling"] = {}
+        for Bs in (8, 16, 32):
+            tp_s = [model.tokenizer.encode(p) for p in mk_prompts(Bs)]
+            model.generate_tokens_batch(tp_s, max_new_tokens=n_new)  # compile
+            t0 = time.perf_counter()
+            out_s = model.generate_tokens_batch(tp_s, max_new_tokens=n_new)
+            sdt = time.perf_counter() - t0
+            line["batch_decode_scaling"][str(Bs)] = {
+                "tok_per_s": round(
+                    sum(_emitted(r) for r in np.asarray(out_s)) / sdt, 1),
+                "explanations_per_s": round(Bs / sdt, 2)}
+
     # int8 weight-only decode (models/llm.py quantize_params): decode is
     # weight-streaming bound, so halving the bytes moves tokens/sec — the
     # convert+scale fuses into each dot's operand load. Measured on the 2B
@@ -633,6 +660,8 @@ def llm_bench() -> dict:
         emitted_q = _emitted(out_q)
         line["decode_int8_tok_per_s"] = round(emitted_q / qdt, 1)
         if hbm_peak:
+            line["decode_int8_weight_stream_gbps"] = round(
+                q_bytes * emitted_q / qdt / 1e9, 1)
             line["decode_int8_pct_hbm_peak"] = round(
                 100 * q_bytes * emitted_q / qdt / hbm_peak, 1)
         qmodel.generate_tokens_batch(tok_prompts, max_new_tokens=n_new)
@@ -642,7 +671,83 @@ def llm_bench() -> dict:
         line["batch_decode_int8_tok_per_s"] = round(
             sum(_emitted(r) for r in np.asarray(out_qb)) / qbdt, 1)
         line["explanations_int8_per_s"] = round(B / qbdt, 2)
+        serve_model = qmodel        # explanations serve int8 when available
+    else:
+        serve_model = model
+
+    # Explanations THROUGH the serve path (round-4 verdict item 3): the
+    # streaming engine on a ~5%-scam stream with the on-pod hook attached.
+    if os.environ.get("BENCH_EXPLAIN_SERVE", "1") != "0" and scale == "gemma2b":
+        if serve_model is not model:
+            # Free the bf16 copy before the KV cache: `backend` closes over
+            # `model`, so both names must drop for the params to release.
+            del model, backend
+        line["explain_serve"] = _explain_serve_bench(serve_model)
     return line
+
+
+def _explain_serve_bench(lm) -> dict:
+    """Flagged-row explanations inside the streaming engine's finish leg —
+    the serving shape that replaces the reference's blocking per-message
+    DeepSeek HTTPS call in its Kafka loop (/root/reference/app_ui.py:207).
+
+    A ~5%-scam stream runs through the full engine (consume -> classify ->
+    explain flagged -> produce -> commit) with
+    ``make_stream_explain_hook(OnPodBackend)`` attached: one batched
+    generate per micro-batch covers every flagged row. Records engine
+    throughput with explanations on, the no-hook baseline on the SAME
+    message stream (the classification-throughput cost of annotating), and
+    flagged-explanations/sec. The hooked engine is warmed once (prefill +
+    decode compile per batch bucket) before the timed run."""
+    from fraud_detection_tpu.data import generate_corpus
+    from fraud_detection_tpu.explain.onpod import (OnPodBackend,
+                                                   make_stream_explain_hook)
+    from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+
+    n_msgs = int(os.environ.get("BENCH_EXPLAIN_MSGS", "1024"))
+    max_tokens = int(os.environ.get("BENCH_EXPLAIN_TOKENS", "48"))
+    batch_size = 512
+    corpus = generate_corpus(n=2000, seed=42)
+    scams = [d.text for d in corpus if d.label == 1]
+    benign = [d.text for d in corpus if d.label == 0]
+    rng = np.random.default_rng(7)
+    texts = [(scams[int(rng.integers(len(scams)))]
+              if rng.uniform() < 0.05
+              else benign[int(rng.integers(len(benign)))])
+             for _ in range(n_msgs)]
+
+    pipe = build_pipeline(batch_size, model="lr")
+    hook = make_stream_explain_hook(OnPodBackend.from_model(lm),
+                                    max_tokens=max_tokens)
+
+    def one_run(with_hook: bool):
+        broker = InProcessBroker(num_partitions=3)
+        producer = broker.producer()
+        for i, t in enumerate(texts):
+            producer.produce("customer-dialogues-raw",
+                             json.dumps({"text": t, "id": i}).encode(),
+                             key=str(i).encode())
+        engine = StreamingClassifier(
+            pipe, broker.consumer(["customer-dialogues-raw"], "bench-x"),
+            broker.producer(), "dialogues-classified",
+            batch_size=batch_size, max_wait=0.01,
+            explain_batch_fn=hook if with_hook else None)
+        stats = engine.run(max_messages=n_msgs, idle_timeout=10.0)
+        assert stats.processed == n_msgs, stats.as_dict()
+        explained = sum(1 for m in broker.messages("dialogues-classified")
+                        if b'"analysis"' in m.value)
+        return stats, explained
+
+    one_run(True)                       # warm: per-bucket prefill/decode compiles
+    stats_x, explained = one_run(True)
+    stats_0, _ = one_run(False)
+    return {
+        "n_msgs": n_msgs, "scam_fraction": 0.05, "max_tokens": max_tokens,
+        "explained": explained,
+        "flagged_explanations_per_s": round(explained / stats_x.elapsed, 2),
+        "msgs_per_s_with_explain": round(stats_x.msgs_per_sec, 1),
+        "msgs_per_s_baseline": round(stats_0.msgs_per_sec, 1),
+    }
 
 
 def main() -> None:

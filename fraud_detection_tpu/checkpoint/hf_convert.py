@@ -374,8 +374,10 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
                 os.replace(tmp, meta_f)
             except OSError:
                 # Unwritable/full disk: the cache is an optimization only —
-                # but a partial multi-GB .tmp must not pin the disk space.
-                for leftover in (cache_f + ".tmp", meta_f + ".tmp"):
+                # but partial multi-GB files must not pin the disk space.
+                # cache_f itself is dead weight too when the meta marker
+                # write failed (nothing will ever validate it).
+                for leftover in (cache_f + ".tmp", meta_f + ".tmp", cache_f):
                     try:
                         os.unlink(leftover)
                     except OSError:
