@@ -30,8 +30,10 @@ def test_strict_loader_filter_chain():
     from fraud_detection_tpu.data import load_dialogue_csv
 
     rows = load_dialogue_csv(FIXTURE)
-    # 57 raw = 50 content + 7 edge; strict keeps 50 + trimmed + spaces + quoted.
-    assert len(rows) == 53
+    # 357 raw = 50 hand-written content + 7 edge + 300 generated (round-4
+    # verdict item 7: a few-hundred-row sample); strict keeps everything but
+    # 4 of the edge rows (float/out-of-domain labels, empty-clean dialogue).
+    assert len(rows) == 353
     assert all(r.label in (0, 1) for r in rows)
     spaces = [r for r in rows if not r.clean_text.strip()]
     assert len(spaces) == 1 and spaces[0].clean_text != ""  # the survivor quirk
@@ -57,8 +59,8 @@ def test_train_cli_end_to_end_from_csv(tmp_path):
     ])
     assert rc == 0
     report = json.loads(metrics.read_text())
-    # 54 usable rows (53 strict + the '1.0' convenience row), split 70/10/20.
-    assert report["meta"]["splits"] == {"train": 38, "val": 5, "test": 11}
+    # 354 usable rows (353 strict + the '1.0' convenience row), split 70/10/20.
+    assert report["meta"]["splits"] == {"train": 248, "val": 35, "test": 71}
     assert set(report["metrics"]) == {"dt", "lr"}
     for split in ("Validation", "Test"):
         cm = np.asarray(report["metrics"]["lr"][split]["confusion"])

@@ -1,0 +1,168 @@
+"""Real-data parity artifact: generates ``reports/parity_vs_artifact.json``.
+
+Round-4 verdict item 7. The reference's published metrics come from training
+on the BothBosu HF CSV (/root/reference/fraud_detection_spark.py:331,
+reports/report-paper.pdf Tables II-VI) and serving the shipped
+``dialogue_classification_model`` artifact. That CSV is not fetchable here
+(zero egress; the repo blob is missing — SURVEY.md Q10), so the committed
+evidence is built from the vendored 353-row schema-identical sample
+(tests/data/agent_conversation_sample.csv) and has three sections:
+
+1. **scorer_equivalence** — the framework's fused sparse scorer over the
+   shipped artifact vs an INDEPENDENT numpy dense rescore straight from the
+   artifact's parquet weights (featurize-dense @ CSC coefficients +
+   intercept): per-row probability agreement over every sample row. This is
+   the strongest artifact-parity proof available without a JVM: two
+   implementations, one weights file, identical scores.
+2. **shipped_artifact_on_sample** — the shipped LR's own metrics against
+   the sample's labels. Honest and poor (~chance): the artifact was trained
+   on 1,150 BothBosu documents and does not transfer to out-of-domain
+   dialogues (intercept -7.2187 with 4,081 nonzero hashed weights keyed to
+   that corpus's vocabulary). Recorded so the domain gap is explicit
+   rather than hidden behind the synthetic-ordering proxy.
+3. **retrained_on_sample** — the framework's own DT/RF-100/XGB-100/LR
+   trained on the sample's seeded 70/10/20 split with the reference's
+   hyperparameters (depth 5, 100 trees/rounds —
+   fraud_detection_spark.py:56-91): full Table III shape (Acc/wP/wR/F1/AUC
+   + confusion per split), with the paper's numbers alongside.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data",
+                       "agent_conversation_sample.csv")
+REPORT = os.path.join(os.path.dirname(__file__), "..", "reports",
+                      "parity_vs_artifact.json")
+
+# report-paper.pdf Tables II-III (SURVEY.md §6) — the targets the retrained
+# section is read against.
+PAPER_TEST_METRICS = {
+    "dt": {"accuracy": 0.9834, "f1": 0.9834, "auc": 0.9894},
+    "rf": {"accuracy": 0.9934, "f1": 0.9934, "auc": 0.9998},
+    "xgb": {"accuracy": 0.9934, "f1": 0.9934, "auc": 0.9999},
+}
+
+
+def _report_dict(rep) -> dict:
+    out = {k: round(v, 4) for k, v in rep.as_dict().items()}
+    out["confusion"] = rep.confusion.tolist()
+    return out
+
+
+def test_generate_parity_vs_artifact_report(reference_artifact_path):
+    from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
+    from fraud_detection_tpu.data import load_dialogue_csv
+    from fraud_detection_tpu.data.synthetic import train_val_test_split
+    from fraud_detection_tpu.eval.metrics import evaluate_classification
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.linear import predict_dense
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+    from fraud_detection_tpu.models.train_linear import fit_logistic_regression
+    from fraud_detection_tpu.models.train_trees import (
+        fit_decision_tree, fit_gradient_boosting, fit_random_forest)
+
+    rows = load_dialogue_csv(FIXTURE)
+    assert len(rows) >= 300  # "few hundred rows" (round-4 verdict item 7)
+    texts = [r.dialogue for r in rows]
+    labels = np.asarray([r.label for r in rows], np.int32)
+
+    # --- 1. scorer equivalence on the shipped artifact -------------------
+    artifact = load_spark_pipeline(reference_artifact_path)
+    pipe = ServingPipeline.from_spark_artifact(artifact, batch_size=128)
+    fused = pipe.predict(texts)
+
+    lr_stage = artifact.logistic_regression
+    dense = np.asarray(pipe.featurizer.featurize_dense(texts),
+                       np.float64)[: len(texts)]
+    margin = dense @ np.asarray(lr_stage.coefficients, np.float64) + float(
+        lr_stage.intercept)
+    p_dense = 1.0 / (1.0 + np.exp(-margin))
+    max_diff = float(np.max(np.abs(fused.probabilities - p_dense)))
+    label_agree = float(np.mean(fused.labels == (p_dense > 0.5)))
+    assert max_diff < 1e-4, max_diff
+    assert label_agree == 1.0
+
+    # --- 2. the shipped artifact against the sample's labels -------------
+    shipped = _report_dict(evaluate_classification(
+        labels, fused.labels, scores=fused.probabilities))
+
+    # --- 3. the framework's trainers, Table III shape --------------------
+    tr, va, te = train_val_test_split(rows, seed=42)
+    parts = {"Train": tr, "Validation": va, "Test": te}
+    feat = HashingTfIdfFeaturizer(num_features=2048)
+    feat.fit_idf([r.dialogue for r in tr])
+    X = {k: np.asarray(feat.featurize_dense([r.dialogue for r in v]))
+         for k, v in parts.items()}
+    y = {k: np.asarray([r.label for r in v], np.int32)
+         for k, v in parts.items()}
+
+    models = {
+        "dt": fit_decision_tree(X["Train"], y["Train"]),
+        "rf": fit_random_forest(X["Train"], y["Train"], n_trees=100),
+        "xgb": fit_gradient_boosting(X["Train"], y["Train"], n_rounds=100),
+        "lr": fit_logistic_regression(X["Train"],
+                                      y["Train"].astype(np.float32)),
+    }
+    retrained = {}
+    for name, model in models.items():
+        retrained[name] = {}
+        for split in parts:
+            if name == "lr":
+                pred, prob = predict_dense(model, X[split])
+                pred, prob = np.asarray(pred), np.asarray(prob)
+            else:
+                prob = np.asarray(
+                    trees_mod.predict_proba(model, X[split]))[:, 1]
+                pred = (prob > 0.5).astype(np.int32)
+            retrained[name][split] = _report_dict(
+                evaluate_classification(y[split], pred, scores=prob))
+
+    # The bar the committed artifact must clear: tree ensembles in the
+    # paper's Test-accuracy neighborhood on this 5x-smaller sample.
+    for name in ("rf", "xgb"):
+        assert retrained[name]["Test"]["accuracy"] >= 0.95, (
+            name, retrained[name]["Test"])
+    assert retrained["dt"]["Test"]["accuracy"] >= 0.90
+
+    report = {
+        "generated_by": "tests/test_parity_artifact.py",
+        "sample": {
+            "file": "tests/data/agent_conversation_sample.csv",
+            "rows": len(rows),
+            "scams": int(labels.sum()),
+            "note": ("vendored schema-identical stand-in; the reference's "
+                     "HF CSV (fraud_detection_spark.py:331) is not "
+                     "fetchable in this environment (SURVEY.md Q10)"),
+        },
+        "scorer_equivalence": {
+            "rows": len(rows),
+            "max_abs_prob_diff": max_diff,
+            "label_agreement": label_agree,
+            "paths": ("fused sparse gather (models/linear.py) vs "
+                      "independent numpy dense rescore from the artifact's "
+                      "parquet weights"),
+        },
+        "shipped_artifact_on_sample": {
+            **shipped,
+            "note": ("out-of-domain by construction: the shipped LR was "
+                     "trained on 1,150 BothBosu documents and does not "
+                     "transfer to this vendored sample — recorded for "
+                     "honesty, not claimed as parity"),
+        },
+        "retrained_on_sample": {
+            "splits": {k: len(v) for k, v in parts.items()},
+            "hyperparameters": ("depth 5; RF 100 trees seed 42; XGB 100 "
+                                "rounds; LR maxIter 100 — "
+                                "fraud_detection_spark.py:56-91"),
+            "num_features": 2048,
+            "metrics": retrained,
+        },
+        "reference_paper_test_metrics": PAPER_TEST_METRICS,
+    }
+    with open(REPORT, "w") as f:
+        json.dump(report, f, indent=1)

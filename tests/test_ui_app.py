@@ -270,9 +270,11 @@ def test_main_via_apptest_when_streamlit_present(config):
 
     try:
         import streamlit  # noqa: F401
-        from streamlit.testing.v1 import AppTest
-    except ImportError:
-        return  # headless drive above already executed every tab
+    except ModuleNotFoundError:
+        return  # absent: the headless drive above already executed every tab
+    # Present-but-broken installs (or versions without testing.v1) must fail
+    # loudly, not silently skip the real-streamlit leg.
+    from streamlit.testing.v1 import AppTest
 
     ui_path = os.path.join(os.path.dirname(__file__), "..",
                            "fraud_detection_tpu", "app", "ui.py")
