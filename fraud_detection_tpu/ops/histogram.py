@@ -23,7 +23,7 @@ exercises them; ``auto_interpret()`` picks per backend.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Tuple
 
 import jax
@@ -35,6 +35,33 @@ from jax.experimental.pallas import tpu as pltpu
 
 def auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+@lru_cache(maxsize=None)
+def _host_callbacks_supported() -> bool:
+    """Some PJRT backends (the tunneled axon TPU) cannot EXECUTE programs
+    containing host callbacks (jax.debug.print et al.) — and reject them at
+    run time, not compile time. Probed once with a never-taken cond, under
+    ``ensure_compile_time_eval`` so the probe runs eagerly even when called
+    mid-trace (a plain call there would inline the callback into the outer
+    program: debug effects defeat DCE, poisoning the caller's jit). Where
+    False, the exact_int8 contract diagnostic degrades to silent saturation
+    (the kernel's clip still prevents int8 wraparound)."""
+    try:
+        def probe(x):
+            jax.lax.cond(x > 0,
+                         lambda v: jax.debug.print("{v}", v=v),
+                         lambda v: None, x)
+            return x
+
+        with jax.ensure_compile_time_eval():
+            # Host fetch, not block_until_ready: the axon tunnel acks
+            # dispatches asynchronously, so only materializing the value
+            # guarantees the runtime's rejection surfaces inside this try.
+            float(jax.device_get(jax.jit(probe)(jnp.zeros(()))))
+        return True
+    except Exception:  # noqa: BLE001 — any refusal means "no"
+        return False
 
 
 # Default tile grid — OWNED here; the trainers' pre-padding imports these so
@@ -197,7 +224,7 @@ def node_feature_bin_histogram_multi(
     b_of_c = (jnp.arange(feature_tile * n_bins, dtype=jnp.int32)
               // feature_tile)[None, :]
 
-    if exact_int8:
+    if exact_int8 and _host_callbacks_supported():
         # Loud contract check: the int8 MXU path is exact only for
         # stats*weight products in [0, 127]. The exact per-row bound
         # max_r(max_k stats[k,r] * max_t w[t,r]) is as cheap as the global
