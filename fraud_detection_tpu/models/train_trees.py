@@ -34,6 +34,7 @@ partitioning rule — see resolve_config).
 
 from __future__ import annotations
 
+import math
 import weakref
 from dataclasses import dataclass
 from functools import partial
@@ -251,12 +252,35 @@ def _route_rows(bins, local, seg_valid, node, best_f, best_b, do_split,
     local/seg_valid/node (T, N); best_f/best_b/do_split (T, L).
     Returns (node, active), each (T, N)."""
     row_local = jnp.clip(local, 0, width - 1)
-    row_f = jnp.take_along_axis(best_f, row_local, axis=1)
     row_b = jnp.take_along_axis(best_b, row_local, axis=1)
     row_split = jnp.take_along_axis(do_split, row_local, axis=1)
-    row_bin = jax.vmap(
-        lambda rf: jnp.take_along_axis(bins, rf[:, None], axis=1)[:, 0])(row_f)
-    go_left = row_bin <= row_b
+    # Per-NODE column extraction instead of a per-row feature gather: every
+    # row at node l reads the same split column best_f[t, l], so ONE
+    # (N, F) @ (F, T*L) one-hot matmul pulls all needed bin columns (exact:
+    # bin ids < 32 are exact in bf16 operands / f32 accumulation) and a
+    # vectorized one-hot select picks each row's own node column. The
+    # row-wise take_along_axis this replaces lowered to a serialized TPU
+    # gather — ~25ms per level at bench shape, the forest builder's single
+    # largest op (profiled r5); the matmul reads bins once at ~1ms.
+    t, n = local.shape
+    if t * n * width * 4 > 256 * 1024 * 1024:
+        # Same 256MB dense-transient guard as _node_totals: deep/wide
+        # configs fall back to the row-wise gather (slower, O(T*N) memory).
+        row_f = jnp.take_along_axis(best_f, row_local, axis=1)
+        row_bin = jax.vmap(
+            lambda rf: jnp.take_along_axis(bins, rf[:, None], axis=1)[:, 0]
+        )(row_f).astype(jnp.float32)
+    else:
+        f = bins.shape[1]
+        onehot_f = (best_f.reshape(-1)[None, :]
+                    == jnp.arange(f)[:, None]).astype(jnp.bfloat16)  # (F, T*L)
+        cols = jax.lax.dot_general(
+            bins.astype(jnp.bfloat16), onehot_f, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                      # (N, T*L)
+        cols = cols.reshape(n, *best_f.shape).transpose(1, 0, 2)
+        sel = row_local[:, :, None] == jnp.arange(width)[None, None, :]
+        row_bin = jnp.sum(jnp.where(sel, cols, 0.0), axis=2)         # (T, N)
+    go_left = row_bin <= row_b.astype(row_bin.dtype)
     new_node = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
     node = jnp.where(seg_valid & row_split, new_node, node)
     return node, seg_valid & row_split
@@ -529,6 +553,33 @@ def _build_forest_chunk_pallas(bins, stats, row_weights, mask_keys,
     return feature, split_bin, left_child, right_child, node_stats
 
 
+# Poisson(1) inverse CDF, support 0..12: P(k > 12) ~ 6e-11 is below f32
+# uniform resolution, so searchsorted(u) IS the exact Poisson(1) quantile
+# function at the precision the draw sees.
+_POISSON1_CDF = np.cumsum(
+    [math.exp(-1.0) / math.factorial(k) for k in range(13)]).astype(np.float32)
+
+
+def _poisson1(key, shape) -> jax.Array:
+    """Poisson(1) bootstrap weights via inverse-CDF lookup.
+
+    ``jax.random.poisson``'s general-rate rejection sampler costs ~69ms per
+    (8, 100k) draw on v5e — 8.6ms/tree of the forest's device critical path
+    (a third of the fused chunk program itself). At rate 1 the distribution
+    has 13 reachable outcomes, so one uniform draw + a 13-entry searchsorted
+    replaces it, trivially within the exact-int8 histogram contract (max
+    weight 13 << 127). NOTE: this changes the bootstrap PRNG stream —
+    same-seed forests differ from builds before this change, and the
+    resume fingerprint's ``bootstrap_sampler`` key refuses pre-change
+    snapshots (see ROUND5_NOTES.md)."""
+    u = jax.random.uniform(key, shape)
+    # Vectorized quantile: count CDF entries below u (a 13-wide broadcast
+    # compare-sum; jnp.searchsorted's default method lowers to a serial
+    # scan, which benchmarked SLOWER than the rejection sampler).
+    cdf = jnp.asarray(_POISSON1_CDF)
+    return jnp.sum(u[..., None] > cdf, axis=-1).astype(jnp.float32)
+
+
 def _edges_to_thresholds(edges: np.ndarray, feature: np.ndarray, split_bin: np.ndarray):
     """Map (feature, bin) splits to serve-time thresholds: edges[f][b]."""
     thr = np.zeros(feature.shape, np.float32)
@@ -747,9 +798,15 @@ def fit_random_forest(
         # bootstrap_rows: the Poisson draw runs over the PADDED row count,
         # so the padded shape is part of the PRNG stream identity — a
         # snapshot from a run with different padding must refuse to resume.
+        # bootstrap_sampler: the weight PRNG stream's identity — r5 swapped
+        # jax.random.poisson for the inverse-CDF sampler, so a pre-swap
+        # snapshot must refuse to resume (a mixed-stream forest would not
+        # be bit-identical to an uninterrupted same-seed build).
         extra = {"seed": seed, "tree_chunk": tree_chunk,
                  "feature_subset": feature_subset, "num_classes": num_classes,
-                 "bootstrap_rows": n_padded, **ts.mesh_extra(mesh)}
+                 "bootstrap_rows": n_padded,
+                 "bootstrap_sampler": "poisson1-icdf",
+                 **ts.mesh_extra(mesh)}
         fingerprint = ts.data_fingerprint(
             cfg.__dict__, edges, n, y=np.asarray(y), extra=extra)
 
@@ -792,8 +849,7 @@ def fit_random_forest(
         # second program shape (which costs far more than the few discarded
         # trees); extras are sliced away. Same rule on resume, so resumed
         # forests stay bit-identical to uninterrupted ones.
-        weights = jax.random.poisson(
-            wkey, 1.0, (tree_chunk, n_padded)).astype(jnp.float32)
+        weights = _poisson1(wkey, (tree_chunk, n_padded))
         weights = weights * base_weights[None, :]  # zero out mesh padding rows
         mask_keys = jax.random.split(mkey, tree_chunk * (cfg.max_depth + 1)).reshape(
             tree_chunk, cfg.max_depth + 1, -1)
