@@ -382,7 +382,20 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
                         os.unlink(leftover)
                     except OSError:
                         pass
-    params = {k: jnp.asarray(v, cfg.dtype) for k, v in params_np.items()}
+    def _to_device(v: np.ndarray) -> "jnp.ndarray":
+        # Memmap-backed tensors (the cached path) materialize to RAM first:
+        # uploading straight from the memmap page-faults through the device
+        # transfer (measured 528s for 5GB over the TPU tunnel vs ~35s of
+        # sequential disk read + upload).
+        base = v
+        while isinstance(base, np.ndarray):
+            if isinstance(base, np.memmap):
+                v = np.array(v)
+                break
+            base = base.base
+        return jnp.asarray(v, cfg.dtype)
+
+    params = {k: _to_device(v) for k, v in params_np.items()}
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
     if tokenizer == "byte":
