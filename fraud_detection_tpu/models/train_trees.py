@@ -203,14 +203,19 @@ def _child_totals(hist, totals, best_f, best_b, do_split):
 
     hist (..., L, F, NB, K); totals (..., L, K); best_f/best_b/do_split
     (..., L) -> (..., 2L, K)."""
-    f_axis = hist.ndim - 3
-    hist_f = jnp.take_along_axis(
-        hist, best_f[..., None, None, None], axis=f_axis)
-    hist_f = jnp.squeeze(hist_f, axis=f_axis)             # (..., L, NB, K)
+    # One-hot contractions instead of take_along_axis: TPU lowers these
+    # small-table gathers to kCustom scans over the full (.., L, F, NB, K)
+    # slab (~6ms/level profiled); the masked reductions are single
+    # vectorized passes.
+    f = hist.shape[-3]
+    onehot_f = (best_f[..., None] == jnp.arange(f)).astype(hist.dtype)
+    hist_f = jnp.einsum("...lfbk,...lf->...lbk", hist, onehot_f,
+                        precision=jax.lax.Precision.HIGHEST)
     cum_f = jnp.cumsum(hist_f, axis=-2)
-    left = jnp.take_along_axis(
-        cum_f, best_b[..., None, None], axis=-2)
-    left = jnp.squeeze(left, axis=-2)                     # (..., L, K)
+    nb = hist.shape[-2]
+    onehot_b = (best_b[..., None] == jnp.arange(nb)).astype(hist.dtype)
+    left = jnp.einsum("...lbk,...lb->...lk", cum_f, onehot_b,
+                      precision=jax.lax.Precision.HIGHEST)   # (..., L, K)
     right = totals - left
     pair = jnp.stack([left, right], axis=-2)              # (..., L, 2, K)
     pair = pair * do_split[..., None, None]
@@ -226,7 +231,15 @@ def _select_splits(hist, totals, mask, cfg: TreeTrainConfig):
     (T, L) — flat first-occurrence argmax over (F, NB-1) per node.
     """
     nb = cfg.n_bins
-    cum = jnp.cumsum(hist, axis=3)                        # left stats per bin
+    # Inclusive bin prefix as an upper-triangular matmul: jnp.cumsum lowers
+    # to a log-step scan (~log2(NB) full passes over the (T, L, F, NB, K)
+    # slab per level), while the (NB, NB) contraction is one MXU pass —
+    # the same formulation the Pallas gain kernel uses in-tile. HIGHEST
+    # precision keeps the f32 count/grad accumulation exact at these
+    # magnitudes (a default bf16 dot would round counts above 2^8).
+    tri = (jnp.arange(nb)[:, None] <= jnp.arange(nb)[None, :]).astype(hist.dtype)
+    cum = jnp.einsum("tlfbk,bc->tlfck", hist, tri,
+                     precision=jax.lax.Precision.HIGHEST)
     total_b = totals[:, :, None, None, :]
     if cfg.criterion == "gini":
         gain = _gini_gain(cum, total_b)                   # (T, L, F, NB)
@@ -252,8 +265,6 @@ def _route_rows(bins, local, seg_valid, node, best_f, best_b, do_split,
     local/seg_valid/node (T, N); best_f/best_b/do_split (T, L).
     Returns (node, active), each (T, N)."""
     row_local = jnp.clip(local, 0, width - 1)
-    row_b = jnp.take_along_axis(best_b, row_local, axis=1)
-    row_split = jnp.take_along_axis(do_split, row_local, axis=1)
     # Per-NODE column extraction instead of a per-row feature gather: every
     # row at node l reads the same split column best_f[t, l], so ONE
     # (N, F) @ (F, T*L) one-hot matmul pulls all needed bin columns (exact:
@@ -265,12 +276,22 @@ def _route_rows(bins, local, seg_valid, node, best_f, best_b, do_split,
     t, n = local.shape
     if t * n * width * 4 > 256 * 1024 * 1024:
         # Same 256MB dense-transient guard as _node_totals: deep/wide
-        # configs fall back to the row-wise gather (slower, O(T*N) memory).
+        # configs fall back to the row-wise gathers (slower, O(T*N) memory —
+        # no (T, N, width) one-hot anywhere on this branch).
+        row_b = jnp.take_along_axis(best_b, row_local, axis=1)
+        row_split = jnp.take_along_axis(do_split, row_local, axis=1)
         row_f = jnp.take_along_axis(best_f, row_local, axis=1)
         row_bin = jax.vmap(
             lambda rf: jnp.take_along_axis(bins, rf[:, None], axis=1)[:, 0]
         )(row_f).astype(jnp.float32)
     else:
+        # sel: each row's one-hot over this level's nodes — drives the
+        # per-node column select AND the small-table lookups (row_b,
+        # row_split), which as take_along_axis lowered to ~5ms kCustom
+        # gathers over (T, N) on TPU (profiled r5).
+        sel = row_local[:, :, None] == jnp.arange(width)[None, None, :]
+        row_b = jnp.sum(jnp.where(sel, best_b[:, None, :], 0), axis=2)
+        row_split = jnp.any(sel & do_split[:, None, :], axis=2)
         f = bins.shape[1]
         onehot_f = (best_f.reshape(-1)[None, :]
                     == jnp.arange(f)[:, None]).astype(jnp.bfloat16)  # (F, T*L)
@@ -278,7 +299,6 @@ def _route_rows(bins, local, seg_valid, node, best_f, best_b, do_split,
             bins.astype(jnp.bfloat16), onehot_f, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                      # (N, T*L)
         cols = cols.reshape(n, *best_f.shape).transpose(1, 0, 2)
-        sel = row_local[:, :, None] == jnp.arange(width)[None, None, :]
         row_bin = jnp.sum(jnp.where(sel, cols, 0.0), axis=2)         # (T, N)
     go_left = row_bin <= row_b.astype(row_bin.dtype)
     new_node = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
@@ -922,7 +942,12 @@ def fit_gradient_boosting(
 
         if checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
-        extra = {"base_score": base_score, **ts.mesh_extra(mesh)}
+        # gain_scan: r5 changed the float-prefix summation order (cumsum ->
+        # triangular matmul) — grad/hess gains can tie-break differently, so
+        # pre-change boosting snapshots must refuse to resume (a mixed-math
+        # ensemble would not be bit-identical to an uninterrupted run).
+        extra = {"base_score": base_score, "gain_scan": "tri-matmul",
+                 **ts.mesh_extra(mesh)}
         fingerprint = ts.data_fingerprint(
             cfg.__dict__, edges, n, y=np.asarray(y), extra=extra)
 
