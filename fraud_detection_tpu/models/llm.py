@@ -586,8 +586,15 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             valid_from: Optional[jax.Array] = None,
             seq_mesh: Optional[Mesh] = None,
             sp_impl: str = "ring",
-            use_flash: Optional[bool] = None) -> Tuple[jax.Array, Optional[Dict]]:
+            use_flash: Optional[bool] = None,
+            logits_last_only: bool = False) -> Tuple[jax.Array, Optional[Dict]]:
     """Logits for a token batch (B, T) -> (B, T, V).
+
+    ``logits_last_only``: emit logits for the LAST position only —
+    (B, 1, V). The decode prefill uses this: full-sequence logits cost
+    B*T*V f32 (a 64-row batch of ~1000-token transcripts would materialize
+    ~63GB and OOM the chip) and T times the output-head FLOPs, while
+    sampling only ever reads position -1.
 
     Three modes:
       * full-sequence (kv_cache None, seq_mesh None): causal attention —
@@ -667,6 +674,8 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         x = x + _mm("btF,FD->btD", gate * up, params[f"l{l}.w_down"], cfg.dtype)
 
     x = rms_norm(x, params["ln_f"], cfg.rms_eps)
+    if logits_last_only:
+        x = x[:, -1:]
     head = params["lm_head"] if not cfg.tie_embeddings else params["embed"]
     if isinstance(head, Q8):
         # (V, 1) per-row scale applied to the f32 logits, same output-side
@@ -720,7 +729,7 @@ def _generate_batch_jit(params: Params, prompt: jax.Array, prompt_len: jax.Array
     positions = jnp.arange(Tp)[None, :] - valid_from[:, None]  # real idx; <0 on pads
     logits, cache = forward(params, prompt, cfg, positions=positions,
                             kv_cache=cache, cache_len=jnp.int32(0),
-                            valid_from=valid_from)
+                            valid_from=valid_from, logits_last_only=True)
     last = logits[:, -1]                                       # every row ends at Tp-1
     sample = partial(_sample_token, temperature)
     out0 = jnp.full((B, max_new), cfg.EOS, jnp.int32)

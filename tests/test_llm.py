@@ -408,3 +408,15 @@ def test_int8_tensor_parallel_both_orders(params):
     qlm = LanguageModel(CFG, q_then_s)
     toks_out = qlm.generate_tokens(qlm.tokenizer.encode("urgent"), max_new_tokens=4)
     assert toks_out.shape == (4,)
+
+
+def test_logits_last_only_matches_full_forward(params):
+    """The decode prefill's last-position-only mode is exactly the full
+    forward's final position (full-sequence logits at B=64 x ~1000-token
+    prompts would materialize ~63GB — the OOM the mode exists to avoid)."""
+    toks = jnp.asarray(np.arange(20, dtype=np.int32)[None, :] % 250)
+    full, _ = forward(params, toks, CFG)
+    last, _ = forward(params, toks, CFG, logits_last_only=True)
+    assert last.shape == (1, 1, CFG.vocab_size)
+    np.testing.assert_allclose(np.asarray(last[:, 0]), np.asarray(full[:, -1]),
+                               rtol=1e-5, atol=1e-5)
