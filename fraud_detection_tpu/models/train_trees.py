@@ -443,14 +443,19 @@ def _build_tree(bins, stats, row_weights, feature_mask_keys, cfg: TreeTrainConfi
             best_f[None], best_b[None], do_split[None], width)
         node, active = node1[0], active1[0]
 
-    return feature, split_bin, left_child, right_child, node_stats
+    # ``node`` is each ACTIVE row's final leaf heap position — the boosting
+    # round reuses it instead of re-traversing (a per-row gather walk).
+    # Weight-0 rows (tile padding, mesh padding) never route and stay at 0;
+    # their margins are inert (stats are weight-zeroed before every
+    # histogram), so this costs nothing downstream.
+    return feature, split_bin, left_child, right_child, node_stats, node
 
 
 @partial(jax.jit, static_argnames=("cfg", "use_feature_mask", "true_features"))
 def _build_tree_jit(bins, stats, row_weights, mask_keys, cfg: TreeTrainConfig,
                     use_feature_mask: bool, true_features: Optional[int] = None):
     keys = mask_keys if use_feature_mask else None
-    return _build_tree(bins, stats, row_weights, keys, cfg, true_features)
+    return _build_tree(bins, stats, row_weights, keys, cfg, true_features)[:5]
 
 
 @partial(jax.jit, static_argnames=("cfg", "use_feature_mask", "true_features"))
@@ -477,7 +482,7 @@ def _build_tree_chunk(bins, stats, row_weights, mask_keys,
     outs = [
         _build_tree(bins, stats, row_weights[i],
                     mask_keys[i] if use_feature_mask else None, cfg,
-                    true_features)
+                    true_features)[:5]     # drop the per-row leaf positions
         for i in range(row_weights.shape[0])
     ]
     return tuple(jnp.stack(parts) for parts in zip(*outs))
@@ -931,7 +936,6 @@ def fit_gradient_boosting(
         base_score = float(np.log(prior / (1.0 - prior)))
     edges, bins, yf, _, weights, n = _prepare_inputs(X, y, 2, cfg, edges, mesh)
     n_padded = bins.shape[0]
-    dummy_keys = jax.random.split(jax.random.PRNGKey(0), cfg.max_depth + 1)
 
     margin = jnp.full((n_padded,), base_score, jnp.float32)
     feats, sbins, lefts, rights, leaf_vals = [], [], [], [], []
@@ -994,7 +998,7 @@ def fit_gradient_boosting(
 
     for r in range(start_round, n_rounds):
         f_, b_, l_, r_, values, values2, row_leaf = _boost_round(
-            margin, bins, yf, weights, dummy_keys, cfg)
+            margin, bins, yf, weights, cfg)
         # The update runs as the SAME separate program the resume replay
         # uses: fusing it into _boost_round lets XLA contract the gather-add
         # differently (fma) and break bit-identical resume.
@@ -1020,7 +1024,7 @@ def _update_margin(margin, row_node, values):
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _boost_round(margin, bins, yf, weights, dummy_keys, cfg: TreeTrainConfig):
+def _boost_round(margin, bins, yf, weights, cfg: TreeTrainConfig):
     """One boosting round as a single program: gradients, tree build, leaf
     values, row routing. Fusing these keeps dispatches per round to two
     (this + ``_update_margin``) — per-launch overhead is material when the
@@ -1028,9 +1032,14 @@ def _boost_round(margin, bins, yf, weights, dummy_keys, cfg: TreeTrainConfig):
     p = jax.nn.sigmoid(margin)
     g, h = p - yf, p * (1.0 - p)
     stats = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
-    f_, b_, l_, r_, s_ = _build_tree_jit(bins, stats, weights, dummy_keys, cfg, False)
+    # The builder's final routing state IS each row's leaf position —
+    # re-traversing with _row_leaves costs a per-row gather walk per round
+    # (TPU serializes row-wise gathers; ~the same pathology removed from
+    # _route_rows in r5). The resume REPLAY still uses _row_leaves (only
+    # the trees are on disk); weight-0 padding rows are the one divergence
+    # (builder leaves them at the root) and their margins are inert.
+    f_, b_, l_, r_, s_, row_leaf = _build_tree(bins, stats, weights, None, cfg)
     values = -s_[:, 0] / (s_[:, 1] + cfg.reg_lambda) * cfg.learning_rate
-    row_leaf = _row_leaves(bins, f_, b_, l_, r_, cfg.max_depth)
     # values twice: flat for the margin update, (M, 1) for the snapshot
     # accumulator — shaping in-program avoids a per-round dispatch.
     return f_, b_, l_, r_, values, values[:, None], row_leaf
