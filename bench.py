@@ -353,14 +353,18 @@ def _attribution(tracer) -> dict:
 
 
 def tree_streaming_bench(texts, batch_size: int, depth: int,
-                         n_msgs: int = 10_000) -> dict:
+                         n_msgs: int = 10_000, lr_pipe=None) -> dict:
     """Streaming throughput for the tree families through the raw-JSON path
     (native JSON encode -> fused scatter-to-dense + traversal program).
 
     Self-explaining decomposition (round-3 verdict item 2): per model the
     artifact records the compile/warm wall separately from the steady-state
     runs, and every run's rate — so a contended run is visible as variance
-    in the committed JSON instead of silently dragging a single number."""
+    in the committed JSON instead of silently dragging a single number.
+    ``lr_pipe`` (the already-warm headline pipeline) adds an ADJACENT LR
+    control run per model: same minute, same host regime — the committed
+    answer to whether a tree-vs-LR gap in this artifact is traversal cost
+    or contention (same-session probes measure them at parity)."""
     from fraud_detection_tpu.utils.tracing import Tracer
 
     out = {}
@@ -380,6 +384,15 @@ def tree_streaming_bench(texts, batch_size: int, depth: int,
                 best_attr = _attribution(tracer)
         out[model] = {"msgs_per_s": max(rates), "compile_s": round(compile_s, 1),
                       "runs": rates, "attribution": best_attr}
+        if lr_pipe is not None:
+            # Best-of-3 like the tree runs (a single control run would be
+            # exposed to exactly the contention it exists to rule out);
+            # every run recorded so the regime is readable either way.
+            ctl = [round(_stream_run(lr_pipe, texts, batch_size, depth,
+                                     n_msgs).msgs_per_sec, 1)
+                   for _ in range(3)]
+            out[model]["lr_control"] = max(ctl)
+            out[model]["lr_control_runs"] = ctl
     return out
 
 
@@ -898,7 +911,8 @@ def main() -> None:
         # 56-91); record it in the same line so the driver's artifact
         # carries the evidence, not just README prose.
         line["tree_streaming"] = leg(lambda: tree_streaming_bench(
-            texts, batch_size, depth, n_msgs=min(n_msgs, 10_000)))
+            texts, batch_size, depth, n_msgs=min(n_msgs, 10_000),
+            lr_pipe=pipe))
     if os.environ.get("BENCH_TRAIN", "1") != "0":
         line["training"] = leg(training_bench)
     # LLM leg: default-on only where it's fast (real TPU). Off-TPU the
