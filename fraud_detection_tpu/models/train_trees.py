@@ -184,7 +184,7 @@ def _node_totals(stats, seg_node, width: int, batch_factor: int = 1):
     chunk pass the chunk width so the threshold sees the REAL materialized
     size (T, width+1, N), not the per-tree slice."""
     n = stats.shape[0]
-    if batch_factor * (width + 1) * n * 4 > 256 * 1024 * 1024:
+    if batch_factor * (width + 1) * n * 4 > _DENSE_TRANSIENT_LIMIT:
         return jax.ops.segment_sum(stats, seg_node, num_segments=width + 1)[:-1]
     onehot = (seg_node[None, :] == jnp.arange(width + 1)[:, None]).astype(
         stats.dtype)                                       # (L+1, N)
@@ -256,8 +256,12 @@ def _select_splits(hist, totals, mask, cfg: TreeTrainConfig):
             (best % (nb - 1)).astype(jnp.int32), best_gain)
 
 
+#: Dense-transient budget shared by _route_rows and _node_totals guards.
+_DENSE_TRANSIENT_LIMIT = 256 * 1024 * 1024
+
+
 def _route_rows(bins, local, seg_valid, node, best_f, best_b, do_split,
-                width: int):
+                width: int, dense_limit: int = _DENSE_TRANSIENT_LIMIT):
     """Row re-routing for one level, batched over a leading tree axis:
     gather each row's node's chosen split, compare bin ids, descend.
     Rows whose node became a leaf stop descending and drop out of deeper
@@ -274,7 +278,7 @@ def _route_rows(bins, local, seg_valid, node, best_f, best_b, do_split,
     # gather — ~25ms per level at bench shape, the forest builder's single
     # largest op (profiled r5); the matmul reads bins once at ~1ms.
     t, n = local.shape
-    if t * n * width * 4 > 256 * 1024 * 1024:
+    if t * n * width * 4 > dense_limit:
         # Same 256MB dense-transient guard as _node_totals: deep/wide
         # configs fall back to the row-wise gathers (slower, O(T*N) memory —
         # no (T, N, width) one-hot anywhere on this branch).

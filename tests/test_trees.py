@@ -396,3 +396,45 @@ def test_poisson1_inverse_cdf_distribution():
     assert abs(w.var() - 1.0) < 0.02
     for k, p in ((0, math.exp(-1)), (1, math.exp(-1)), (2, math.exp(-1) / 2)):
         assert abs((w == k).mean() - p) < 0.005
+
+
+def test_route_rows_fallback_matches_matmul_branch():
+    """Both REAL branches of _route_rows — the one-hot matmul and the
+    256MB-guarded gather fallback (forced via dense_limit=0) — must agree
+    exactly, including ties, inactive rows, and no-split nodes. Bench
+    shapes only ever run the matmul branch, so this is the fallback's one
+    execution in the suite."""
+    from fraud_detection_tpu.models import train_trees as tt
+
+    rng = np.random.default_rng(11)
+    t, n, f, width = 3, 257, 64, 8
+    bins = jnp.asarray(rng.integers(0, 32, (n, f), dtype=np.int32))
+    local = jnp.asarray(rng.integers(-1, width + 1, (t, n), dtype=np.int32))
+    seg_valid = (jnp.asarray(rng.uniform(size=(t, n)) < 0.8)
+                 & (local >= 0) & (local < width))
+    node = jnp.asarray(rng.integers(0, 2 * width, (t, n), dtype=np.int32))
+    best_f = jnp.asarray(rng.integers(0, f, (t, width), dtype=np.int32))
+    best_b = jnp.asarray(rng.integers(0, 31, (t, width), dtype=np.int32))
+    do_split = jnp.asarray(rng.uniform(size=(t, width)) < 0.7)
+
+    args = (bins, local, seg_valid, node, best_f, best_b, do_split, width)
+    node_mm, act_mm = tt._route_rows(*args)
+    node_gather, act_gather = tt._route_rows(*args, dense_limit=0)
+    np.testing.assert_array_equal(np.asarray(node_mm), np.asarray(node_gather))
+    np.testing.assert_array_equal(np.asarray(act_mm), np.asarray(act_gather))
+
+
+def test_node_totals_fallback_matches_dense():
+    """_node_totals' segment_sum fallback (above the dense-transient
+    threshold) must equal the dense matmul path bit-for-bit on integer
+    stats."""
+    from fraud_detection_tpu.models import train_trees as tt
+
+    rng = np.random.default_rng(5)
+    n, width, k = 4096, 16, 2
+    stats = jnp.asarray(rng.integers(0, 4, (n, k)).astype(np.float32))
+    seg = jnp.asarray(rng.integers(0, width + 1, (n,), dtype=np.int32))
+    dense = tt._node_totals(stats, seg, width)
+    # batch_factor large enough to trip the fallback at these shapes
+    fallback = tt._node_totals(stats, seg, width, batch_factor=10**6)
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(fallback))
