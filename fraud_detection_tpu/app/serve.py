@@ -212,9 +212,26 @@ def main(argv=None) -> int:
         # close-the-consumer path.
         shutdown = threading.Event()
 
+        # Demo path (in-process broker, topic pre-loaded): construct every
+        # worker's engine BEFORE any engine consumes — group members join at
+        # consumer CONSTRUCTION, so this settles the group at its final
+        # generation first. Staggered joins let worker 0 drain the whole
+        # topic in one batch and then have its commit correctly fenced by
+        # the late joiners' rebalance: at-least-once duplicates a settled
+        # group never produces (Kafka deployments avoid the same pathology
+        # by starting all consumers before traffic). --kafka keeps lazy
+        # construction INSIDE the supervisor — client-construction failures
+        # must stay retryable incarnations (engine.py run_supervised), and
+        # one worker's failure must not abort its siblings.
+        prebuilt = [make_engine() if broker is not None else None
+                    for _ in range(args.workers)]
+
         def run_worker(i: int) -> None:
             def make():
-                live[i] = make_engine()
+                if prebuilt[i] is not None:
+                    live[i], prebuilt[i] = prebuilt[i], None
+                else:
+                    live[i] = make_engine()
                 if shutdown.is_set():
                     live[i].stop()
                 return live[i]
