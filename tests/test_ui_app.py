@@ -126,6 +126,9 @@ class FakeStreamlit:
     def expander(self, label, expanded=False):
         return self
 
+    def chat_message(self, role):
+        return self
+
     # --- inputs: scripted, defaulting like streamlit does ------------------
     def _get(self, kind, label, default):
         return self.script.get((kind, label), default)
@@ -147,6 +150,9 @@ class FakeStreamlit:
 
     def button(self, label, **kw):
         return self._get("button", label, False)
+
+    def chat_input(self, label="", **kw):
+        return self._get("chat_input", label, None)
 
     def file_uploader(self, label, type=None, key=None, **kw):
         return self._get("file_uploader", key or label, None)
@@ -260,6 +266,42 @@ def test_main_full_drive_headless(config, monkeypatch):
     assert fake.session_state.monitor.engine is None
     monitor.thread.join(timeout=15)
     assert not monitor.thread.is_alive()
+
+
+def test_chat_main_headless(monkeypatch):
+    """chat.main() (the reference deepseek_chat_ui.py analogue) executed end
+    to end without streamlit or a live endpoint: the sidebar builds the
+    backend, a scripted chat_input sends a prompt, the stubbed backend's
+    reply lands in session history, and an input-less rerun re-renders
+    without appending."""
+    from fraud_detection_tpu.app import chat
+
+    fake = FakeStreamlit()
+    monkeypatch.setattr(chat, "require_streamlit", lambda: fake)
+
+    calls = {}
+
+    class StubBackend:
+        def __init__(self, base_url, model, api_key=None):
+            calls["built"] = (base_url, model, api_key)
+
+        def chat(self, messages, temperature):
+            calls["n_messages"] = len(messages)
+            return "stub reply"
+
+    monkeypatch.setattr(chat, "OpenAIChatBackend", StubBackend)
+
+    fake.script = {("chat_input", "Say something"): "hello there"}
+    chat.main()
+    assert fake.session_state.messages == [
+        {"role": "user", "content": "hello there"},
+        {"role": "assistant", "content": "stub reply"}]
+    assert calls["built"][0].startswith("http://localhost:1234")
+    assert calls["n_messages"] == 1          # sent after the user turn landed
+
+    fake.script = {}
+    chat.main()                              # rerun: render-only
+    assert len(fake.session_state.messages) == 2
 
 
 def test_main_via_apptest_when_streamlit_present(config):
