@@ -303,6 +303,17 @@ def test_chat_main_headless(monkeypatch):
     chat.main()                              # rerun: render-only
     assert len(fake.session_state.messages) == 2
 
+    # Backend failure degrades to an inline error message, not a crash.
+    class FailBackend(StubBackend):
+        def chat(self, messages, temperature):
+            raise chat.BackendError("endpoint down")
+
+    monkeypatch.setattr(chat, "OpenAIChatBackend", FailBackend)
+    fake.script = {("chat_input", "Say something"): "are you there?"}
+    chat.main()
+    assert fake.session_state.messages[-1]["content"].startswith(
+        "[backend error:")
+
 
 def test_main_via_apptest_when_streamlit_present(config):
     """Real-streamlit AppTest drive where streamlit exists; headless
