@@ -420,3 +420,29 @@ def test_logits_last_only_matches_full_forward(params):
     assert last.shape == (1, 1, CFG.vocab_size)
     np.testing.assert_allclose(np.asarray(last[:, 0]), np.asarray(full[:, -1]),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_int8_tensor_parallel_mqa_kv_replicated():
+    """int8 x TP at the Gemma-2B serving shape: MQA (one kv head) keeps
+    wk/wv REPLICATED while wq shards over heads — the Q8 leaves must follow
+    the same split (replicated q+scale for kv, head-sharded for q), and the
+    tp(8) forward must match the single-device quantized forward."""
+    from fraud_detection_tpu.models.llm import (Q8, init_params,
+                                                quantize_params, shard_params)
+
+    cfg = TransformerConfig(d_model=64, n_heads=8, n_layers=2, d_ff=128,
+                            max_seq=256, n_kv_heads=1, head_dim_override=16)
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    mesh = model_mesh(8)
+    toks = jnp.asarray(np.arange(24, dtype=np.int32)[None, :] % 250)
+
+    qparams = quantize_params(params)
+    want = np.asarray(forward(qparams, toks, cfg)[0])
+    sharded = shard_params(qparams, cfg, mesh)
+    wk = sharded["l0.wk"]
+    assert isinstance(wk, Q8)
+    assert wk.q.sharding.is_fully_replicated          # MQA: kv replicated
+    assert wk.scale.sharding.is_fully_replicated
+    assert not sharded["l0.wq"].q.sharding.is_fully_replicated
+    got = np.asarray(jax.jit(lambda p, t: forward(p, t, cfg)[0])(sharded, toks))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
