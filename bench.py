@@ -142,6 +142,34 @@ def training_matrix(n_rows: int, n_features: int):
     return np.concatenate(chunks), y
 
 
+def steady_rate_estimate(full_s: float, small_s: float, full_units: int,
+                         small_units: int) -> tuple:
+    """Seconds-per-unit in steady state, from a full-fit and a small-fit wall.
+
+    Marginal full-minus-small rate: subtracting the small fit cancels the
+    fixed per-fit wall (input prep, final drain, host finalize) that
+    dominates a small fit — the old small-fit estimator read ~17 trees/s
+    while the marginal device rate is ~4x that (r5 profiling).
+
+    The margin is trusted only while the implied marginal rate stays within
+    4x of the full fit's AVERAGE rate (quiet-host profiling puts the true
+    ratio near 2x): a contention spike during the small fit can leave the
+    margin tiny-but-positive, and a tiny margin implies an absurd rate —
+    and, downstream, a roofline above 100% of HBM peak. Degenerate margins
+    (including ``full_units <= small_units``) fall back to the small-fit
+    rate; the returned label ("marginal" | "small_fit") records which
+    estimator produced the number, and the roofline legs reuse the same
+    number so the label always names the estimator they used.
+
+    Returns ``(seconds_per_unit, label)``.
+    """
+    marg, den = full_s - small_s, full_units - small_units
+    ok = den > 0 and marg > 0 and marg / den > full_s / full_units / 4
+    if ok:
+        return marg / den, "marginal"
+    return small_s / small_units, "small_fit"
+
+
 def training_bench() -> dict:
     """Wall-clock for the three reference model families on the default
     (Pallas-on-TPU) path. DT is fit twice: the first call carries the jit
@@ -215,31 +243,12 @@ def training_bench() -> dict:
     t8 = time.time()
     fit_gradient_boosting(X_dev, y, n_rounds=16, edges=edges)
     t9 = time.time()
-    # Marginal per-tree rate: full fit minus small fit cancels the fixed
-    # per-fit wall (input prep, final drain, host finalize) that dominates
-    # a small fit — the old small-fit estimator read ~17 trees/s while the
-    # marginal device rate is ~4x that (r5 profiling). The forest builds
-    # full chunks (ceil(n/chunk)*chunk trees of device work), so the RF
-    # denominator counts built trees. A non-positive margin (tiny
-    # BENCH_TRAIN_TREES, or a contention spike during the small fit) falls
-    # back to the small-fit estimator instead of emitting a clamped
-    # absurdity; `steady_estimator` records which one produced the number.
     rf_built = -(-n_trees // chunk) * chunk
-    rf_marg, rf_den = (t6 - t5) - (t8 - t7), rf_built - 2 * chunk
-    xgb_marg, xgb_den = (t7 - t6) - (t9 - t8), n_trees - 16
-    # Trust the margin only while the implied marginal rate stays within 4x
-    # of the full fit's AVERAGE rate (quiet-host profiling puts the true
-    # ratio near 2x): a contention spike during the small fit can leave the
-    # margin tiny-but-positive, and a tiny margin implies an absurd rate —
-    # and, downstream, a roofline above 100% of HBM peak.
-    rf_marginal_ok = (rf_den > 0 and rf_marg > 0
-                      and rf_marg / rf_den > (t6 - t5) / rf_built / 4)
-    xgb_marginal_ok = (xgb_den > 0 and xgb_marg > 0
-                       and xgb_marg / xgb_den > (t7 - t6) / n_trees / 4)
-    rf_steady_s = (rf_marg / rf_den if rf_marginal_ok
-                   else (t8 - t7) / (2 * chunk))
-    xgb_steady_s = (xgb_marg / xgb_den if xgb_marginal_ok
-                    else (t9 - t8) / 16)
+    rf_steady_s, rf_est = steady_rate_estimate(
+        full_s=t6 - t5, small_s=t8 - t7, full_units=rf_built,
+        small_units=2 * chunk)
+    xgb_steady_s, xgb_est = steady_rate_estimate(
+        full_s=t7 - t6, small_s=t9 - t8, full_units=n_trees, small_units=16)
 
     # --- device-side steady state for the roofline: K pipelined DT builds,
     # ONE terminal sync. A single fit's wall on a remote-tunneled device is
@@ -273,9 +282,7 @@ def training_bench() -> dict:
         f"xgb{n_trees}_fit_s": round(t7 - t6, 3),
         "rf_steady_trees_per_s": round(1.0 / rf_steady_s, 1),
         "xgb_steady_trees_per_s": round(1.0 / xgb_steady_s, 1),
-        "steady_estimator": {
-            "rf": "marginal" if rf_marginal_ok else "small_fit",
-            "xgb": "marginal" if xgb_marginal_ok else "small_fit"},
+        "steady_estimator": {"rf": rf_est, "xgb": xgb_est},
     }
     _, hbm_peak = _peaks_if_tpu()
     if hbm_peak:
@@ -522,6 +529,19 @@ def llm_bench() -> dict:
             ckpt_dir = _gemma2b_synthetic_dir()
             synth_s = time.perf_counter() - t0
             warm = has_converted_cache(ckpt_dir)
+            # The load times below are dominated by the 5GB param upload,
+            # whose rate is set by the shared TPU tunnel — observed anywhere
+            # from ~95MB/s (54s warm reloads) to ~7MB/s (a 719s one) across
+            # sessions. Probe it (fresh 64MB + computed fetch, so the axon
+            # async-ack can't fake completion) so the artifact's own numbers
+            # attribute a slow load to the transport, not the cache design.
+            rng = np.random.default_rng(0)
+            probe = rng.integers(0, 255, 1 << 26, dtype=np.uint8)
+            jnp.asarray(probe).astype(jnp.int32).sum().item()  # compile warm
+            probe = rng.integers(0, 255, 1 << 26, dtype=np.uint8)  # fresh
+            t0 = time.perf_counter()
+            jnp.asarray(probe).astype(jnp.int32).sum().item()
+            tunnel_mbps = probe.nbytes / 1e6 / (time.perf_counter() - t0)
             t0 = time.perf_counter()
             # max_seq 8192 so the long-context leg can run T=8192; it only
             # sizes position validation, not buffers.
@@ -530,7 +550,8 @@ def llm_bench() -> dict:
             load_s = time.perf_counter() - t0
             cfg = model.cfg
             meta = {"model": "gemma-2b-arch (synthetic weights)",
-                    "synth_checkpoint_s": round(synth_s, 1)}
+                    "synth_checkpoint_s": round(synth_s, 1),
+                    "tunnel_upload_mbps": round(tunnel_mbps, 1)}
             if warm:
                 # Converted-layout cache hit: no transpose-heavy conversion,
                 # just memmap -> device upload (round-4 verdict item 6).
