@@ -237,15 +237,20 @@ _CACHE_NAME = "converted.fraud_tpu_cache"  # not .safetensors: must never be
 _CONVERTER_VERSION = 1
 
 
-def _converted_cache_paths(ckpt_dir: str, *, create: bool = False):
+def _converted_cache_paths(ckpt_dir: str, *, create: bool = False,
+                           variant: str = ""):
     """(tensor_file, meta_file) for the converted cache — next to the HF dir
     when writable, under ~/.cache/fraud_tpu_converted/<dirhash> otherwise.
     ``create`` makes the fallback directory (write path only; read-side
-    queries must not mutate the filesystem)."""
+    queries must not mutate the filesystem). ``variant`` names an alternate
+    converted layout ("q8": host-quantized int8 — half the bytes to read
+    AND upload on the tunnel-bound warm path)."""
     import hashlib
 
+    name = _CACHE_NAME if not variant else _CACHE_NAME.replace(
+        "converted.", f"converted_{variant}.")
     if os.access(ckpt_dir, os.W_OK):
-        base = os.path.join(ckpt_dir, _CACHE_NAME)
+        base = os.path.join(ckpt_dir, name)
     else:
         tag = hashlib.sha256(
             os.path.abspath(ckpt_dir).encode()).hexdigest()[:16]
@@ -253,7 +258,7 @@ def _converted_cache_paths(ckpt_dir: str, *, create: bool = False):
                          tag)
         if create:
             os.makedirs(d, exist_ok=True)
-        base = os.path.join(d, _CACHE_NAME)
+        base = os.path.join(d, name)
     return base, base + ".json"
 
 
@@ -272,17 +277,23 @@ def _source_fingerprint(ckpt_dir: str) -> str:
     return h.hexdigest()
 
 
-def _valid_cache_file(ckpt_dir: str) -> Optional[str]:
+def _valid_cache_file(ckpt_dir: str, variant: str = "",
+                      require: Optional[dict] = None) -> Optional[str]:
     """Path of a valid converted cache (fingerprint AND converter version
     match, tensor file present), else None. The ONE validity check — used by
     both ``load_hf_checkpoint`` and ``has_converted_cache`` so the bench's
-    cold/warm labeling can't drift from what the loader actually does."""
-    cache_f, meta_f = _converted_cache_paths(ckpt_dir)
+    cold/warm labeling can't drift from what the loader actually does.
+    ``require``: extra meta key/values that must match exactly (the q8
+    variant's codes bake in the compute dtype, so its loader requires
+    ``{"quant_dtype": ...}`` — a bf16-quantized cache must never serve an
+    f32 load)."""
+    cache_f, meta_f = _converted_cache_paths(ckpt_dir, variant=variant)
     try:
         with open(meta_f) as f:
             meta = json.load(f)
         if (meta.get("fingerprint") == _source_fingerprint(ckpt_dir)
                 and meta.get("converter_version") == _CONVERTER_VERSION
+                and all(meta.get(k) == v for k, v in (require or {}).items())
                 and os.path.exists(cache_f)):
             return cache_f
     except (OSError, ValueError):
@@ -290,10 +301,11 @@ def _valid_cache_file(ckpt_dir: str) -> Optional[str]:
     return None
 
 
-def has_converted_cache(ckpt_dir: str) -> bool:
+def has_converted_cache(ckpt_dir: str, variant: str = "") -> bool:
     """True when a valid converted cache exists — the bench uses this to
-    label its load timing cold vs warm."""
-    return _valid_cache_file(ckpt_dir) is not None
+    label its load timing cold vs warm. ``variant="q8"`` asks about the
+    host-quantized cache the ``int8=True`` load path keeps."""
+    return _valid_cache_file(ckpt_dir, variant) is not None
 
 
 class HFTokenizerAdapter:
@@ -330,9 +342,43 @@ class HFTokenizerAdapter:
         return self.tok.decode(ids, skip_special_tokens=True)
 
 
+_Q8_KEY, _Q8_SCALE_KEY = "::q8", "::q8_scale"   # "::" never occurs in param names
+
+
+def _flatten_q8(params: Dict[str, object]) -> Dict[str, np.ndarray]:
+    """{name: ndarray | Q8} -> flat safetensors-writable {name: ndarray}."""
+    from fraud_detection_tpu.models.llm import Q8
+
+    out: Dict[str, np.ndarray] = {}
+    for k, v in params.items():
+        if isinstance(v, Q8):
+            out[k + _Q8_KEY] = np.asarray(v.q)
+            out[k + _Q8_SCALE_KEY] = np.asarray(v.scale)
+        else:
+            out[k] = v
+    return out
+
+
+def _unflatten_q8(tensors: Dict[str, np.ndarray]) -> Dict[str, object]:
+    """Inverse of ``_flatten_q8`` (raises KeyError on a q8 half-pair —
+    caught by the loader's corrupt-cache fallback)."""
+    from fraud_detection_tpu.models.llm import Q8
+
+    out: Dict[str, object] = {}
+    for k, v in tensors.items():
+        if k.endswith(_Q8_SCALE_KEY):
+            continue
+        elif k.endswith(_Q8_KEY):
+            name = k[: -len(_Q8_KEY)]
+            out[name] = Q8(q=v, scale=tensors[name + _Q8_SCALE_KEY])
+        else:
+            out[k] = v
+    return out
+
+
 def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
                        mesh=None, tokenizer: Optional[object] = None,
-                       use_cache: bool = True):
+                       use_cache: bool = True, int8: bool = False):
     """Directory of a downloaded HF checkpoint -> ready LanguageModel.
 
     Plugs straight into the explanation layer:
@@ -342,35 +388,67 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
     ``use_cache``: reuse (and on a miss, write) the converted-layout cache —
     warm loads skip the transpose-heavy conversion entirely and memmap
     straight into the device upload.
+
+    ``int8``: weight-only quantization ON THE HOST, before upload — the
+    model arrives identical to ``load_hf_checkpoint(dir).quantized()``
+    (same rounding contract, pinned by test) but ships HALF the bytes
+    through the device transfer that floors cold-start time on a tunneled
+    chip. Keeps its own converted cache variant ("q8", int8 + scales), so
+    warm int8 loads also READ half the bytes; an int8 miss still reuses a
+    valid bf16 cache (host quantize, no reconversion).
     """
     import jax.numpy as jnp
 
-    from fraud_detection_tpu.models.llm import LanguageModel, shard_params
+    from fraud_detection_tpu.models.llm import (
+        LanguageModel, Q8, quantize_params_host, shard_params)
 
     with open(os.path.join(ckpt_dir, "config.json")) as f:
         cfg = config_from_hf(json.load(f), max_seq=max_seq, dtype=dtype)
+    variant = "q8" if int8 else ""
+    require = ({"quant_dtype": np.dtype(cfg.dtype).name} if int8 else None)
     params_np = None
     if use_cache:
-        valid = _valid_cache_file(ckpt_dir)
+        valid = _valid_cache_file(ckpt_dir, variant, require)
         if valid is not None:
             try:
-                params_np = read_safetensors(valid)
-            except (OSError, ValueError):
+                raw = read_safetensors(valid)
+                params_np = _unflatten_q8(raw) if int8 else raw
+            except (OSError, ValueError, KeyError):
                 params_np = None
     if params_np is None:
-        params_np = convert_hf_state(read_checkpoint_tensors(ckpt_dir), cfg)
+        if use_cache and int8:
+            # int8 miss, bf16 cache hit: skip the transpose-heavy
+            # reconversion, just host-quantize the cached layout.
+            bf16_cache = _valid_cache_file(ckpt_dir)
+            if bf16_cache is not None:
+                try:
+                    params_np = read_safetensors(bf16_cache)
+                except (OSError, ValueError):
+                    params_np = None
+        if params_np is None:
+            params_np = convert_hf_state(read_checkpoint_tensors(ckpt_dir),
+                                         cfg)
+        if int8:
+            params_np = quantize_params_host(params_np,
+                                             compute_dtype=cfg.dtype)
         if use_cache:
-            cache_f, meta_f = _converted_cache_paths(ckpt_dir, create=True)
+            cache_f, meta_f = _converted_cache_paths(ckpt_dir, create=True,
+                                                     variant=variant)
             try:
                 # Tensors first, meta (the validity marker) last and
                 # atomically — a kill mid-write can't leave a valid-looking
                 # cache.
-                write_safetensors(cache_f + ".tmp", params_np)
+                write_safetensors(
+                    cache_f + ".tmp",
+                    _flatten_q8(params_np) if int8 else params_np)
                 os.replace(cache_f + ".tmp", cache_f)
                 tmp = meta_f + ".tmp"
+                meta = {"fingerprint": _source_fingerprint(ckpt_dir),
+                        "converter_version": _CONVERTER_VERSION}
+                if int8:
+                    meta.update(require)
                 with open(tmp, "w") as f:
-                    json.dump({"fingerprint": _source_fingerprint(ckpt_dir),
-                               "converter_version": _CONVERTER_VERSION}, f)
+                    json.dump(meta, f)
                 os.replace(tmp, meta_f)
             except OSError:
                 # Unwritable/full disk: the cache is an optimization only —
@@ -382,7 +460,7 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
                         os.unlink(leftover)
                     except OSError:
                         pass
-    def _to_device(v: np.ndarray) -> "jnp.ndarray":
+    def _materialize(v: np.ndarray) -> np.ndarray:
         # Memmap-backed tensors (the cached path) materialize to RAM first:
         # uploading straight from the memmap page-faults through the device
         # transfer (measured 528s for 5GB over the TPU tunnel vs ~35s of
@@ -390,10 +468,18 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
         base = v
         while isinstance(base, np.ndarray):
             if isinstance(base, np.memmap):
-                v = np.array(v)
-                break
+                return np.array(v)
             base = base.base
-        return jnp.asarray(v, cfg.dtype)
+        return v
+
+    def _to_device(v):
+        if isinstance(v, Q8):
+            # int8 payload + f32 scale upload at their own widths — the
+            # whole point of quantize-before-upload; never cast to
+            # cfg.dtype.
+            return Q8(q=jnp.asarray(_materialize(v.q)),
+                      scale=jnp.asarray(_materialize(v.scale), jnp.float32))
+        return jnp.asarray(_materialize(v), cfg.dtype)
 
     params = {k: _to_device(v) for k, v in params_np.items()}
     if mesh is not None:

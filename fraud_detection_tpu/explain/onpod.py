@@ -88,19 +88,18 @@ class OnPodBackend(_GenerateMixin):
         zero-egress replacement for the reference's hosted DeepSeek call
         (utils/agent_api.py:36; converter: checkpoint/hf_convert.py).
 
-        ``int8=True`` applies weight-only quantization after load
-        (``models/llm.py quantize_params``): ~1.5x explanations/sec on a
-        2B model at >0.999 logit correlation — opt-in, because greedy
-        decodes can still differ from bf16 near ties. Composes with
-        ``mesh``: quantization runs on the already-sharded params (the
-        elementwise q keeps the TP layout; the scale reduction lands on its
-        output-channel sharding — models/llm.py shard_params)."""
+        ``int8=True`` loads weight-only-quantized (``load_hf_checkpoint``'s
+        host-side quantize-before-upload — half the bytes through the
+        tunnel-bound device transfer, same weights as an after-load
+        ``quantize_params``): ~1.5x explanations/sec on a 2B model at
+        >0.999 logit correlation — opt-in, because greedy decodes can
+        still differ from bf16 near ties. Composes with ``mesh``: Q8
+        leaves shard componentwise (q on the weight's TP spec, the scale
+        on its output-channel dims — models/llm.py shard_params)."""
         from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
 
         lm = load_hf_checkpoint(ckpt_dir, max_seq=max_seq, mesh=mesh,
-                                tokenizer=tokenizer)
-        if int8:
-            lm = lm.quantized()
+                                tokenizer=tokenizer, int8=int8)
         return cls.from_model(lm, mesh=mesh)
 
 

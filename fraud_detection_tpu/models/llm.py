@@ -228,6 +228,45 @@ def quantize_params(params: Params, *, include_embed: bool = True) -> Params:
     return out
 
 
+def quantize_params_host(params: dict, *, include_embed: bool = True,
+                         compute_dtype=None) -> dict:
+    """``quantize_params`` in host numpy, for quantize-BEFORE-upload loads.
+
+    The device upload is the cold-start floor on a tunneled chip (5GB of
+    bf16 at single-digit-to-double-digit MB/s), so an int8 serving config
+    wants the weights quantized on the host and HALF the bytes shipped —
+    not a bf16 upload followed by on-device ``quantize_params``. Same
+    contract as the device version (f32 math, keepdims absmax, round-half-
+    even, ±127 clip; both numpy and XLA follow IEEE semantics for these
+    ops), pinned by tests/test_llm.py's host-vs-device equality test.
+
+    ``compute_dtype``: the model dtype an after-load ``quantize_params``
+    would have seen — weights round-trip through it before quantizing, so
+    an f32/f16 checkpoint loaded at bf16 quantizes the same rounded values
+    on both paths (checkpoint dtype and model dtype differ routinely; both
+    numpy/ml_dtypes and XLA cast round-to-nearest-even).
+
+    Takes and returns numpy leaves ({name: ndarray | Q8-of-ndarray});
+    callers upload with Q8-aware device placement (checkpoint/hf_convert.py)
+    or ``shard_params``."""
+    out: dict = {}
+    for name, w in params.items():
+        suffix = name.rsplit(".", 1)[-1]
+        axes = _QUANT_REDUCE_AXES.get(suffix)
+        if axes is None or (suffix in ("embed", "lm_head") and not include_embed):
+            out[name] = w
+            continue
+        wf = np.asarray(w)
+        if compute_dtype is not None:
+            wf = wf.astype(np.dtype(compute_dtype))
+        wf = wf.astype(np.float32)
+        absmax = np.max(np.abs(wf), axis=axes, keepdims=True)
+        scale = np.maximum(absmax, np.float32(1e-8)) / np.float32(127.0)
+        q = np.clip(np.round(wf / scale), -127, 127).astype(np.int8)
+        out[name] = Q8(q=q, scale=scale)
+    return out
+
+
 def _mm(sub: str, x: jax.Array, w, dtype) -> jax.Array:
     """Einsum against a possibly-quantized weight. An int8 weight enters the
     dot as a bare int8->dtype convert — the HBM read stays int8-wide — and

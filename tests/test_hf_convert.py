@@ -272,3 +272,172 @@ def test_gqa_forward_equals_expanded_mha():
     a, _ = forward(p, toks, cfg_gqa)
     b, _ = forward(p_mha, toks, cfg_mha)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def _write_checkpoint_dir(tmp_path, hf, st):
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    write_safetensors(str(tmp_path / "model.safetensors"), st)
+
+
+def test_int8_load_is_quantize_before_upload(tmp_path, monkeypatch):
+    """``load_hf_checkpoint(int8=True)`` must produce the exact model of
+    load-then-``.quantized()`` while shipping int8 through the upload, and
+    keep a q8 converted-cache variant that warm loads and the bf16 cache
+    can both serve without reconversion."""
+    import fraud_detection_tpu.checkpoint.hf_convert as hfc
+    from fraud_detection_tpu.models.llm import Q8
+
+    hf = make_hf_config(gemma=False, n_kv=2)
+    st = make_hf_state(hf, seed=9)
+    _write_checkpoint_dir(tmp_path, hf, st)
+
+    ref = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                             tokenizer="byte", use_cache=False).quantized()
+    lm = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                            tokenizer="byte", int8=True)
+
+    def assert_same(a, b):
+        assert a.keys() == b.keys()
+        for name in a:
+            x, y = a[name], b[name]
+            assert isinstance(x, Q8) == isinstance(y, Q8), name
+            if isinstance(x, Q8):
+                assert np.asarray(y.q).dtype == np.int8
+                np.testing.assert_array_equal(np.asarray(x.q),
+                                              np.asarray(y.q), err_msg=name)
+                np.testing.assert_array_equal(np.asarray(x.scale),
+                                              np.asarray(y.scale),
+                                              err_msg=name)
+            else:
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                              err_msg=name)
+
+    assert_same(ref.params, lm.params)
+
+    # The int8 load wrote the q8 cache variant (half the bytes of bf16),
+    # not the bf16 one.
+    from fraud_detection_tpu.checkpoint.hf_convert import has_converted_cache
+
+    assert has_converted_cache(str(tmp_path), "q8")
+    assert not has_converted_cache(str(tmp_path))
+
+    # Warm q8 reload: identical params WITHOUT any reconversion or
+    # requantization (both would have to call convert_hf_state or
+    # quantize_params_host — forbid both).
+    def boom(*a, **k):
+        raise AssertionError("warm q8 load must not reconvert/requantize")
+
+    import fraud_detection_tpu.models.llm as llm_mod
+
+    monkeypatch.setattr(hfc, "convert_hf_state", boom)
+    # the loader does a call-time ``from models.llm import ...``
+    monkeypatch.setattr(llm_mod, "quantize_params_host", boom)
+    lm2 = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                             tokenizer="byte", int8=True)
+    assert_same(lm.params, lm2.params)
+    monkeypatch.undo()
+
+    # int8 forward equals the reference quantized forward.
+    tokens = np.arange(12, dtype=np.int64)[None, :] % hf["vocab_size"]
+    got, _ = forward(lm.params, jnp.asarray(tokens), lm.cfg)
+    want, _ = forward(ref.params, jnp.asarray(tokens), ref.cfg)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_int8_load_reuses_bf16_cache(tmp_path, monkeypatch):
+    """An int8 load with no q8 cache but a valid bf16 cache must host-
+    quantize the cached layout instead of reconverting from HF shards."""
+    import fraud_detection_tpu.checkpoint.hf_convert as hfc
+
+    hf = make_hf_config(gemma=False, n_kv=2)
+    st = make_hf_state(hf, seed=10)
+    _write_checkpoint_dir(tmp_path, hf, st)
+
+    bf16 = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                              tokenizer="byte")     # writes the bf16 cache
+    ref = bf16.quantized()
+
+    monkeypatch.setattr(
+        hfc, "convert_hf_state",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("int8 load must reuse the bf16 cache")))
+    lm = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                            tokenizer="byte", int8=True)
+    from fraud_detection_tpu.models.llm import Q8
+
+    for name, v in ref.params.items():
+        if isinstance(v, Q8):
+            np.testing.assert_array_equal(np.asarray(v.q),
+                                          np.asarray(lm.params[name].q),
+                                          err_msg=name)
+
+
+def test_int8_load_with_mesh_matches_single_device(tmp_path):
+    """int8=True composes with a mesh: the sharded Q8 forward matches the
+    single-device int8 load exactly."""
+    from jax.sharding import Mesh
+    import jax
+
+    from fraud_detection_tpu.models.llm import MODEL_AXIS, Q8
+
+    hf = make_hf_config(gemma=False, n_kv=2)
+    st = make_hf_state(hf, seed=11)
+    _write_checkpoint_dir(tmp_path, hf, st)
+
+    lm = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                            tokenizer="byte", int8=True)
+    mesh = Mesh(np.asarray(jax.devices("cpu")[:2]), (MODEL_AXIS,))
+    lm_tp = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                               tokenizer="byte", int8=True, mesh=mesh)
+    assert isinstance(lm_tp.params["l0.wq"], Q8)
+    assert not lm_tp.params["l0.wq"].q.sharding.is_fully_replicated
+
+    tokens = jnp.asarray(np.arange(12, dtype=np.int64)[None, :]
+                         % hf["vocab_size"])
+    got, _ = forward(lm_tp.params, tokens, lm_tp.cfg)
+    want, _ = forward(lm.params, tokens, lm.cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_int8_load_matches_quantized_across_dtype_gap(tmp_path):
+    """The host quantizer must round-trip weights through the MODEL dtype
+    before quantizing: an f32 checkpoint loaded at the default bf16 has
+    .quantized() seeing bf16-rounded values, and int8=True must bake the
+    SAME codes (review finding: quantizing the raw f32 produced different
+    absmax scales). Also pins that a q8 cache written at one dtype never
+    serves a load at another."""
+    from fraud_detection_tpu.models.llm import Q8
+
+    hf = make_hf_config(gemma=False, n_kv=2)
+    st = make_hf_state(hf, seed=12)          # f32 tensors on disk
+    _write_checkpoint_dir(tmp_path, hf, st)
+
+    def assert_q8_same(a, b):
+        for name, v in a.items():
+            if isinstance(v, Q8):
+                np.testing.assert_array_equal(
+                    np.asarray(v.q), np.asarray(b[name].q), err_msg=name)
+                np.testing.assert_array_equal(
+                    np.asarray(v.scale), np.asarray(b[name].scale),
+                    err_msg=name)
+
+    # Default dtype (bf16) — checkpoint dtype differs from model dtype.
+    ref = load_hf_checkpoint(str(tmp_path), max_seq=64, tokenizer="byte",
+                             use_cache=False).quantized()
+    lm = load_hf_checkpoint(str(tmp_path), max_seq=64, tokenizer="byte",
+                            int8=True)
+    assert_q8_same(ref.params, lm.params)
+
+    # An f32 load must not be served by the bf16-quantized cache: its codes
+    # must match the f32 .quantized() reference, not the cached bf16 ones.
+    ref32 = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                               tokenizer="byte", use_cache=False).quantized()
+    lm32 = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
+                              tokenizer="byte", int8=True)
+    assert_q8_same(ref32.params, lm32.params)
+    # ... and the two references really differ (the dtype gap is real).
+    q_bf16 = np.asarray(ref.params["l0.wq"].q)
+    q_f32 = np.asarray(ref32.params["l0.wq"].q)
+    assert (q_bf16 != q_f32).any()

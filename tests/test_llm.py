@@ -446,3 +446,37 @@ def test_int8_tensor_parallel_mqa_kv_replicated():
     assert not sharded["l0.wq"].q.sharding.is_fully_replicated
     got = np.asarray(jax.jit(lambda p, t: forward(p, t, cfg)[0])(sharded, toks))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_quantize_params_host_matches_device():
+    """Host (numpy) and device (XLA) quantization are the SAME function:
+    load_hf_checkpoint(int8=True) ships host-quantized weights and must
+    land bit-identical to an after-load ``.quantized()`` — int8 codes
+    exactly equal, scales exactly equal (both run f32 math with
+    round-half-even, per the quantize_params_host contract)."""
+    from fraud_detection_tpu.models.llm import (Q8, quantize_params,
+                                                quantize_params_host)
+
+    params = init_params(jax.random.PRNGKey(11), CFG)
+    params_np = {k: np.asarray(v) for k, v in params.items()}
+
+    dev = quantize_params(params)
+    host = quantize_params_host(params_np)
+    assert dev.keys() == host.keys()
+    for name in dev:
+        d, h = dev[name], host[name]
+        assert isinstance(d, Q8) == isinstance(h, Q8), name
+        if isinstance(d, Q8):
+            assert np.asarray(h.q).dtype == np.int8
+            np.testing.assert_array_equal(np.asarray(d.q), h.q, err_msg=name)
+            np.testing.assert_array_equal(
+                np.asarray(d.scale), h.scale, err_msg=name)
+        else:
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(h),
+                                          err_msg=name)
+
+    # include_embed=False propagates the same way on both paths.
+    dev_half = quantize_params(params, include_embed=False)
+    host_half = quantize_params_host(params_np, include_embed=False)
+    assert not isinstance(dev_half["embed"], Q8)
+    assert not isinstance(host_half["embed"], Q8)
