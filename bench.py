@@ -748,8 +748,34 @@ def llm_bench() -> dict:
     # model: 111 -> 182 tok/s single stream, 14.2 -> 21.2 explanations/sec
     # at B=8. BENCH_LLM_Q8=0 skips (the quantize + recompile adds ~2 min).
     if os.environ.get("BENCH_LLM_Q8", "1") != "0" and scale == "gemma2b":
-        qmodel = model.quantized()
-        jax.block_until_ready(qmodel.params)
+        # The int8 model arrives through the quantize-before-upload path
+        # (load_hf_checkpoint(int8=True)): half the bytes through the
+        # tunnel-bound transfer that floors reload_s, reusing this run's
+        # bf16 converted cache for the layout and writing the q8 variant.
+        # int8_load_s vs reload_s is the committed evidence of the halving
+        # (tunnel_upload_mbps attributes the absolute numbers); the codes
+        # are bit-identical to on-device quantization (pinned in tests),
+        # so every downstream int8 leg measures the same model either way.
+        # BENCH_LLM_Q8LOAD=0 quantizes the resident params instead (no
+        # second load; quick runs).
+        qmodel = None
+        if os.environ.get("BENCH_LLM_Q8LOAD", "1") != "0":
+            try:
+                load_info = {}
+                t0 = time.perf_counter()
+                qmodel = load_hf_checkpoint(ckpt_dir, max_seq=8192,
+                                            tokenizer="byte", int8=True,
+                                            load_info=load_info)
+                jax.block_until_ready(qmodel.params)
+                line["int8_load_s"] = round(time.perf_counter() - t0, 1)
+                # The loader reports the tier that actually served the
+                # weights — recorded only on success, never predicted.
+                line["int8_load_from"] = load_info.get("source")
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                qmodel, line["int8_load_error"] = None, repr(e)[:200]
+        if qmodel is None:
+            qmodel = model.quantized()
+            jax.block_until_ready(qmodel.params)
         q_bytes = _tree_bytes(qmodel.params)
         qmodel.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
         t0 = time.perf_counter()

@@ -378,7 +378,8 @@ def _unflatten_q8(tensors: Dict[str, np.ndarray]) -> Dict[str, object]:
 
 def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
                        mesh=None, tokenizer: Optional[object] = None,
-                       use_cache: bool = True, int8: bool = False):
+                       use_cache: bool = True, int8: bool = False,
+                       load_info: Optional[dict] = None):
     """Directory of a downloaded HF checkpoint -> ready LanguageModel.
 
     Plugs straight into the explanation layer:
@@ -395,13 +396,20 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
     through the device transfer that floors cold-start time on a tunneled
     chip. Keeps its own converted cache variant ("q8", int8 + scales), so
     warm int8 loads also READ half the bytes; an int8 miss still reuses a
-    valid bf16 cache (host quantize, no reconversion).
+    valid bf16 cache (host quantize, no reconverting).
+
+    ``load_info``: caller-supplied dict that receives what ACTUALLY
+    happened — ``source`` ("q8_cache" | "bf16_cache" | "hf_shards": the
+    tier the weights came from, recorded at the branch that served them,
+    never re-derived by callers) — so the bench's artifact attribution is
+    ground truth, not a pre-check that can drift from the loader.
     """
     import jax.numpy as jnp
 
     from fraud_detection_tpu.models.llm import (
         LanguageModel, Q8, quantize_params_host, shard_params)
 
+    info = load_info if load_info is not None else {}
     with open(os.path.join(ckpt_dir, "config.json")) as f:
         cfg = config_from_hf(json.load(f), max_seq=max_seq, dtype=dtype)
     variant = "q8" if int8 else ""
@@ -413,6 +421,7 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
             try:
                 raw = read_safetensors(valid)
                 params_np = _unflatten_q8(raw) if int8 else raw
+                info["source"] = "q8_cache" if int8 else "bf16_cache"
             except (OSError, ValueError, KeyError):
                 params_np = None
     if params_np is None:
@@ -423,11 +432,13 @@ def load_hf_checkpoint(ckpt_dir: str, *, max_seq: int = 4096, dtype=None,
             if bf16_cache is not None:
                 try:
                     params_np = read_safetensors(bf16_cache)
+                    info["source"] = "bf16_cache"
                 except (OSError, ValueError):
                     params_np = None
         if params_np is None:
             params_np = convert_hf_state(read_checkpoint_tensors(ckpt_dir),
                                          cfg)
+            info["source"] = "hf_shards"
         if int8:
             params_np = quantize_params_host(params_np,
                                              compute_dtype=cfg.dtype)

@@ -294,8 +294,10 @@ def test_int8_load_is_quantize_before_upload(tmp_path, monkeypatch):
 
     ref = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
                              tokenizer="byte", use_cache=False).quantized()
+    info = {}
     lm = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
-                            tokenizer="byte", int8=True)
+                            tokenizer="byte", int8=True, load_info=info)
+    assert info == {"source": "hf_shards"}
 
     def assert_same(a, b):
         assert a.keys() == b.keys()
@@ -333,8 +335,10 @@ def test_int8_load_is_quantize_before_upload(tmp_path, monkeypatch):
     monkeypatch.setattr(hfc, "convert_hf_state", boom)
     # the loader does a call-time ``from models.llm import ...``
     monkeypatch.setattr(llm_mod, "quantize_params_host", boom)
+    info2 = {}
     lm2 = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
-                             tokenizer="byte", int8=True)
+                             tokenizer="byte", int8=True, load_info=info2)
+    assert info2 == {"source": "q8_cache"}
     assert_same(lm.params, lm2.params)
     monkeypatch.undo()
 
@@ -362,8 +366,10 @@ def test_int8_load_reuses_bf16_cache(tmp_path, monkeypatch):
         hfc, "convert_hf_state",
         lambda *a, **k: (_ for _ in ()).throw(
             AssertionError("int8 load must reuse the bf16 cache")))
+    info = {}
     lm = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
-                            tokenizer="byte", int8=True)
+                            tokenizer="byte", int8=True, load_info=info)
+    assert info == {"source": "bf16_cache"}
     from fraud_detection_tpu.models.llm import Q8
 
     for name, v in ref.params.items():
