@@ -9,7 +9,12 @@ untied heads, Gemma's norm/scale/GeGLU quirks all handled, verified
 against an independent numpy forward in tests/test_hf_convert.py).
 
 Run:  python examples/convert_hf_checkpoint.py /path/to/hf-model-dir
+      python examples/convert_hf_checkpoint.py /path/to/hf-model-dir --int8
       python examples/convert_hf_checkpoint.py          # tiny synthetic demo
+
+--int8 quantizes ON THE HOST before upload (bit-identical to an
+after-load .quantized(), half the bytes through the device transfer) and
+keeps a converted_q8 cache next to the checkpoint for warm reloads.
 """
 import json
 import os
@@ -38,19 +43,23 @@ def make_synthetic_checkpoint(d: str) -> str:
 def main():
     from fraud_detection_tpu.explain.onpod import OnPodBackend
 
-    if len(sys.argv) > 1:
-        ckpt, tokenizer = sys.argv[1], None  # real dir: use its tokenizer
-        backend = OnPodBackend.from_hf_checkpoint(ckpt)
+    int8 = "--int8" in sys.argv
+    dirs = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if dirs:
+        ckpt = dirs[0]  # real dir: use its tokenizer
+        backend = OnPodBackend.from_hf_checkpoint(ckpt, int8=int8)
     else:
         with tempfile.TemporaryDirectory() as d:
             make_synthetic_checkpoint(d)
             from fraud_detection_tpu.checkpoint.hf_convert import load_hf_checkpoint
 
-            lm = load_hf_checkpoint(d, max_seq=128, tokenizer="byte")
+            lm = load_hf_checkpoint(d, max_seq=128, tokenizer="byte",
+                                    int8=int8)
             backend = OnPodBackend.from_model(lm)
             print("loaded synthetic checkpoint:",
                   f"{lm.cfg.n_layers} layers, d_model={lm.cfg.d_model},",
-                  f"kv_heads={lm.cfg.kv_heads} (GQA)")
+                  f"kv_heads={lm.cfg.kv_heads} (GQA)",
+                  "[int8 weight-only]" if int8 else "")
 
     reply = backend.generate(
         "Classify this call: 'you won a prize, read me your SSN'.",

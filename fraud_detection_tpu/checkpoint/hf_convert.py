@@ -247,8 +247,8 @@ def _converted_cache_paths(ckpt_dir: str, *, create: bool = False,
     AND upload on the tunnel-bound warm path)."""
     import hashlib
 
-    name = _CACHE_NAME if not variant else _CACHE_NAME.replace(
-        "converted.", f"converted_{variant}.")
+    stem, dot, ext = _CACHE_NAME.partition(".")
+    name = f"{stem}_{variant}{dot}{ext}" if variant else _CACHE_NAME
     if os.access(ckpt_dir, os.W_OK):
         base = os.path.join(ckpt_dir, name)
     else:
@@ -301,11 +301,18 @@ def _valid_cache_file(ckpt_dir: str, variant: str = "",
     return None
 
 
-def has_converted_cache(ckpt_dir: str, variant: str = "") -> bool:
+def has_converted_cache(ckpt_dir: str, variant: str = "",
+                        quant_dtype=None) -> bool:
     """True when a valid converted cache exists — the bench uses this to
     label its load timing cold vs warm. ``variant="q8"`` asks about the
-    host-quantized cache the ``int8=True`` load path keeps."""
-    return _valid_cache_file(ckpt_dir, variant) is not None
+    host-quantized cache the ``int8=True`` load path keeps; pass the
+    load's ``quant_dtype`` (model dtype) to ask the loader's EXACT
+    question — a q8 cache bakes its compute dtype into the codes, so
+    without it this is a presence check that a differently-typed load
+    would still reject and rebuild."""
+    require = ({"quant_dtype": np.dtype(quant_dtype).name}
+               if quant_dtype is not None else None)
+    return _valid_cache_file(ckpt_dir, variant, require) is not None
 
 
 class HFTokenizerAdapter:

@@ -436,6 +436,15 @@ def test_int8_load_matches_quantized_across_dtype_gap(tmp_path):
                             int8=True)
     assert_q8_same(ref.params, lm.params)
 
+    # has_converted_cache asks the loader's exact question when given the
+    # dtype: the bf16-written q8 cache is present, but not FOR an f32 load.
+    from fraud_detection_tpu.checkpoint.hf_convert import has_converted_cache
+
+    assert has_converted_cache(str(tmp_path), "q8")
+    assert has_converted_cache(str(tmp_path), "q8", quant_dtype=jnp.bfloat16)
+    assert not has_converted_cache(str(tmp_path), "q8",
+                                   quant_dtype=jnp.float32)
+
     # An f32 load must not be served by the bf16-quantized cache: its codes
     # must match the f32 .quantized() reference, not the cached bf16 ones.
     ref32 = load_hf_checkpoint(str(tmp_path), max_seq=64, dtype=jnp.float32,
