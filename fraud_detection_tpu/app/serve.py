@@ -75,6 +75,15 @@ def main(argv=None) -> int:
                          "backend)")
     ap.add_argument("--explain-tokens", type=int, default=128,
                     help="max new tokens per analysis (--explain)")
+    ap.add_argument("--explain-async", action="store_true",
+                    help="annotate flagged rows in the background onto "
+                         "--annotations-topic instead of inline: "
+                         "classification never waits for LLM decode "
+                         "(bounded queue, drop-oldest under overload; "
+                         "stream/annotations.py)")
+    ap.add_argument("--annotations-topic", default=None,
+                    help="side topic for --explain-async records "
+                         "(default: <output-topic>-annotations)")
     args = ap.parse_args(argv)
 
     if args.kafka and args.demo:
@@ -87,6 +96,12 @@ def main(argv=None) -> int:
         raise SystemExit(f"--workers must be >= 1, got {args.workers}")
     if args.explain_tokens < 1:
         raise SystemExit(f"--explain-tokens must be >= 1, got {args.explain_tokens}")
+    if args.explain_async and args.explain == "off":
+        raise SystemExit("--explain-async needs an --explain backend")
+    if args.annotations_topic is not None and not args.explain_async:
+        raise SystemExit("--annotations-topic only applies with "
+                         "--explain-async (inline analyses ride the "
+                         "output frames)")
     if args.workers > 1 and args.max_messages is not None:
         # Per-worker message caps can't split a global cap meaningfully —
         # refuse BEFORE the expensive pipeline build, like every other
@@ -176,12 +191,32 @@ def main(argv=None) -> int:
     else:
         raise SystemExit("choose --kafka or --demo N (no broker specified)")
 
+    engines_built = []   # async lanes to drain + aggregate at exit
+
     def make_engine():
         c, p = make_clients()
-        return StreamingClassifier(pipe, c, p, args.output_topic,
-                                   batch_size=args.batch_size, max_wait=args.max_wait,
-                                   pipeline_depth=args.pipeline_depth,
-                                   explain_batch_fn=explain_hook)
+        e = StreamingClassifier(pipe, c, p, args.output_topic,
+                                batch_size=args.batch_size, max_wait=args.max_wait,
+                                pipeline_depth=args.pipeline_depth,
+                                explain_batch_fn=explain_hook,
+                                explain_async=args.explain_async,
+                                annotations_topic=args.annotations_topic)
+        engines_built.append(e)
+        return e
+
+    def finish_annotations():
+        """Drain every engine's async lane; aggregated counters for the
+        stats JSON (None when running inline)."""
+        if not args.explain_async:
+            return None
+        agg = {"submitted": 0, "annotated": 0, "dropped": 0,
+               "backend_errors": 0}
+        for e in engines_built:
+            e.close_annotations(timeout=30.0)
+            s = e.annotation_stats() or {}
+            for k in agg:
+                agg[k] += s.get(k, 0)
+        return agg
 
     print(f"serving: model={args.model} in={args.input_topic} out={args.output_topic} "
           f"batch={args.batch_size} workers={args.workers}", flush=True)
@@ -290,6 +325,9 @@ def main(argv=None) -> int:
         merged = {**total.as_dict(), "workers": args.workers,
                   "per_worker_processed": [r.processed if r else None
                                            for r in results]}
+        annotations = finish_annotations()
+        if annotations is not None:
+            merged["annotations"] = annotations
         print(json.dumps(merged))
         if args.demo:
             n_out = broker.topic_size(args.output_topic)
@@ -316,7 +354,11 @@ def main(argv=None) -> int:
             stats = engine.stats
         finally:
             engine.consumer.close()
-    print(json.dumps(stats.as_dict()))
+    out = stats.as_dict()
+    annotations = finish_annotations()
+    if annotations is not None:
+        out["annotations"] = annotations
+    print(json.dumps(out))
     if args.demo:
         n_out = broker.topic_size(args.output_topic)
         print(f"classified messages on {args.output_topic}: {n_out}")

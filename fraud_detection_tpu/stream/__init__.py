@@ -1,6 +1,7 @@
+from fraud_detection_tpu.stream.annotations import AsyncAnnotationLane
 from fraud_detection_tpu.stream.broker import CommitFailedError, InProcessBroker, Message
 from fraud_detection_tpu.stream.engine import StreamingClassifier, StreamStats
 from fraud_detection_tpu.stream.kafka import kafka_available
 
-__all__ = ["CommitFailedError", "InProcessBroker", "Message", "StreamingClassifier", "StreamStats",
+__all__ = ["AsyncAnnotationLane", "CommitFailedError", "InProcessBroker", "Message", "StreamingClassifier", "StreamStats",
            "kafka_available"]

@@ -182,10 +182,14 @@ class StreamingClassifier:
         explain_fn: Optional[Callable[[str, int, float], Optional[str]]] = None,
         explain_batch_fn: Optional[Callable[[List[str], List[int], List[float]],
                                             List[Optional[str]]]] = None,
+        explain_async: bool = False,
+        annotations_topic: Optional[str] = None,
         tracer: Optional[Tracer] = None,
     ):
         if pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        if explain_async and explain_batch_fn is None:
+            raise ValueError("explain_async requires explain_batch_fn")
         self.pipeline = pipeline
         self.consumer = consumer
         self.producer = producer
@@ -201,6 +205,21 @@ class StreamingClassifier:
         # where the reference paid a synchronous HTTPS call per message
         # (app_ui.py:207). Takes precedence over explain_fn when both given.
         self.explain_batch_fn = explain_batch_fn
+        # Async lane (stream/annotations.py): classification frames go out
+        # WITHOUT analysis (so the raw-JSON + native-frame fast paths stay
+        # in play) and flagged rows annotate in the background onto a side
+        # topic, bounded-queue/drop-oldest — the LLM's decode rate caps the
+        # ANNOTATION rate instead of the classification rate.
+        self._annotation_lane = None
+        if explain_async:
+            from fraud_detection_tpu.stream.annotations import (
+                AsyncAnnotationLane)
+
+            self._annotation_lane = AsyncAnnotationLane(
+                explain_batch_fn, producer,
+                annotations_topic or f"{output_topic}-annotations")
+            self.explain_fn = explain_fn = None
+            self.explain_batch_fn = explain_batch_fn = None
         # Optional utils.tracing.Tracer: per-batch "dispatch" / "finish"
         # spans (host featurize+launch vs device-wait+produce+commit legs)
         # for profiling beyond StreamStats' aggregate latencies. None = the
@@ -307,6 +326,9 @@ class StreamingClassifier:
         msgs, texts = inflight.msgs, inflight.texts
         preds = inflight.pending.resolve() if inflight.pending is not None else None
 
+        if preds is not None and self._annotation_lane is not None:
+            self._submit_annotations(inflight, preds)
+
         if inflight.splice is not None and preds is not None:
             wires = self._assemble_frames_native(inflight, preds)
             return self._deliver(inflight, wires, t1)
@@ -384,6 +406,69 @@ class StreamingClassifier:
                     wire = json.dumps(out).encode()
             wires.append((wire, msg.key))
         return self._deliver(inflight, wires, t1)
+
+    def _submit_annotations(self, inflight: "_InFlight", preds) -> None:
+        """Hand this batch's flagged (non-benign) valid rows to the async
+        lane. Non-blocking: the lane's bounded queue absorbs or drops;
+        frames below ship regardless. Text is extracted lazily for flagged
+        rows only (~5% of traffic), so the raw/native paths keep their
+        zero-decode hot loop."""
+        labels = np.asarray(preds.labels)
+        flagged = np.flatnonzero(labels != 0)
+        if flagged.size == 0:
+            return
+        confs = _confidence_array(preds)
+        items = []
+        if inflight.raw:
+            # Predictions are positional over ALL rows; malformed rows hold
+            # padding garbage — keep valid ones only.
+            valid = frozenset(inflight.valid_idx)
+            for i in flagged.tolist():
+                if i not in valid:
+                    continue
+                text = self._annotation_text(inflight, i)
+                if text is not None:
+                    items.append((inflight.msgs[i].key, text,
+                                  int(labels[i]), float(confs[i])))
+        else:
+            for j in flagged.tolist():
+                i = inflight.valid_idx[j]
+                items.append((inflight.msgs[i].key, inflight.texts[i],
+                              int(labels[j]), float(confs[j])))
+        if items:
+            self._annotation_lane.submit(items)
+
+    def _annotation_text(self, inflight: "_InFlight", i: int) -> Optional[str]:
+        """Decoded text of row i in a raw-mode batch: the stored slice (or
+        the native path's encode-time span) covers the complete QUOTED JSON
+        string literal, so it round-trips through json.loads for exact
+        unescaping."""
+        lit = inflight.texts[i]
+        if lit is None and inflight.splice is not None:
+            _, span_start, span_len = inflight.splice
+            s = int(span_start[i])
+            lit = inflight.msgs[i].value[s : s + int(span_len[i])]
+        if lit is None:
+            return None
+        if isinstance(lit, str):
+            return lit
+        try:
+            return json.loads(lit)
+        except ValueError:  # can't happen for scanner-validated literals
+            return None
+
+    def annotation_stats(self) -> Optional[dict]:
+        """Async-lane counters (submitted/annotated/dropped/queue_depth),
+        or None when the engine runs inline or without explanations."""
+        lane = self._annotation_lane
+        return lane.stats() if lane is not None else None
+
+    def close_annotations(self, timeout: float = 30.0) -> bool:
+        """Drain and stop the async lane (no-op inline). Call after the
+        last run() when annotation completeness matters — run() itself
+        leaves the lane up so repeated runs share it."""
+        lane = self._annotation_lane
+        return lane.close(timeout) if lane is not None else True
 
     def _native_frames(self) -> bool:
         """Native output-frame assembly available? (cached after first ask)"""

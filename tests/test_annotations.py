@@ -1,0 +1,215 @@
+"""Async annotation lane (stream/annotations.py): classification must never
+wait for LLM decode. Covers the bounded-queue/drop-oldest contract, degraded
+mode, and the engine integration — flagged rows annotate onto the side topic
+while the classified frames ship analysis-free through the native fast path.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from fraud_detection_tpu.stream import AsyncAnnotationLane, InProcessBroker
+from fraud_detection_tpu.stream import StreamingClassifier
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    return synthetic_demo_pipeline(batch_size=64, n=400, seed=3,
+                                   num_features=2048,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
+
+
+def _lane(broker, fn, **kw):
+    return AsyncAnnotationLane(fn, broker.producer(), "annotations", **kw)
+
+
+def test_lane_annotates_and_keys_records():
+    broker = InProcessBroker(num_partitions=2)
+    lane = _lane(broker, lambda t, l, c: [f"analysis {x}" for x in l])
+    lane.submit([(b"k1", "text one", 1, 0.9), (b"k2", "text two", 2, 0.8)])
+    assert lane.close(timeout=10.0)
+    recs = broker.messages("annotations")
+    assert len(recs) == 2
+    by_key = {m.key: json.loads(m.value) for m in recs}
+    assert by_key[b"k1"] == {"prediction": 1, "label": "Potential Scam",
+                             "confidence": 0.9, "analysis": "analysis 1"}
+    assert by_key[b"k2"]["prediction"] == 2
+    assert lane.stats() == {"submitted": 2, "annotated": 2, "dropped": 0,
+                            "backend_errors": 0, "queue_depth": 0}
+
+
+def test_lane_bounded_queue_drops_oldest():
+    broker = InProcessBroker()
+    gate = threading.Event()
+    seen = []
+
+    def fn(texts, labels, confs):
+        gate.wait(5.0)               # hold the worker so the queue fills
+        seen.extend(texts)
+        return ["a"] * len(texts)
+
+    lane = _lane(broker, fn, max_queue=4, max_batch=64)
+    # One submit call is atomic vs the worker: 10 rows into a 4-slot queue
+    # drops the 6 oldest.
+    lane.submit([(None, f"t{i}", 1, 0.5) for i in range(10)])
+    gate.set()
+    assert lane.close(timeout=10.0)
+    s = lane.stats()
+    assert s["submitted"] == 10 and s["dropped"] == 6
+    # The kept rows are the NEWEST (a sliding recent sample under overload).
+    assert set(seen) <= {f"t{i}" for i in range(6, 10)}
+
+
+def test_lane_batches_at_max_batch():
+    broker = InProcessBroker()
+    calls = []
+    lane = _lane(broker, lambda t, l, c: (calls.append(len(t)),
+                                          ["a"] * len(t))[1],
+                 max_batch=3)
+    lane.submit([(None, f"t{i}", 1, 0.5) for i in range(7)])
+    assert lane.close(timeout=10.0)
+    assert sum(calls) == 7
+    assert max(calls) <= 3
+
+
+def test_lane_survives_backend_failure():
+    broker = InProcessBroker()
+    state = {"n": 0}
+
+    def fn(texts, labels, confs):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise RuntimeError("backend down")
+        return ["recovered"] * len(texts)
+
+    lane = _lane(broker, fn)
+    lane.submit([(b"k1", "first", 1, 0.5)])
+    lane.drain(timeout=10.0)
+    lane.submit([(b"k2", "second", 1, 0.5)])
+    assert lane.close(timeout=10.0)
+    s = lane.stats()
+    assert s["backend_errors"] == 1
+    assert s["annotated"] == 1           # the failed batch's row is dropped
+    assert [m.key for m in broker.messages("annotations")] == [b"k2"]
+
+
+def test_lane_skips_none_analyses():
+    broker = InProcessBroker()
+    lane = _lane(broker, lambda t, l, c: [None if x == 0 else "flagged"
+                                          for x in l])
+    lane.submit([(b"a", "benign", 0, 0.1), (b"b", "scam", 1, 0.9)])
+    assert lane.close(timeout=10.0)
+    recs = broker.messages("annotations")
+    assert [m.key for m in recs] == [b"b"]
+    assert lane.stats()["annotated"] == 1
+
+
+def test_lane_length_mismatch_is_backend_error():
+    broker = InProcessBroker()
+    lane = _lane(broker, lambda t, l, c: ["only-one"])
+    lane.submit([(None, "t1", 1, 0.5), (None, "t2", 1, 0.5)])
+    assert lane.close(timeout=10.0)
+    assert lane.stats()["backend_errors"] == 1
+    assert broker.messages("annotations") == []
+
+
+def test_engine_async_annotations_end_to_end(pipeline):
+    """explain_async=True: classified frames ship WITHOUT analysis (and the
+    raw-JSON fast path stays in play — inline hooks disable it), flagged
+    rows land on the annotations side topic keyed like their sources."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    corpus = generate_corpus(n=40, seed=13, hard_fraction=0.0,
+                             label_noise=0.0)
+    broker = InProcessBroker(num_partitions=2)
+    producer = broker.producer()
+    for i, d in enumerate(corpus):
+        producer.produce("customer-dialogues-raw",
+                         json.dumps({"text": d.text, "id": i}).encode(),
+                         key=str(i).encode())
+
+    def explain_batch(texts, labels, confs):
+        assert all(l != 0 for l in labels)     # engine pre-filters flagged
+        return [f"async analysis label={l}" for l in labels]
+
+    engine = StreamingClassifier(
+        pipeline, broker.consumer(["customer-dialogues-raw"], "grp"),
+        broker.producer(), "out", batch_size=16, max_wait=0.01,
+        explain_batch_fn=explain_batch, explain_async=True)
+    stats = engine.run(max_messages=40, idle_timeout=0.2)
+    assert engine.close_annotations(timeout=30.0)
+
+    assert stats.processed == 40
+    assert engine._json_fast is True          # fast path NOT disabled
+    outs = {m.key: json.loads(m.value) for m in broker.messages("out")}
+    assert len(outs) == 40
+    assert all("analysis" not in o for o in outs.values())
+    flagged = {k for k, o in outs.items() if o["prediction"] != 0}
+    assert flagged                            # the corpus has scams
+
+    recs = {m.key: json.loads(m.value) for m in
+            broker.messages("out-annotations")}
+    assert set(recs) == flagged               # every flagged row annotated
+    for k, r in recs.items():
+        assert r["prediction"] == outs[k]["prediction"]
+        assert r["confidence"] == outs[k]["confidence"]
+        assert r["analysis"] == f"async analysis label={r['prediction']}"
+    s = engine.annotation_stats()
+    assert s["annotated"] == len(flagged) and s["dropped"] == 0
+
+
+def test_engine_async_requires_batch_fn(pipeline):
+    broker = InProcessBroker()
+    with pytest.raises(ValueError, match="explain_async"):
+        StreamingClassifier(
+            pipeline, broker.consumer(["t"], "g"), broker.producer(), "out",
+            explain_async=True)
+
+
+def test_engine_inline_has_no_lane(pipeline):
+    broker = InProcessBroker()
+    engine = StreamingClassifier(
+        pipeline, broker.consumer(["t"], "g"), broker.producer(), "out")
+    assert engine.annotation_stats() is None
+    assert engine.close_annotations() is True
+
+
+def test_engine_async_slow_backend_never_blocks_classification(pipeline):
+    """A backend 100x slower than the stream must not throttle it: the run
+    finishes at transport speed with annotations trailing/dropping, not
+    serialized behind decode (the inline hook's failure mode)."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    corpus = generate_corpus(n=60, seed=21, hard_fraction=0.0,
+                             label_noise=0.0)
+    broker = InProcessBroker()
+    producer = broker.producer()
+    for i, d in enumerate(corpus):
+        producer.produce("customer-dialogues-raw",
+                         json.dumps({"text": d.text}).encode(),
+                         key=str(i).encode())
+
+    def slow_explain(texts, labels, confs):
+        time.sleep(0.25)                      # "decode" far slower than poll
+        return ["slow"] * len(texts)
+
+    engine = StreamingClassifier(
+        pipeline, broker.consumer(["customer-dialogues-raw"], "grp"),
+        broker.producer(), "out", batch_size=16, max_wait=0.01,
+        explain_batch_fn=slow_explain, explain_async=True)
+    t0 = time.perf_counter()
+    stats = engine.run(max_messages=60, idle_timeout=0.2)
+    run_s = time.perf_counter() - t0
+    assert stats.processed == 60
+    assert len(broker.messages("out")) == 60
+    # Inline, 60 msgs in 16-row batches would pay >= 4 * 0.25s of decode
+    # inside the loop; async classification must not have waited for it.
+    lane_work = engine.annotation_stats()
+    assert lane_work["submitted"] > 0
+    assert run_s < 0.9, f"classification waited on the annotator: {run_s:.2f}s"
+    engine.close_annotations(timeout=30.0)

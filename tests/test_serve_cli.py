@@ -121,3 +121,58 @@ def test_explain_spec_validation():
     with pytest.raises(SystemExit, match="unknown --explain"):
         serve_main(["--model", "synthetic", "--demo", "10",
                     "--explain", "bogus"])
+
+
+def test_demo_async_explanations(artifact_spec, capsys):
+    """--explain-async: classified frames ship analysis-free at full rate;
+    flagged rows land on the annotations side topic; the stats JSON carries
+    the lane's counters (the CLI surface of stream/annotations.py)."""
+    import json as j
+
+    built = {}
+    from fraud_detection_tpu.stream import InProcessBroker
+
+    class SpyBroker(InProcessBroker):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            built["broker"] = self
+
+    import fraud_detection_tpu.stream as stream_pkg
+    old = stream_pkg.InProcessBroker
+    stream_pkg.InProcessBroker = SpyBroker
+    try:
+        rc = serve_main(["--model", artifact_spec, "--demo", "120",
+                         "--batch-size", "32", "--max-wait", "0.01",
+                         "--explain", "canned", "--explain-async"])
+    finally:
+        stream_pkg.InProcessBroker = old
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = j.loads([l for l in out.splitlines() if l.startswith("{")][0])
+    assert stats["processed"] == 120
+    ann = stats["annotations"]
+    assert ann["annotated"] > 0 and ann["backend_errors"] == 0
+    broker = built["broker"]
+    outs = {m.key: j.loads(m.value)
+            for m in broker.messages("dialogues-classified")}
+    assert len(outs) == 120
+    assert all("analysis" not in o for o in outs.values())
+    flagged = {k for k, o in outs.items() if o["prediction"] != 0}
+    recs = {m.key: j.loads(m.value)
+            for m in broker.messages("dialogues-classified-annotations")}
+    assert set(recs) == flagged
+    assert ann["annotated"] == len(flagged)
+    assert all("offline analysis stub" in r["analysis"]
+               for r in recs.values())
+
+
+def test_explain_async_requires_backend():
+    with pytest.raises(SystemExit, match="explain-async"):
+        serve_main(["--model", "synthetic", "--demo", "10",
+                    "--explain-async"])
+
+
+def test_annotations_topic_requires_async():
+    with pytest.raises(SystemExit, match="annotations-topic"):
+        serve_main(["--model", "synthetic", "--demo", "10",
+                    "--explain", "canned", "--annotations-topic", "audit"])
