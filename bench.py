@@ -668,13 +668,29 @@ def llm_bench() -> dict:
     # weight-streaming metric by ~15% — 256 amortizes it to ~4% and matches
     # a realistic explanation length. decode_tokens records the change.
     n_new = 256
-    model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)  # compile
-    t0 = time.perf_counter()
-    out = model.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
-    dt = time.perf_counter() - t0
-    emitted = _emitted(out)
+
+    def timed_decode(m) -> tuple:
+        """Best-of-2 single-stream decode (seconds, tokens emitted): a host
+        contention spike during the one ~1.5s timed window otherwise puts
+        run-to-run noise (~8% observed) straight into the headline
+        decode_*_pct_hbm_peak fields."""
+        m.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)  # compile
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = m.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
+            dt_i = time.perf_counter() - t0
+            if best is None or dt_i < best[0]:
+                best = (dt_i, _emitted(out))
+        return best
+
+    dt, emitted = timed_decode(model)
     line.update({"decode_tok_per_s": round(emitted / dt, 1),
-                 "decode_tokens": emitted})
+                 "decode_tokens": emitted,
+                 # Methodology marker: single-sample through the fifth r5
+                 # validation run, best-of-2 after — cross-round readers
+                 # must not read the change as a speedup.
+                 "decode_best_of": 2})
     if hbm_peak:
         # Single-stream decode is weight-streaming bound: every token reads
         # all param bytes from HBM once.
@@ -777,11 +793,7 @@ def llm_bench() -> dict:
             qmodel = model.quantized()
             jax.block_until_ready(qmodel.params)
         q_bytes = _tree_bytes(qmodel.params)
-        qmodel.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
-        t0 = time.perf_counter()
-        out_q = qmodel.generate_tokens(np.asarray(prompt), max_new_tokens=n_new)
-        qdt = time.perf_counter() - t0
-        emitted_q = _emitted(out_q)
+        qdt, emitted_q = timed_decode(qmodel)
         line["decode_int8_tok_per_s"] = round(emitted_q / qdt, 1)
         if hbm_peak:
             line["decode_int8_weight_stream_gbps"] = round(
