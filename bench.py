@@ -874,7 +874,9 @@ def _explain_serve_bench(lm) -> dict:
             broker.producer(), "dialogues-classified",
             batch_size=batch_size, max_wait=0.01,
             explain_batch_fn=hook if mode != "off" else None,
-            explain_async=mode == "async")
+            explain_async=mode == "async",
+            annotations_producer=(broker.producer() if mode == "async"
+                                  else None))
         t0 = time.perf_counter()
         stats = engine.run(max_messages=n_msgs, idle_timeout=10.0)
         assert stats.processed == n_msgs, stats.as_dict()

@@ -172,6 +172,7 @@ def main(argv=None) -> int:
         from fraud_detection_tpu.stream.kafka import KafkaConsumer, KafkaProducer
 
         make_clients = lambda: (KafkaConsumer([args.input_topic]), KafkaProducer())
+        make_producer = KafkaProducer
         max_messages, idle = args.max_messages, None
     elif args.demo > 0:
         from fraud_detection_tpu.data import generate_corpus
@@ -186,6 +187,7 @@ def main(argv=None) -> int:
                            key=str(i).encode())
         make_clients = lambda: (broker.consumer([args.input_topic], "serve-demo"),
                                 broker.producer())
+        make_producer = broker.producer
         max_messages = args.max_messages if args.max_messages is not None else args.demo
         idle = 1.0
     else:
@@ -193,14 +195,23 @@ def main(argv=None) -> int:
 
     engines_built = []   # async lanes to drain + aggregate at exit
 
-    def make_engine():
+    def make_engine(replacing=None):
+        """Build an engine; ``replacing`` is the previous incarnation on a
+        supervised-restart path — its async lane is stopped first (briefly
+        drained) so restarts don't accumulate worker threads, each pinning
+        a producer."""
+        if replacing is not None:
+            replacing.close_annotations(timeout=5.0)
         c, p = make_clients()
         e = StreamingClassifier(pipe, c, p, args.output_topic,
                                 batch_size=args.batch_size, max_wait=args.max_wait,
                                 pipeline_depth=args.pipeline_depth,
                                 explain_batch_fn=explain_hook,
                                 explain_async=args.explain_async,
-                                annotations_topic=args.annotations_topic)
+                                annotations_topic=args.annotations_topic,
+                                annotations_producer=(
+                                    make_producer() if args.explain_async
+                                    else None))
         engines_built.append(e)
         return e
 
@@ -266,7 +277,7 @@ def main(argv=None) -> int:
                 if prebuilt[i] is not None:
                     live[i], prebuilt[i] = prebuilt[i], None
                 else:
-                    live[i] = make_engine()
+                    live[i] = make_engine(replacing=live[i])
                 if shutdown.is_set():
                     live[i].stop()
                 return live[i]
@@ -343,8 +354,11 @@ def main(argv=None) -> int:
         # (including on Ctrl-C, where it returns the aggregated stats).
         from fraud_detection_tpu.stream.engine import run_supervised
 
-        stats = run_supervised(make_engine, max_restarts=args.supervise,
-                               max_messages=max_messages, idle_timeout=idle)
+        stats = run_supervised(
+            lambda: make_engine(
+                replacing=engines_built[-1] if engines_built else None),
+            max_restarts=args.supervise,
+            max_messages=max_messages, idle_timeout=idle)
     else:
         engine = make_engine()
         try:

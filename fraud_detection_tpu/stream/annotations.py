@@ -33,7 +33,7 @@ import json
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 from fraud_detection_tpu.explain.prompts import label_name
 from fraud_detection_tpu.utils import get_logger
@@ -51,7 +51,10 @@ class AsyncAnnotationLane:
 
     ``producer``/``topic``: where annotation records go. Records are JSON:
     ``{"prediction", "label", "confidence", "analysis"}`` keyed by the
-    source message's key.
+    source message's key. The producer must be the lane's OWN (a second
+    client on the same transport), never shared with the engine: flush()
+    is how both sides account delivery, and sharing would let either side
+    consume the other's failures (StreamingClassifier enforces this).
     """
 
     def __init__(self, explain_batch_fn: Callable, producer, topic: str, *,

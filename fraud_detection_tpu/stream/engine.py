@@ -184,12 +184,23 @@ class StreamingClassifier:
                                             List[Optional[str]]]] = None,
         explain_async: bool = False,
         annotations_topic: Optional[str] = None,
+        annotations_producer: Optional[Producer] = None,
         tracer: Optional[Tracer] = None,
     ):
         if pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
         if explain_async and explain_batch_fn is None:
             raise ValueError("explain_async requires explain_batch_fn")
+        if explain_async and annotations_producer is None:
+            # NOT defaulted to the engine's producer: flush() is how both
+            # sides account delivery (engine: commit-only-if-drained;
+            # lane: annotated counters), and a shared producer would let
+            # either side consume the other's delivery failures — the
+            # engine could commit past a lost classification record, or a
+            # failed annotation could halt the classification stream.
+            raise ValueError(
+                "explain_async requires a dedicated annotations_producer "
+                "(a second producer on the same transport)")
         self.pipeline = pipeline
         self.consumer = consumer
         self.producer = producer
@@ -216,7 +227,7 @@ class StreamingClassifier:
                 AsyncAnnotationLane)
 
             self._annotation_lane = AsyncAnnotationLane(
-                explain_batch_fn, producer,
+                explain_batch_fn, annotations_producer,
                 annotations_topic or f"{output_topic}-annotations")
             self.explain_fn = explain_fn = None
             self.explain_batch_fn = explain_batch_fn = None

@@ -140,7 +140,8 @@ def test_engine_async_annotations_end_to_end(pipeline):
     engine = StreamingClassifier(
         pipeline, broker.consumer(["customer-dialogues-raw"], "grp"),
         broker.producer(), "out", batch_size=16, max_wait=0.01,
-        explain_batch_fn=explain_batch, explain_async=True)
+        explain_batch_fn=explain_batch, explain_async=True,
+        annotations_producer=broker.producer())
     stats = engine.run(max_messages=40, idle_timeout=0.2)
     assert engine.close_annotations(timeout=30.0)
 
@@ -201,7 +202,8 @@ def test_engine_async_slow_backend_never_blocks_classification(pipeline):
     engine = StreamingClassifier(
         pipeline, broker.consumer(["customer-dialogues-raw"], "grp"),
         broker.producer(), "out", batch_size=16, max_wait=0.01,
-        explain_batch_fn=slow_explain, explain_async=True)
+        explain_batch_fn=slow_explain, explain_async=True,
+        annotations_producer=broker.producer())
     t0 = time.perf_counter()
     stats = engine.run(max_messages=60, idle_timeout=0.2)
     run_s = time.perf_counter() - t0
@@ -213,3 +215,15 @@ def test_engine_async_slow_backend_never_blocks_classification(pipeline):
     assert lane_work["submitted"] > 0
     assert run_s < 0.9, f"classification waited on the annotator: {run_s:.2f}s"
     engine.close_annotations(timeout=30.0)
+
+
+def test_engine_async_requires_dedicated_producer(pipeline):
+    """Sharing the engine's producer would cross-contaminate flush()-based
+    delivery accounting (engine: commit-only-if-drained; lane: annotated
+    counters) — the constructor refuses."""
+    broker = InProcessBroker()
+    with pytest.raises(ValueError, match="annotations_producer"):
+        StreamingClassifier(
+            pipeline, broker.consumer(["t"], "g"), broker.producer(), "out",
+            explain_batch_fn=lambda t, l, c: [None] * len(t),
+            explain_async=True)
