@@ -28,16 +28,30 @@ def main():
     producer.produce("customer-dialogues-raw", b"not json", key=b"oops")
 
     consumer = broker.consumer(["customer-dialogues-raw"], "demo-group")
+    # Async annotation lane: flagged rows get an LLM-style analysis on a
+    # keyed side topic while classification runs at full rate (a canned
+    # backend stands in for the on-pod LLM; swap in
+    # make_stream_explain_hook(OnPodBackend.from_hf_checkpoint(...)) for
+    # real analyses — docs/serving.md).
     engine = StreamingClassifier(
         pipe, consumer, broker.producer(), "dialogues-classified",
-        batch_size=128, max_wait=0.01, pipeline_depth=2)
+        batch_size=128, max_wait=0.01, pipeline_depth=2,
+        explain_batch_fn=lambda texts, labels, confs:
+            [f"flagged: {len(t.split())}-word dialogue" for t in texts],
+        explain_async=True, annotations_producer=broker.producer())
     stats = engine.run(max_messages=501, idle_timeout=2.0)
+    engine.close_annotations(timeout=10.0)
 
     outs = broker.messages("dialogues-classified")
+    annos = broker.messages("dialogues-classified-annotations")
     print(f"processed={stats.processed} malformed={stats.malformed} "
           f"rate={stats.msgs_per_sec:.0f} msgs/sec "
           f"p50={stats.latency_percentile(50)*1e3:.0f}ms")
+    print(f"async annotations on side topic: {len(annos)} "
+          f"({engine.annotation_stats()})")
     print("sample output:", outs[0].value.decode()[:120], "...")
+    if annos:
+        print("sample annotation:", annos[0].value.decode()[:120], "...")
 
 
 if __name__ == "__main__":
