@@ -73,13 +73,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 @partial(jax.jit, static_argnames=("blk_q", "blk_k", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    blk_q: int = 128, blk_k: int = 128,
+                    blk_q: int = 0, blk_k: int = 0,
                     interpret: bool = False) -> jax.Array:
     """Causal flash attention. q/k/v: (B, T, H, d) — the ``models/llm.py``
     layout (GQA already expanded by the caller, matching ``_attend``).
     Returns (B, T, H, d). Matches ``_attend(q, k, v, tril)`` to f32
-    round-off; enforced by tests/test_flash_attention.py."""
+    round-off; enforced by tests/test_flash_attention.py.
+
+    ``blk_q``/``blk_k`` default (0) to shape-aware auto-selection: 512x512
+    for T >= 512, else 128x128. Each query block re-streams ALL of K/V
+    through VMEM, so K/V DMA scales as (T/blk_q)*T — on the 2B serving
+    config the 128x128 default measured 16.0k prefill tok/s at T=8192
+    (45.6% MFU) vs 26.8-27.5k at 512-wide blocks (76-78% MFU), with
+    T=2048 improving 22.9k -> 27.9k too (device sweep, r5). 512x512 keeps
+    the f32 score tile + accumulators comfortably inside VMEM (~3MB).
+    Ragged T guard: wide blocks also widen t_pad, and padded q-blocks run
+    both matmuls before being sliced off — so auto-selection takes the
+    largest block adding at most ~12.5% padding over the 128-granularity
+    floor (T=4000 -> 512 via 1.6% waste; T=640 stays 128, where 512
+    would pad 60%)."""
     B, T, H, d = q.shape
+    if not blk_q or not blk_k:
+        floor = _round_up(T, 128)
+        auto = next(b for b in (512, 256, 128)
+                    if _round_up(T, b) * 8 <= floor * 9)
+        blk_q = blk_q or auto
+        blk_k = blk_k or auto
     scale = 1.0 / math.sqrt(d)
     d_pad = _round_up(d, 128)
     t_pad = _round_up(T, max(blk_q, blk_k))
