@@ -122,10 +122,7 @@ class AsyncAnnotationLane:
                               "classification unaffected", len(batch))
 
     def _annotate(self, batch: List[tuple]) -> None:
-        keys = [b[0] for b in batch]
-        texts = [b[1] for b in batch]
-        labels = [b[2] for b in batch]
-        confs = [b[3] for b in batch]
+        keys, texts, labels, confs = map(list, zip(*batch))
         analyses = self._fn(texts, labels, confs)
         if len(analyses) != len(batch):  # mirrors the engine's inline check
             raise ValueError(f"explain_batch_fn returned {len(analyses)} "
