@@ -746,8 +746,11 @@ def llm_bench() -> dict:
     # the array shows where. The B=8 fields above remain the cross-round
     # comparable headline. BENCH_LLM_SCALING=0 skips.
     if os.environ.get("BENCH_LLM_SCALING", "1") != "0" and scale == "gemma2b":
+        # B=64 is the explain hook's max power-of-two bucket (the
+        # explain_serve leg's 54-row flagged batches round up to it), so
+        # the array covers the range production actually decodes at.
         line["batch_decode_scaling"] = {}
-        for Bs in (8, 16, 32):
+        for Bs in (8, 16, 32, 64):
             tp_s = [model.tokenizer.encode(p) for p in mk_prompts(Bs)]
             model.generate_tokens_batch(tp_s, max_new_tokens=n_new)  # compile
             t0 = time.perf_counter()
