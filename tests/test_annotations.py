@@ -227,3 +227,18 @@ def test_engine_async_requires_dedicated_producer(pipeline):
             pipeline, broker.consumer(["t"], "g"), broker.producer(), "out",
             explain_batch_fn=lambda t, l, c: [None] * len(t),
             explain_async=True)
+
+
+def test_lane_close_is_idempotent_and_latching():
+    """serve's supervised-restart path closes the replaced engine's lane and
+    finish_annotations() closes every built engine again at exit — double
+    close must be safe, and a closed lane must ignore late submits (a
+    replaced incarnation's _finish could still be unwinding)."""
+    broker = InProcessBroker()
+    lane = _lane(broker, lambda t, l, c: ["a"] * len(t))
+    lane.submit([(b"k", "text", 1, 0.5)])
+    assert lane.close(timeout=10.0)
+    assert lane.close(timeout=10.0)          # second close: clean no-op
+    lane.submit([(b"late", "text", 1, 0.5)])  # latched: dropped silently
+    assert lane.stats()["submitted"] == 1
+    assert [m.key for m in broker.messages("annotations")] == [b"k"]
