@@ -763,9 +763,10 @@ def llm_bench() -> dict:
 
     # int8 weight-only decode (models/llm.py quantize_params): decode is
     # weight-streaming bound, so halving the bytes moves tokens/sec — the
-    # convert+scale fuses into each dot's operand load. Measured on the 2B
-    # model: 111 -> 182 tok/s single stream, 14.2 -> 21.2 explanations/sec
-    # at B=8. BENCH_LLM_Q8=0 skips (the quantize + recompile adds ~2 min).
+    # raw int8 enters the dot and the per-channel scale multiplies the
+    # OUTPUT (exact; no operand-fusion reliance). Measured on the 2B
+    # model: 135.7 -> 240.7 tok/s single stream (1.77x), 3.9 -> 6.8
+    # explanations/sec at B=8. BENCH_LLM_Q8=0 skips.
     if os.environ.get("BENCH_LLM_Q8", "1") != "0" and scale == "gemma2b":
         # The int8 model arrives through the quantize-before-upload path
         # (load_hf_checkpoint(int8=True)): half the bytes through the

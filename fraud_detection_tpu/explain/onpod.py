@@ -91,7 +91,7 @@ class OnPodBackend(_GenerateMixin):
         ``int8=True`` loads weight-only-quantized (``load_hf_checkpoint``'s
         host-side quantize-before-upload — half the bytes through the
         tunnel-bound device transfer, same weights as an after-load
-        ``quantize_params``): ~1.5x explanations/sec on a 2B model at
+        ``quantize_params``): ~1.7x explanations/sec on a 2B model at
         >0.999 logit correlation — opt-in, because greedy decodes can
         still differ from bf16 near ties. Composes with ``mesh``: Q8
         leaves shard componentwise (q on the weight's TP spec, the scale

@@ -172,8 +172,9 @@ def shard_params(params: Params, cfg: TransformerConfig, mesh: Mesh) -> Params:
 
 # ---------------------------------------------------------------------------
 # int8 weight-only quantization (decode is weight-streaming bound: bf16
-# decode on the 2B model measures ~65% of HBM peak, so halving the weight
-# bytes is the one lever that moves single-stream tokens/sec)
+# decode on the 2B model measures ~81-83% of HBM peak at 256-token
+# samples, so halving the weight bytes is the one lever that moves
+# single-stream tokens/sec — measured 1.77x, 135.7 -> 240.7 tok/s)
 # ---------------------------------------------------------------------------
 
 @jax.tree_util.register_dataclass
