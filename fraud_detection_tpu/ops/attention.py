@@ -75,10 +75,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     blk_q: int = 0, blk_k: int = 0,
                     interpret: bool = False) -> jax.Array:
-    """Causal flash attention. q/k/v: (B, T, H, d) — the ``models/llm.py``
-    layout (GQA already expanded by the caller, matching ``_attend``).
-    Returns (B, T, H, d). Matches ``_attend(q, k, v, tril)`` to f32
-    round-off; enforced by tests/test_flash_attention.py.
+    """Causal flash attention. q: (B, T, H, d); k/v: (B, T, Hkv, d) with
+    H % Hkv == 0 — GQA/MQA kv stay at their NATIVE width and the kernel's
+    index map hands each query head its group's K/V block, so nothing
+    expands: on Gemma-2B (MQA, H=8, Hkv=1) the pre-r5 caller-side
+    ``jnp.repeat`` materialized and streamed 8x the K/V bytes. Hkv == H
+    recovers plain MHA. Returns (B, T, H, d). Matches
+    ``_attend(q, expand(k), expand(v), tril)`` to f32 round-off; enforced
+    by tests/test_flash_attention.py.
 
     ``blk_q``/``blk_k`` default (0) to shape-aware auto-selection: 512x512
     for T >= 512, else 128x128. Each query block re-streams ALL of K/V
@@ -93,6 +97,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     floor (T=4000 -> 512 via 1.6% waste; T=640 stays 128, where 512
     would pad 60%)."""
     B, T, H, d = q.shape
+    h_kv = k.shape[2]
+    if H % h_kv or v.shape[2] != h_kv:
+        raise ValueError(f"kv heads {k.shape[2]}/{v.shape[2]} must divide "
+                         f"query heads {H}")
+    rep = H // h_kv
     if not blk_q or not blk_k:
         floor = _round_up(T, 128)
         auto = next(b for b in (512, 256, 128)
@@ -104,11 +113,17 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     t_pad = _round_up(T, max(blk_q, blk_k))
 
     def prep(x):
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, T, d)
+        h = x.shape[2]
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(B * h, T, d)
         return jnp.pad(x, ((0, 0), (0, t_pad - T), (0, d_pad - d)))
 
     qf, kf, vf = prep(q), prep(k), prep(v)
     n_q, n_k = t_pad // blk_q, t_pad // blk_k
+
+    def kv_row(b, qi, si):
+        # grid row b = bi * H + hi over (B*H); its kv row is
+        # bi * Hkv + hi // rep over (B*Hkv).
+        return (b // H) * h_kv + (b % H) // rep, si, 0
 
     out = pl.pallas_call(
         partial(_flash_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, n_k=n_k),
@@ -116,9 +131,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         in_specs=[
             pl.BlockSpec((1, blk_q, d_pad), lambda b, qi, si: (b, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d_pad), lambda b, qi, si: (b, si, 0),
+            pl.BlockSpec((1, blk_k, d_pad), kv_row,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d_pad), lambda b, qi, si: (b, si, 0),
+            pl.BlockSpec((1, blk_k, d_pad), kv_row,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, blk_q, d_pad), lambda b, qi, si: (b, qi, 0),

@@ -65,3 +65,41 @@ def test_forward_uses_flash_above_threshold(monkeypatch):
     flash_logits, _ = llm.forward(params, tokens, cfg)
     np.testing.assert_allclose(np.asarray(flash_logits),
                                np.asarray(ref_logits), atol=5e-4, rtol=5e-4)
+
+
+def test_flash_gqa_native_kv_matches_expanded():
+    """GQA/MQA kv at native width through the kernel's head-group index map
+    must equal the expanded-kv computation exactly (same blocks, same
+    accumulation order — the expansion only changes WHERE K/V bytes come
+    from, not the math)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fraud_detection_tpu.models.llm import _attend
+    from fraud_detection_tpu.ops.attention import auto_interpret, flash_attention
+
+    B, T, H, Hkv, d = 2, 192, 4, 1, 32
+    rng = jax.random.PRNGKey(5)
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (B, T, H, d), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, T, Hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, T, Hkv, d), jnp.float32)
+    ke, ve = (jnp.repeat(t, H // Hkv, axis=2) for t in (k, v))
+
+    interp = auto_interpret()
+    native = flash_attention(q, k, v, interpret=interp)
+    expanded = flash_attention(q, ke, ve, interpret=interp)
+    np.testing.assert_array_equal(np.asarray(native), np.asarray(expanded))
+
+    tril = jnp.tril(jnp.ones((T, T), bool))
+    np.testing.assert_allclose(np.asarray(native),
+                               np.asarray(_attend(q, ke, ve, tril)),
+                               rtol=2e-5, atol=2e-5)
+
+    # GQA with 2 groups exercises a non-trivial b%H//rep map.
+    k2 = jax.random.normal(jax.random.fold_in(rng, 3), (B, T, 2, d), jnp.float32)
+    v2 = jax.random.normal(jax.random.fold_in(rng, 4), (B, T, 2, d), jnp.float32)
+    ke2, ve2 = (jnp.repeat(t, 2, axis=2) for t in (k2, v2))
+    np.testing.assert_array_equal(
+        np.asarray(flash_attention(q, k2, v2, interpret=interp)),
+        np.asarray(flash_attention(q, ke2, ve2, interpret=interp)))

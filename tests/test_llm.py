@@ -480,3 +480,35 @@ def test_quantize_params_host_matches_device():
     host_half = quantize_params_host(params_np, include_embed=False)
     assert not isinstance(dev_half["embed"], Q8)
     assert not isinstance(host_half["embed"], Q8)
+
+
+def test_flash_gqa_narrow_kv_gradients_match_expanded():
+    """Differentiating the auto-dispatched flash path with NARROW GQA kv
+    must produce dk/dv at the narrow width, equal to the expanded-kv
+    gradients summed over each head group (the vjp of the expansion).
+    Pins _flash_diff_bwd's rep != 1 branch — forward parity alone would
+    not catch a dropped group-sum or wrong repeat axis."""
+    from fraud_detection_tpu.models.llm import causal_attention
+
+    B, T, H, Hkv, d = 1, 640, 4, 2, 16   # T >= _FLASH_MIN_T: flash dispatch
+    rng = jax.random.PRNGKey(7)
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (B, T, H, d), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, T, Hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, T, Hkv, d), jnp.float32)
+
+    def loss_narrow(q_, k_, v_):
+        return causal_attention(q_, k_, v_).astype(jnp.float32).sum()
+
+    def loss_expanded(q_, k_, v_):
+        ke, ve = (jnp.repeat(t, H // Hkv, axis=2) for t in (k_, v_))
+        return causal_attention(q_, ke, ve).astype(jnp.float32).sum()
+
+    gq, gk, gv = jax.grad(loss_narrow, argnums=(0, 1, 2))(q, k, v)
+    assert gk.shape == k.shape and gv.shape == v.shape
+    eq, ek, ev = jax.grad(loss_expanded, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(eq),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(ek),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(ev),
+                               rtol=1e-5, atol=1e-5)
