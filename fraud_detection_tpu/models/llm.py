@@ -519,13 +519,17 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
     step, keys are processed in ``key_chunk`` slices so score memory stays
     O(T_loc * key_chunk).
 
-    q, k, v: (B, T_loc, H, d) — this device's sequence shard.
-    Device r owns global positions [r*T_loc, (r+1)*T_loc).
+    q: (B, T_loc, H, d) — this device's sequence shard; k/v may be at
+    their NARROW GQA/MQA width (B, T_loc, Hkv, d): blocks transit the ring
+    narrow — 1/rep of the ICI bytes per rotation (8x less for Gemma-2B's
+    MQA) — and expand to query width only on arrival, for the local
+    chunk attend. Device r owns global positions [r*T_loc, (r+1)*T_loc).
     """
     if key_chunk < 1:
         raise ValueError(f"key_chunk must be >= 1, got {key_chunk}")
     idx = jax.lax.axis_index(axis_name)
     B, T, H, d = q.shape
+    rep = H // k.shape[2]
     qf = q.astype(jnp.float32)
     # Ceil-division chunking (T is static): the last chunk may overhang the
     # block; overhang keys are masked out via a sentinel position, so any
@@ -542,13 +546,16 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, blocks_per_ring: int,
         # after s rotations device idx holds the block produced by idx - s
         src = (idx - s) % blocks_per_ring
         q_pos = idx * T + jnp.arange(T)
+        # Expand AFTER transit: the block rode the ring at narrow width.
+        k_full = _expand_kv_heads(k_blk, rep)
+        v_full = _expand_kv_heads(v_blk, rep)
         if n_chunks == 1:
             k_pos = src * T + jnp.arange(T)
             m, l, acc = _online_softmax_update(
-                qf, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale)
+                qf, k_full, v_full, q_pos, k_pos, m, l, acc, scale)
         else:
-            k_pad = jnp.pad(k_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
-            v_pad = jnp.pad(v_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            k_pad = jnp.pad(k_full, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v_pad = jnp.pad(v_full, ((0, 0), (0, pad), (0, 0), (0, 0)))
             m, l, acc = _chunked_key_pass(
                 qf, q_pos, k_pad, v_pad, chunk=chunk, n_chunks=n_chunks,
                 base_pos=src * T, valid_len=T, far=far, carry=(m, l, acc),
@@ -604,9 +611,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     chunking) remains the memory-bounded choice for extreme T.
 
     q/k/v: (B, T, H, d) global; T and H must divide by the axis size.
+    Narrow GQA/MQA k/v are accepted and expanded HERE: the head<->sequence
+    all-to-all splits the head axis, which needs full query width (the
+    ring, which never reshards heads, ships kv narrow instead).
     """
     n = mesh.shape[axis_name]
     B, T, H, d = q.shape
+    k = _expand_kv_heads(k, H // k.shape[2])
+    v = _expand_kv_heads(v, H // v.shape[2])
     if T % n or H % n:
         raise ValueError(
             f"ulysses_attention needs T ({T}) and H ({H}) divisible by the "
@@ -624,8 +636,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    batch_axis: Optional[str] = None) -> jax.Array:
     """Exact causal attention with the sequence sharded over ``axis_name``.
 
-    q/k/v: (B, T, H, d) global arrays; T must divide by the axis size.
-    ``key_chunk`` bounds per-step score memory (see ``_RING_KEY_CHUNK``).
+    q: (B, T, H, d) global; k/v may be at their narrow GQA/MQA width
+    (B, T, Hkv, d) — they rotate the ring NARROW (1/rep of the ICI bytes;
+    8x less for MQA) and expand per arrival. T must divide by the axis
+    size. ``key_chunk`` bounds per-step score memory (see
+    ``_RING_KEY_CHUNK``).
     ``batch_axis``: on a 2-D (data, seq) mesh, also shard the batch dim —
     without it the shard_map spec would silently REPLICATE the batch across
     the data axis (an all-gather of every dp-sharded activation).
@@ -722,11 +737,13 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         elif seq_mesh is not None:
             # On a (data, seq) training mesh the batch dim rides the data
             # axis through the SP body; a pure-seq serving mesh has none.
+            # kv pass at native GQA width: the ring ships them narrow over
+            # ICI (1/rep of the bytes per rotation) and expands on arrival;
+            # ulysses expands at entry (its all-to-all splits heads).
             b_axis = DATA_AXIS if DATA_AXIS in seq_mesh.axis_names else None
             sp = (ulysses_attention if sp_impl == "ulysses"
                   else ring_attention)
-            attn = sp(q, expand_kv(k), expand_kv(v), seq_mesh,
-                      batch_axis=b_axis)
+            attn = sp(q, k, v, seq_mesh, batch_axis=b_axis)
         else:
             # kv at native GQA width: causal_attention expands only on the
             # XLA branches; the flash kernel maps heads to groups directly.

@@ -512,3 +512,44 @@ def test_flash_gqa_narrow_kv_gradients_match_expanded():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(gv), np.asarray(ev),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_ring_attention_narrow_kv_matches_dense(hkv):
+    """GQA/MQA kv ride the ring at NARROW width (1/rep of the ICI bytes per
+    rotation) and expand per arrival — must equal dense attention over the
+    expanded kv exactly as the full-width ring does. Covers both the
+    single-pass and key-chunked step bodies."""
+    from fraud_detection_tpu.models.llm import _expand_kv_heads
+
+    B, T, H, d = 2, 64, 4, 16
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, hkv, d)), jnp.float32)
+    ke, ve = (_expand_kv_heads(t, H // hkv) for t in (k, v))
+    dense = _attend(q, ke, ve, jnp.tril(jnp.ones((T, T), bool)))
+
+    ring = ring_attention(q, k, v, seq_mesh(8))
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+    chunked = ring_attention(q, k, v, seq_mesh(8), key_chunk=3)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_narrow_kv_matches_dense():
+    """Ulysses expands narrow kv at entry (its all-to-all splits the head
+    axis) — same result as pre-expanded kv."""
+    from fraud_detection_tpu.models.llm import _expand_kv_heads, ulysses_attention
+
+    B, T, H, d = 2, 64, 8, 16
+    rng = np.random.default_rng(10)
+    q = jnp.asarray(rng.normal(size=(B, T, H, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, 2, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, 2, d)), jnp.float32)
+    ke, ve = (_expand_kv_heads(t, 4) for t in (k, v))
+    dense = _attend(q, ke, ve, jnp.tril(jnp.ones((T, T), bool)))
+    out = ulysses_attention(q, k, v, seq_mesh(8))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
