@@ -176,3 +176,54 @@ def test_annotations_topic_requires_async():
     with pytest.raises(SystemExit, match="annotations-topic"):
         serve_main(["--model", "synthetic", "--demo", "10",
                     "--explain", "canned", "--annotations-topic", "audit"])
+
+
+def test_supervised_restart_closes_replaced_async_lane(artifact_spec,
+                                                       capsys, monkeypatch):
+    """--supervise + --explain-async: each restart incarnation's engine
+    replaces the previous one, whose annotation lane must be STOPPED (its
+    worker thread joined) — otherwise long-running supervised deployments
+    accumulate one polling thread + pinned producer per restart (the
+    round-5 high-effort review finding)."""
+    from fraud_detection_tpu.stream import StreamingClassifier
+
+    built = []
+    fails = {"n": 0}
+
+    class FlakyEngine(StreamingClassifier):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            built.append(self)
+
+        def run(self, *a, **k):
+            if fails["n"] == 0:
+                fails["n"] += 1
+                raise ConnectionError("transient broker hiccup")
+            # The restart-path close must have ALREADY happened when the
+            # replacement incarnation starts consuming — asserting after
+            # serve_main returns would be satisfied by the exit-time
+            # finish_annotations() drain even with the restart-path close
+            # deleted (review finding).
+            fails["lane0_closed_at_restart"] = (
+                not built[0]._annotation_lane._thread.is_alive())
+            return super().run(*a, **k)
+
+    monkeypatch.setattr("fraud_detection_tpu.stream.StreamingClassifier",
+                        FlakyEngine)
+    rc = serve_main(["--model", artifact_spec, "--demo", "150",
+                     "--batch-size", "32", "--supervise", "2",
+                     "--explain", "canned", "--explain-async"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = json.loads([l for l in out.splitlines() if l.startswith("{")][0])
+    assert stats["processed"] == 150 and stats["restarts"] == 1
+    assert stats["annotations"]["annotated"] > 0
+    # Two incarnations were built; the REPLACED one's lane was stopped by
+    # make_engine(replacing=...) before the replacement started consuming
+    # (not merely by the exit-time drain), and the survivor's by
+    # finish_annotations — none left polling.
+    assert len(built) == 2
+    assert fails["lane0_closed_at_restart"] is True
+    for e in built:
+        lane = e._annotation_lane
+        assert lane is not None and not lane._thread.is_alive()
