@@ -22,7 +22,50 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
+
+
+def start_health_writer(path, interval, current_engines, fault_plan=None):
+    """Launch the ``--health-file`` dumper: every ``interval`` seconds the
+    current engines' ``health()`` snapshots are written to ``path`` via an
+    atomic replace (readers never see a torn file). Returns a ``finish()``
+    callable that stops the thread and writes the FINAL state — call it
+    after the run ends, including on failure paths, so the file on disk
+    always reflects how the run finished. No-op (returns a no-op finish)
+    when ``path`` is None."""
+    if path is None:
+        return lambda: None
+
+    def dump():
+        snap = {"time": time.time(),
+                "engines": [e.health() for e in list(current_engines())
+                            if e is not None]}
+        if fault_plan is not None:
+            snap["chaos"] = fault_plan.report()
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(snap, f, indent=2)
+            os.replace(tmp, path)
+        except OSError:   # health reporting must never kill serving
+            pass
+
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            dump()
+
+    thread = threading.Thread(target=loop, daemon=True, name="health-writer")
+    thread.start()
+
+    def finish():
+        stop.set()
+        thread.join(timeout=5.0)
+        dump()
+
+    return finish
 
 
 def build_pipeline(spec: str, batch_size: int):
@@ -84,6 +127,39 @@ def main(argv=None) -> int:
     ap.add_argument("--annotations-topic", default=None,
                     help="side topic for --explain-async records "
                          "(default: <output-topic>-annotations)")
+    ap.add_argument("--dlq", action="store_true",
+                    help="route malformed and repeatedly-failing messages "
+                         "to a dead-letter topic (<output-topic>-dlq) as "
+                         "structured reason records instead of inline "
+                         "error frames (docs/robustness.md)")
+    ap.add_argument("--dlq-topic", default=None,
+                    help="dead-letter topic name (implies --dlq)")
+    ap.add_argument("--dlq-max-attempts", type=int, default=3,
+                    help="re-deliveries before a row is dead-lettered as "
+                         "poison (--dlq; counted across --supervise restarts)")
+    ap.add_argument("--breaker", type=int, metavar="N", default=0,
+                    help="wrap the --explain backend in a circuit breaker "
+                         "that opens after N consecutive failures (0 = off; "
+                         "open = explanations fast-fail instead of paying "
+                         "the backend's timeout/retry budget)")
+    ap.add_argument("--breaker-probe", type=float, default=30.0,
+                    help="seconds an open breaker waits before probing the "
+                         "backend again (--breaker)")
+    ap.add_argument("--health-file", default=None,
+                    help="periodically dump an engine-health JSON snapshot "
+                         "to this path (atomic replace; final state written "
+                         "at exit)")
+    ap.add_argument("--health-interval", type=float, default=2.0,
+                    help="seconds between --health-file dumps")
+    ap.add_argument("--chaos", action="store_true",
+                    help="demo mode only: run the in-process broker under a "
+                         "seeded fault plan (poll errors, lossy flushes, "
+                         "commit fences, duplicates, corruption) to "
+                         "demonstrate graceful degradation; implies "
+                         "supervision (stream/faults.py)")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="fault-plan seed (--chaos; same seed = same "
+                         "fault schedule)")
     args = ap.parse_args(argv)
 
     if args.kafka and args.demo:
@@ -109,11 +185,35 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--max-messages cannot be split across --workers > 1; "
             "drop one of the two (workers drain until idle)")
+    if args.chaos and not args.demo:
+        raise SystemExit("--chaos needs --demo N (faults are injected into "
+                         "the in-process broker; against real Kafka use a "
+                         "real chaos tool)")
+    if args.dlq_topic is not None:
+        args.dlq = True
+    if args.dlq_max_attempts < 1:
+        raise SystemExit(
+            f"--dlq-max-attempts must be >= 1, got {args.dlq_max_attempts}")
+    if args.breaker < 0:
+        raise SystemExit(f"--breaker must be >= 0, got {args.breaker}")
+    if args.breaker > 0 and args.explain == "off":
+        raise SystemExit("--breaker needs an --explain backend")
+    if args.breaker_probe <= 0:
+        raise SystemExit(
+            f"--breaker-probe must be > 0, got {args.breaker_probe}")
+    if args.health_interval <= 0:
+        raise SystemExit(
+            f"--health-interval must be > 0, got {args.health_interval}")
+    if args.chaos and args.supervise == 0:
+        # Chaos without supervision dies on the first injected fault by
+        # design; default to enough restarts for the demo plan's budget.
+        args.supervise = 25
 
     from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
     from fraud_detection_tpu.stream.kafka import kafka_available
 
     explain_hook = None
+    breaker = None
     if args.explain != "off":
         from fraud_detection_tpu.explain import make_stream_explain_hook
         from fraud_detection_tpu.utils.config import LLMConfig
@@ -160,6 +260,16 @@ def main(argv=None) -> int:
             backend = llm_cfg.make_backend()
         else:
             raise SystemExit(f"unknown --explain spec {args.explain!r}")
+        if args.breaker > 0:
+            # Breaker wraps the backend BEFORE the hook is built, so every
+            # call path (inline hook, async lane) shares one breaker and a
+            # dead endpoint fast-fails instead of stalling annotation
+            # (explain/circuit.py; state surfaced via health()).
+            from fraud_detection_tpu.explain import CircuitBreakerBackend
+
+            backend = breaker = CircuitBreakerBackend(
+                backend, failure_threshold=args.breaker,
+                probe_interval=args.breaker_probe)
         explain_hook = make_stream_explain_hook(
             backend, temperature=temp, max_tokens=args.explain_tokens)
 
@@ -193,15 +303,39 @@ def main(argv=None) -> int:
     else:
         raise SystemExit("choose --kafka or --demo N (no broker specified)")
 
+    fault_plan = None
+    if args.chaos:
+        # One plan shared by every incarnation: the single seeded rng stream
+        # is what makes the fault schedule (and the demo) reproducible, and
+        # the budget guarantees convergence once spent.
+        from fraud_detection_tpu.stream.faults import FaultPlan
+
+        fault_plan = FaultPlan.demo(seed=args.chaos_seed)
+        inner_make_clients = make_clients
+        make_clients = lambda: tuple(
+            wrap(client) for wrap, client in
+            zip((fault_plan.consumer, fault_plan.producer),
+                inner_make_clients()))
+
+    dlq_topic = None
+    dlq_trackers: dict = {}
+    if args.dlq:
+        dlq_topic = args.dlq_topic or f"{args.output_topic}-dlq"
+
     engines_built = []   # async lanes to drain + aggregate at exit
 
-    def make_engine(replacing=None):
+    def make_engine(replacing=None, worker=0):
         """Build an engine; ``replacing`` is the previous incarnation on a
         supervised-restart path — its async lane is stopped first (briefly
         drained) so restarts don't accumulate worker threads, each pinning
-        a producer."""
+        a producer. The DLQ poison tracker is shared across one WORKER's
+        incarnations (so counts survive restarts) but never across workers:
+        they own disjoint partitions, and a cross-thread dict would race a
+        worker's cleanup iteration against another's inserts."""
         if replacing is not None:
             replacing.close_annotations(timeout=5.0)
+        dlq_attempts = (dlq_trackers.setdefault(worker, {})
+                        if args.dlq else None)
         c, p = make_clients()
         e = StreamingClassifier(pipe, c, p, args.output_topic,
                                 batch_size=args.batch_size, max_wait=args.max_wait,
@@ -211,7 +345,11 @@ def main(argv=None) -> int:
                                 annotations_topic=args.annotations_topic,
                                 annotations_producer=(
                                     make_producer() if args.explain_async
-                                    else None))
+                                    else None),
+                                dlq_topic=dlq_topic,
+                                dlq_max_attempts=args.dlq_max_attempts,
+                                dlq_attempts=dlq_attempts,
+                                breaker=breaker)
         engines_built.append(e)
         return e
 
@@ -240,8 +378,6 @@ def main(argv=None) -> int:
         # duplicates on the common exit path). Workers share the
         # pipeline (scoring is jitted + thread-safe; the engine serializes
         # its own consumer). --max-messages was already rejected up top.
-        import threading
-
         from fraud_detection_tpu.stream.engine import (StreamStats,
                                                        _merge_stats,
                                                        run_supervised)
@@ -249,6 +385,8 @@ def main(argv=None) -> int:
         results = [None] * args.workers
         errors = [None] * args.workers
         live = [None] * args.workers     # current engine, for Ctrl-C stop
+        finish_health = start_health_writer(
+            args.health_file, args.health_interval, lambda: live, fault_plan)
         # Cooperative shutdown: KeyboardInterrupt only reaches the MAIN
         # thread, so a supervised worker in its backoff sleep would rebuild
         # and keep consuming after the operator's Ctrl-C stopped its dead
@@ -269,15 +407,15 @@ def main(argv=None) -> int:
         # construction INSIDE the supervisor — client-construction failures
         # must stay retryable incarnations (engine.py run_supervised), and
         # one worker's failure must not abort its siblings.
-        prebuilt = [make_engine() if broker is not None else None
-                    for _ in range(args.workers)]
+        prebuilt = [make_engine(worker=i) if broker is not None else None
+                    for i in range(args.workers)]
 
         def run_worker(i: int) -> None:
             def make():
                 if prebuilt[i] is not None:
                     live[i], prebuilt[i] = prebuilt[i], None
                 else:
-                    live[i] = make_engine(replacing=live[i])
+                    live[i] = make_engine(replacing=live[i], worker=i)
                 if shutdown.is_set():
                     live[i].stop()
                 return live[i]
@@ -335,10 +473,15 @@ def main(argv=None) -> int:
         total.restarts = sum(r.restarts for r in done)
         merged = {**total.as_dict(), "workers": args.workers,
                   "per_worker_processed": [r.processed if r else None
-                                           for r in results]}
+                                           for r in results],
+                  "health": [e.health() if e is not None else None
+                             for e in live]}
+        if fault_plan is not None:
+            merged["chaos"] = fault_plan.report()
         annotations = finish_annotations()
         if annotations is not None:
             merged["annotations"] = annotations
+        finish_health()
         print(json.dumps(merged))
         if args.demo:
             n_out = broker.topic_size(args.output_topic)
@@ -349,16 +492,31 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
         return 0
+    finish_health = start_health_writer(
+        args.health_file, args.health_interval,
+        lambda: engines_built[-1:], fault_plan)
+    gave_up = None
     if args.supervise > 0:
         # The supervisor builds and closes every consumer/producer itself
         # (including on Ctrl-C, where it returns the aggregated stats).
-        from fraud_detection_tpu.stream.engine import run_supervised
+        from fraud_detection_tpu.stream.engine import StreamStats, run_supervised
 
-        stats = run_supervised(
-            lambda: make_engine(
-                replacing=engines_built[-1] if engines_built else None),
-            max_restarts=args.supervise,
-            max_messages=max_messages, idle_timeout=idle)
+        try:
+            stats = run_supervised(
+                lambda: make_engine(
+                    replacing=engines_built[-1] if engines_built else None),
+                max_restarts=args.supervise,
+                max_messages=max_messages, idle_timeout=idle)
+        except Exception as e:  # noqa: BLE001 — give-up surfaced as exit code
+            # The supervisor exhausted max_restarts: report the partial
+            # progress it attached plus final health, exit non-zero — an
+            # orchestrator reading exit codes must never see success on a
+            # stream that died (mirrors the multi-worker path's contract).
+            gave_up = e
+            stats = getattr(e, "supervisor_stats", None) or StreamStats()
+            print(f"supervised run gave up after {args.supervise} restarts: "
+                  f"{e!r} (offsets stay at the last commit; a restarted "
+                  f"serve resumes there)", file=sys.stderr, flush=True)
     else:
         engine = make_engine()
         try:
@@ -369,14 +527,18 @@ def main(argv=None) -> int:
         finally:
             engine.consumer.close()
     out = stats.as_dict()
+    out["health"] = engines_built[-1].health() if engines_built else None
+    if fault_plan is not None:
+        out["chaos"] = fault_plan.report()
     annotations = finish_annotations()
     if annotations is not None:
         out["annotations"] = annotations
+    finish_health()
     print(json.dumps(out))
     if args.demo:
         n_out = broker.topic_size(args.output_topic)
         print(f"classified messages on {args.output_topic}: {n_out}")
-    return 0
+    return 3 if gave_up is not None else 0
 
 
 if __name__ == "__main__":

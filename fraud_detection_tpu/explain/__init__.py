@@ -15,6 +15,10 @@ from fraud_detection_tpu.explain.backends import (
     LLMBackend,
     OpenAIChatBackend,
 )
+from fraud_detection_tpu.explain.circuit import (
+    BreakerOpenError,
+    CircuitBreakerBackend,
+)
 from fraud_detection_tpu.explain.history import HistoricalCaseStore
 from fraud_detection_tpu.explain.onpod import OnPodBackend, make_stream_explain_hook
 from fraud_detection_tpu.explain.prompts import (
@@ -26,6 +30,8 @@ from fraud_detection_tpu.explain.prompts import (
 __all__ = [
     "FraudAnalysisAgent",
     "BackendError",
+    "BreakerOpenError",
+    "CircuitBreakerBackend",
     "CannedBackend",
     "LLMBackend",
     "OpenAIChatBackend",

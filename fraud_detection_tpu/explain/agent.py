@@ -16,10 +16,12 @@ Capability parity with ``DeepSeekClassificationAgent``
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 from fraud_detection_tpu.explain.backends import BackendError, CannedBackend, LLMBackend
+from fraud_detection_tpu.explain.circuit import CircuitBreakerBackend
 from fraud_detection_tpu.explain.history import HistoricalCaseStore
 from fraud_detection_tpu.explain.prompts import (
     analysis_prompt,
@@ -42,6 +44,28 @@ class FraudAnalysisAgent:
         """Install a historical corpus (the UI's CSV-upload path,
         app_ui.py:56-64) indexed with the pipeline's own featurizer."""
         self.history = HistoricalCaseStore(self.pipeline.featurizer, texts, labels)
+
+    def enable_circuit_breaker(self, *, failure_threshold: int = 5,
+                               probe_interval: float = 30.0,
+                               clock: Callable[[], float] = time.monotonic,
+                               ) -> CircuitBreakerBackend:
+        """Wrap the agent's backend in a circuit breaker (explain/circuit.py)
+        so a dead endpoint costs one fast ``error`` field per request instead
+        of the full timeout x retry budget (the reference paid 90 s x 3 per
+        click, agent_api.py:34-42). Idempotent; returns the breaker for
+        state inspection. ``classify_and_explain`` needs no change — the
+        breaker's fast-fail is a ``BackendError`` and degrades through the
+        existing path."""
+        if not isinstance(self.backend, CircuitBreakerBackend):
+            self.backend = CircuitBreakerBackend(
+                self.backend, failure_threshold=failure_threshold,
+                probe_interval=probe_interval, clock=clock)
+        return self.backend
+
+    def backend_health(self) -> Optional[Dict]:
+        """The breaker's snapshot, or None when no breaker is installed."""
+        b = self.backend
+        return b.snapshot() if isinstance(b, CircuitBreakerBackend) else None
 
     def predict_and_get_label(self, text: str) -> Dict:
         """Classifier-only result: {prediction, label, confidence}."""

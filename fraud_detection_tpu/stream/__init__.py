@@ -1,7 +1,11 @@
 from fraud_detection_tpu.stream.annotations import AsyncAnnotationLane
-from fraud_detection_tpu.stream.broker import CommitFailedError, InProcessBroker, Message
+from fraud_detection_tpu.stream.broker import (CommitFailedError, InProcessBroker,
+                                               Message, TransientBrokerError)
 from fraud_detection_tpu.stream.engine import StreamingClassifier, StreamStats
+from fraud_detection_tpu.stream.faults import ChaosConsumer, ChaosProducer, FaultPlan
 from fraud_detection_tpu.stream.kafka import kafka_available
 
-__all__ = ["AsyncAnnotationLane", "CommitFailedError", "InProcessBroker", "Message", "StreamingClassifier", "StreamStats",
+__all__ = ["AsyncAnnotationLane", "ChaosConsumer", "ChaosProducer",
+           "CommitFailedError", "FaultPlan", "InProcessBroker", "Message",
+           "StreamingClassifier", "StreamStats", "TransientBrokerError",
            "kafka_available"]

@@ -58,10 +58,12 @@ class AsyncAnnotationLane:
     """
 
     def __init__(self, explain_batch_fn: Callable, producer, topic: str, *,
-                 max_queue: int = 1024, max_batch: int = 64):
+                 max_queue: int = 1024, max_batch: int = 64,
+                 clock: Callable[[], float] = time.perf_counter):
         if max_queue < 1 or max_batch < 1:
             raise ValueError(
                 f"max_queue/max_batch must be >= 1, got {max_queue}/{max_batch}")
+        self._clock = clock   # injectable: drain/close deadlines in tests
         self._fn = explain_batch_fn
         self._producer = producer
         self.topic = topic
@@ -156,27 +158,41 @@ class AsyncAnnotationLane:
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until the queue is empty and the worker is idle (or
-        timeout). The lane stays usable after. True = fully drained."""
-        deadline = time.perf_counter() + timeout
-        while time.perf_counter() < deadline:
-            with self._cv:
-                empty = not self._q
-            if empty and self._idle.wait(
-                    timeout=max(0.0, deadline - time.perf_counter())):
+        timeout). The lane stays usable after. True = fully drained.
+
+        Bounded even against a HUNG backend: a worker stuck inside
+        ``explain_batch_fn`` never raises ``_idle``, so the wait simply
+        expires — the caller gets False after ~``timeout``, never a
+        deadlock. The deadline runs on the injectable ``clock``."""
+        deadline = self._clock() + timeout
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                return False
+            if self._idle.wait(timeout=min(remaining, 0.2)):
                 with self._cv:
-                    if not self._q:      # nothing re-queued while idle rose
+                    # Re-queued rows cleared _idle under the same lock (see
+                    # submit), so observing idle + empty here is conclusive
+                    # and a stale idle cannot busy-spin this loop.
+                    if not self._q:
                         return True
-            time.sleep(0.01)
-        return False
 
     def close(self, timeout: float = 30.0) -> bool:
-        """Drain best-effort, then stop the worker. True = clean drain."""
+        """Drain best-effort, then stop the worker. True = clean shutdown
+        (queue drained AND worker exited); False is honest about partial
+        failure — rows still queued, or a worker hung in the backend (it is
+        a daemon thread, so an un-joinable worker cannot block process
+        exit, and a latched-closed lane drops any late submits).
+
+        Never blocks unboundedly: the drain phase is capped by ``timeout``
+        and the join by a short window scaled to it — a backend that
+        ignores interruption costs the caller ~timeout, not forever."""
         drained = self.drain(timeout)
         with self._cv:
             self._closed = True
             self._cv.notify()
-        self._thread.join(timeout=5.0)
-        return drained
+        self._thread.join(timeout=min(5.0, max(0.2, timeout)))
+        return drained and not self._thread.is_alive()
 
     def stats(self) -> dict:
         with self._cv:

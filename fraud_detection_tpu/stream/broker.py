@@ -87,6 +87,17 @@ class CommitFailedError(RuntimeError):
     the partition's new owner reprocesses the batch (at-least-once)."""
 
 
+class TransientBrokerError(RuntimeError):
+    """Transport-level broker failure that is expected to heal (librdkafka's
+    ``_TRANSPORT`` / ``_ALL_BROKERS_DOWN`` while retrying, or an injected
+    chaos fault). Raised from the poll path; it kills the engine incarnation
+    and the supervisor (``run_supervised``) restarts with backoff from the
+    last committed offsets — unlike fatal client states, which should crash
+    through. stream/kafka.py translates real librdkafka codes to this class
+    so rebalance/outage survival behaves identically in tests (in-process
+    broker + chaos wrappers) and production."""
+
+
 class _GroupState:
     """Broker-side consumer-group bookkeeping (the group-coordinator role)."""
 

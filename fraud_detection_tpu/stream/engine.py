@@ -93,10 +93,28 @@ def _malformed_wire(msg: Message) -> bytes:
         "original": msg.value.decode("utf-8", "replace")[:500]}).encode()
 
 
+def _dlq_record(msg: Message, reason: str, error: str,
+                attempts: Optional[int] = None) -> bytes:
+    """Structured dead-letter record (docs/robustness.md schema): why the
+    row was diverted plus enough source coordinates to find and replay it.
+    Keyed by the source message's key, so DLQ consumers can join back."""
+    rec = {
+        "reason": reason,
+        "error": error,
+        "source": {"topic": msg.topic, "partition": msg.partition,
+                   "offset": msg.offset},
+        "original": msg.value.decode("utf-8", "replace")[:500],
+    }
+    if attempts is not None:
+        rec["attempts"] = attempts
+    return json.dumps(rec).encode()
+
+
 @dataclass
 class StreamStats:
     processed: int = 0
     malformed: int = 0
+    dead_lettered: int = 0    # rows routed to the DLQ topic (subset of processed)
     batches: int = 0
     commits_skipped: int = 0  # producer didn't drain; offsets left uncommitted
     rebalanced_commits: int = 0  # commit fenced by a group rebalance (routine)
@@ -145,6 +163,7 @@ class StreamStats:
         return {
             "processed": self.processed,
             "malformed": self.malformed,
+            "dead_lettered": self.dead_lettered,
             "batches": self.batches,
             "commits_skipped": self.commits_skipped,
             "rebalanced_commits": self.rebalanced_commits,
@@ -186,9 +205,17 @@ class StreamingClassifier:
         annotations_topic: Optional[str] = None,
         annotations_producer: Optional[Producer] = None,
         tracer: Optional[Tracer] = None,
+        dlq_topic: Optional[str] = None,
+        dlq_max_attempts: int = 3,
+        dlq_attempts: Optional[dict] = None,
+        breaker: Optional[object] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        if dlq_max_attempts < 1:
+            raise ValueError(
+                f"dlq_max_attempts must be >= 1, got {dlq_max_attempts}")
         if explain_async and explain_batch_fn is None:
             raise ValueError("explain_async requires explain_batch_fn")
         if explain_async and annotations_producer is None:
@@ -236,6 +263,31 @@ class StreamingClassifier:
         # for profiling beyond StreamStats' aggregate latencies. None = the
         # hot loop pays nothing.
         self.tracer = tracer
+        # Dead-letter routing (docs/robustness.md): when ``dlq_topic`` is
+        # set, malformed rows and rows re-delivered more than
+        # ``dlq_max_attempts`` times without a successful batch go to the
+        # DLQ topic as structured reason records instead of inline error
+        # frames. ``dlq_attempts`` is the redelivery tracker — pass ONE dict
+        # to every incarnation a supervisor builds so poison counting
+        # survives restarts (a fresh dict per engine would reset the count
+        # exactly when the poison row crashes the incarnation). None (the
+        # default) keeps today's inline error frames for wire parity, at
+        # zero per-message cost.
+        self.dlq_topic = dlq_topic
+        self.dlq_max_attempts = dlq_max_attempts
+        self._dlq_attempts = ((dlq_attempts if dlq_attempts is not None else {})
+                              if dlq_topic is not None else None)
+        self._dlq_counts: dict = {}   # reason -> records delivered to the DLQ
+        # Optional explain/circuit.CircuitBreakerBackend (anything with
+        # ``snapshot()``) — health() surfaces its state; the engine never
+        # calls it directly (the explain hook / annotation lane own calls).
+        self._breaker = breaker
+        # Injectable monotonic clock for health ages (tests drive it).
+        self._clock = clock
+        self._created_at = clock()
+        self._last_batch_at: Optional[float] = None
+        self._inflight_depth = 0
+        self._flush_fail_streak = 0
         self.stats = StreamStats()
         self._running = False
         self._flush_failed = False
@@ -277,22 +329,65 @@ class StreamingClassifier:
         """Decode + featurize + launch device scoring; does NOT block on the
         device. Returns the in-flight batch handle for ``_finish``."""
         t0 = time.perf_counter()
+        # Offsets cover the ORIGINAL batch — rows screened out below are
+        # handled (their DLQ record ships with this batch) and must commit.
         offsets: dict = {}
         for m in msgs:
             key = (m.topic, m.partition)
             offsets[key] = max(offsets.get(key, 0), m.offset + 1)
 
-        if self._json_fast is not False:
-            inflight = self._dispatch_raw_json(msgs, offsets, t0)
-            if inflight is not None:
-                return inflight
+        dead: Optional[List[tuple]] = None
+        dead_reasons: Optional[dict] = None
+        if self._dlq_attempts is not None:
+            dead, dead_reasons = [], {}
+            msgs = self._screen_poison(msgs, dead, dead_reasons)
 
-        texts: List[Optional[str]] = [self._decode(m) for m in msgs]
-        valid_idx = [i for i, t in enumerate(texts) if t is not None]
-        pending = (self.pipeline.predict_async([texts[i] for i in valid_idx])
-                   if valid_idx else None)
-        return _InFlight(msgs, texts, valid_idx, pending, offsets,
-                         time.perf_counter() - t0)
+        inflight = None
+        if msgs and self._json_fast is not False:
+            inflight = self._dispatch_raw_json(msgs, offsets, t0)
+        if inflight is None:
+            texts: List[Optional[str]] = [self._decode(m) for m in msgs]
+            valid_idx = [i for i, t in enumerate(texts) if t is not None]
+            pending = (self.pipeline.predict_async([texts[i] for i in valid_idx])
+                       if valid_idx else None)
+            inflight = _InFlight(msgs, texts, valid_idx, pending, offsets,
+                                 time.perf_counter() - t0)
+        if dead:
+            inflight.dead = dead
+            inflight.dead_reasons = dead_reasons
+            # Screened rows are OUTSIDE inflight.msgs — message accounting
+            # (processed, budget) must add them back; rows diverted later in
+            # _finish stay inside msgs and must not be added twice.
+            inflight.dead_screened = len(dead)
+        return inflight
+
+    def _screen_poison(self, msgs: List[Message], dead: List[tuple],
+                       dead_reasons: dict) -> List[Message]:
+        """Count this delivery against each row and divert rows whose count
+        exceeded ``dlq_max_attempts`` — a row that keeps being re-delivered
+        is one whose batch keeps dying (crash/flush-fail replays), and
+        re-scoring it forever burns every supervisor restart. Counts clear
+        on batch success (``_deliver``) and are tracked per source offset,
+        so duplicates of a committed row start fresh. Granularity is the
+        batch: innocent batch-mates of a poison row accumulate the same
+        count and may be diverted with it — the DLQ record carries the
+        attempt count so they are distinguishable downstream."""
+        attempts = self._dlq_attempts
+        keep: List[Message] = []
+        for m in msgs:
+            key = (m.topic, m.partition, m.offset)
+            n = attempts[key] = attempts.get(key, 0) + 1
+            if n > self.dlq_max_attempts:
+                dead.append((_dlq_record(
+                    m, "max_attempts_exceeded",
+                    f"re-delivered {n} times without a successful batch "
+                    f"(dlq_max_attempts={self.dlq_max_attempts})",
+                    attempts=n), m.key))
+                dead_reasons["max_attempts_exceeded"] = (
+                    dead_reasons.get("max_attempts_exceeded", 0) + 1)
+            else:
+                keep.append(m)
+        return keep if len(keep) != len(msgs) else msgs
 
     def _dispatch_raw_json(self, msgs: List[Message], offsets: dict,
                            t0: float) -> Optional["_InFlight"]:
@@ -383,6 +478,11 @@ class StreamingClassifier:
         for idx, (msg, text, res) in enumerate(zip(msgs, texts, results)):
             if res is None:
                 self.stats.malformed += 1
+                if self.dlq_topic is not None:
+                    self._dead_letter(inflight, msg, "malformed",
+                                      "undecodable JSON or missing/"
+                                      "non-string text field")
+                    continue
                 wire = _malformed_wire(msg)
             else:
                 label, confidence = res  # confidence precomputed vectorized
@@ -449,6 +549,17 @@ class StreamingClassifier:
         if items:
             self._annotation_lane.submit(items)
 
+    def _dead_letter(self, inflight: "_InFlight", msg: Message, reason: str,
+                     error: str, attempts: Optional[int] = None) -> None:
+        """Divert one row to the DLQ: its record rides THIS batch's delivery
+        (same flush/commit accounting as the output frames, so a commit can
+        never advance past a lost DLQ record either)."""
+        if inflight.dead is None:
+            inflight.dead, inflight.dead_reasons = [], {}
+        inflight.dead.append((_dlq_record(msg, reason, error, attempts),
+                              msg.key))
+        inflight.dead_reasons[reason] = inflight.dead_reasons.get(reason, 0) + 1
+
     def _annotation_text(self, inflight: "_InFlight", i: int) -> Optional[str]:
         """Decoded text of row i in a raw-mode batch: the stored slice (or
         the native path's encode-time span) covers the complete QUOTED JSON
@@ -473,6 +584,42 @@ class StreamingClassifier:
         or None when the engine runs inline or without explanations."""
         lane = self._annotation_lane
         return lane.stats() if lane is not None else None
+
+    def health(self) -> dict:
+        """Point-in-time engine health snapshot.
+
+        Cheap and lock-free — callable from any thread while the loop runs
+        (serve.py's ``--health-file`` dumper does exactly that); values are
+        racy single reads by design, a monitoring sample rather than a
+        consistent transaction. Ages use the engine's injectable monotonic
+        clock. ``None`` sub-objects mean the feature is off (no DLQ / no
+        async lane / no breaker)."""
+        now = self._clock()
+        lane = self._annotation_lane
+        breaker = self._breaker
+        return {
+            "running": self._running,
+            "stopped": self._stopped,
+            "uptime_sec": now - self._created_at,
+            # Age of the last DELIVERED batch; None until the first one.
+            # A growing age with running=True is the stall signal.
+            "last_batch_age_sec": (None if self._last_batch_at is None
+                                   else now - self._last_batch_at),
+            "in_flight_depth": self._inflight_depth,
+            "consecutive_flush_failures": self._flush_fail_streak,
+            "processed": self.stats.processed,
+            "malformed": self.stats.malformed,
+            "dead_lettered": self.stats.dead_lettered,
+            "dlq": (None if self.dlq_topic is None else {
+                "topic": self.dlq_topic,
+                "routed": dict(self._dlq_counts),
+                "tracked_offsets": len(self._dlq_attempts),
+            }),
+            "annotations": lane.stats() if lane is not None else None,
+            "breaker": (breaker.snapshot()
+                        if breaker is not None and hasattr(breaker, "snapshot")
+                        else None),
+        }
 
     def close_annotations(self, timeout: float = 30.0) -> bool:
         """Drain and stop the async lane (no-op inline). Call after the
@@ -522,7 +669,12 @@ class StreamingClassifier:
                 msg = msgs[off + j]
                 if end == start:  # malformed (valid frames are never empty)
                     self.stats.malformed += 1
-                    wires.append((_malformed_wire(msg), msg.key))
+                    if self.dlq_topic is not None:
+                        self._dead_letter(inflight, msg, "malformed",
+                                          "undecodable JSON or missing/"
+                                          "non-string text field")
+                    else:
+                        wires.append((_malformed_wire(msg), msg.key))
                 else:
                     wires.append((blob[start:end], msg.key))
                     start = end
@@ -535,9 +687,14 @@ class StreamingClassifier:
         produce_batch = getattr(self.producer, "produce_batch", None)
         if produce_batch is not None:
             produce_batch(self.output_topic, wires)
+            if inflight.dead:
+                produce_batch(self.dlq_topic, inflight.dead)
         else:
             for wire, key in wires:
                 self.producer.produce(self.output_topic, wire, key=key)
+            if inflight.dead:
+                for wire, key in inflight.dead:
+                    self.producer.produce(self.dlq_topic, wire, key=key)
 
         # Produce-then-commit: at-least-once with durable progress (fixes Q2).
         # Commit ONLY if the producer fully drained — committing past
@@ -554,9 +711,11 @@ class StreamingClassifier:
             # lost and its offsets uncommitted, so a restart re-drives it —
             # counting it would let a supervisor believe the work is done.
             self.stats.commits_skipped += 1
+            self._flush_fail_streak += 1
             self._flush_failed = True
             self._running = False
             return 0
+        self._flush_fail_streak = 0
         try:
             self.consumer.commit_offsets(inflight.offsets)
         except CommitFailedError as e:
@@ -570,6 +729,22 @@ class StreamingClassifier:
             self.stats.rebalanced_commits += 1
             log.info("commit fenced by rebalance (batch stays at-least-once): %s", e)
 
+        # Batch delivered: clear poison-attempt tracking for every offset
+        # this batch's commit covers (fenced commits clear too — the outputs
+        # stand; a new owner's replay recounts from zero, which is the
+        # consecutive-failure semantics the screen wants). Keeps the tracker
+        # bounded to in-flight + recently-failed rows.
+        if self._dlq_attempts:
+            done = inflight.offsets
+            for key in [k for k in self._dlq_attempts
+                        if k[2] < done.get((k[0], k[1]), 0)]:
+                del self._dlq_attempts[key]
+        n_dead = len(inflight.dead) if inflight.dead else 0
+        if n_dead:
+            self.stats.dead_lettered += n_dead
+            for reason, n in inflight.dead_reasons.items():
+                self._dlq_counts[reason] = self._dlq_counts.get(reason, 0) + n
+
         # Active processing latency: dispatch-side host work + this finish
         # leg (device wait, produce, flush, commit). Excludes time the batch
         # spent parked behind the next batch's poll — that's pipeline
@@ -577,13 +752,14 @@ class StreamingClassifier:
         # max_wait on a sparse stream.
         finish_dt = time.perf_counter() - t1
         dt = inflight.dispatch_time + finish_dt
-        self.stats.processed += len(msgs)
+        self.stats.processed += len(msgs) + inflight.dead_screened
         self.stats.batches += 1
         self.stats.record_latency(dt)
+        self._last_batch_at = self._clock()
         if self.tracer is not None:
             self.tracer.record("dispatch", inflight.dispatch_time)
             self.tracer.record("finish", finish_dt)
-        return len(msgs)
+        return len(msgs) + inflight.dead_screened
 
     def process_batch(self, msgs: List[Message]) -> int:
         """Score one micro-batch synchronously and emit results."""
@@ -629,11 +805,13 @@ class StreamingClassifier:
             while self._running:
                 budget = self.batch_size
                 if max_messages is not None:
-                    consumed = self.stats.processed + sum(len(f.msgs) for f in in_flight)
+                    consumed = self.stats.processed + sum(
+                        len(f.msgs) + f.dead_screened for f in in_flight)
                     budget = min(budget, max_messages - consumed)
                 if budget <= 0:
                     if in_flight:
                         self._finish(in_flight.popleft())
+                        self._inflight_depth = len(in_flight)
                         continue
                     break
                 msgs = self.consumer.poll_batch(budget, self.max_wait)
@@ -641,6 +819,7 @@ class StreamingClassifier:
                     if in_flight:
                         # Drain the tail rather than idling behind it.
                         self._finish(in_flight.popleft())
+                        self._inflight_depth = len(in_flight)
                         continue
                     now = time.perf_counter()
                     idle_since = idle_since or now
@@ -651,6 +830,7 @@ class StreamingClassifier:
                 in_flight.append(self._dispatch(msgs))
                 if len(in_flight) > self.pipeline_depth:
                     self._finish(in_flight.popleft())
+                self._inflight_depth = len(in_flight)
         except BaseException:
             # An exception (including Ctrl-C) may have landed mid-_finish
             # after some produces succeeded. Do NOT drain newer in-flight
@@ -666,6 +846,10 @@ class StreamingClassifier:
             # failed batch's outputs.
             while in_flight and not self._flush_failed:
                 self._finish(in_flight.popleft())
+            self._inflight_depth = 0
+            # The loop can exit via break with the flag still set; clear it
+            # so health() reports a finished engine as not running.
+            self._running = False
             self.stats.elapsed = time.perf_counter() - started
         return self.stats
 
@@ -684,6 +868,12 @@ class _InFlight:
     # Native frame-assembly context (raw mode): per-chunk marshalled message
     # arrays + the batch's span arrays; texts may then be lazily-unbuilt.
     splice: Optional[tuple] = None  # (ctxs, span_start, span_len)
+    # Dead-letter rows riding this batch (DLQ mode only): (record, key)
+    # wires for the DLQ topic + per-reason counts, delivered/committed with
+    # the batch. None = nothing diverted (the common case costs nothing).
+    dead: Optional[List[tuple]] = None
+    dead_reasons: Optional[dict] = None
+    dead_screened: int = 0      # dead rows NOT in msgs (poison screening)
 
 
 def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
@@ -692,7 +882,9 @@ def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
                    backoff_cap: float = 30.0,
                    max_messages: Optional[int] = None,
                    idle_timeout: Optional[float] = None,
-                   sleep=time.sleep) -> StreamStats:
+                   sleep=time.sleep,
+                   jitter: bool = True,
+                   rng: Optional[random.Random] = None) -> StreamStats:
     """Failure-detecting restart loop around the streaming engine.
 
     The reference's loop dies on the first Kafka error and, because it never
@@ -702,10 +894,20 @@ def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
     it resumes from the group's last committed offsets), restarts use
     exponential backoff, and the backoff resets after any healthy run that
     made progress. Gives up after ``max_restarts`` consecutive failures and
-    re-raises the last error.
+    re-raises the last error (with the aggregated stats attached as
+    ``.supervisor_stats`` so callers can report partial progress).
+
+    Backoff uses FULL JITTER: each wait is uniform in [0, min(backoff *
+    2^(n-1), backoff_cap)]. A broker outage fails every worker in the same
+    instant; deterministic backoff would march N consumers back into the
+    group coordinator in synchronized waves (each wave a rebalance storm),
+    while jittered restarts spread the rejoins across the whole window.
+    ``jitter=False`` restores the deterministic ceiling; ``rng`` injects a
+    seeded ``random.Random`` for reproducible schedules (tests, chaos runs).
 
     Aggregated StreamStats across incarnations (restarts counted).
     """
+    uniform = (rng.uniform if rng is not None else random.uniform)
     total = StreamStats()
     consecutive = 0
     while True:
@@ -754,14 +956,22 @@ def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
             consecutive = 0  # made progress: treat as a fresh incident
         consecutive += 1
         if consecutive > max_restarts:
-            if failed is not None:
-                raise failed
-            raise RuntimeError(
-                f"producer flush kept failing after {max_restarts} restarts "
-                f"(last committed offsets hold; {total.processed} processed)")
+            if failed is None:
+                failed = RuntimeError(
+                    f"producer flush kept failing after {max_restarts} "
+                    f"restarts (last committed offsets hold; "
+                    f"{total.processed} processed)")
+            # Attach partial progress: the raise discards the return value,
+            # and serve.py's give-up path still owes the operator a stats
+            # line + final health instead of a bare traceback.
+            failed.supervisor_stats = total
+            raise failed
         total.restarts += 1
+        delay = min(backoff * (2 ** (consecutive - 1)), backoff_cap)
+        if jitter:
+            delay = uniform(0.0, delay)
         try:
-            sleep(min(backoff * (2 ** (consecutive - 1)), backoff_cap))
+            sleep(delay)
         except KeyboardInterrupt:
             break  # operator shutdown during backoff: report and stop
     return total
@@ -770,6 +980,7 @@ def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
 def _merge_stats(total: StreamStats, part: StreamStats) -> None:
     total.processed += part.processed
     total.malformed += part.malformed
+    total.dead_lettered += part.dead_lettered
     total.batches += part.batches
     total.commits_skipped += part.commits_skipped
     total.rebalanced_commits += part.rebalanced_commits

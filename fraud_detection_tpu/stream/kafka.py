@@ -18,7 +18,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from fraud_detection_tpu.stream.broker import CommitFailedError, Message
+from fraud_detection_tpu.stream.broker import (CommitFailedError, Message,
+                                               TransientBrokerError)
 from fraud_detection_tpu.utils.config import KafkaConfig
 
 try:  # pragma: no cover - exercised only where the wheel exists
@@ -54,6 +55,34 @@ def _translate_commit_error(e: Exception) -> None:
             raise CommitFailedError(
                 f"commit fenced by group rebalance: {e}") from e
     raise e
+
+
+# Transient transport-class poll errors: the broker link is down but expected
+# to heal (librdkafka keeps retrying underneath). These must surface as
+# TransientBrokerError so the supervisor restarts the incarnation with
+# backoff instead of the engine spinning on a dead link while its consumer
+# silently falls out of the group. Deliberately NOT included: fatal client
+# states (e.g. _FATAL) and informational events (_PARTITION_EOF) — fatal
+# errors must crash through untranslated, and EOF is not an error at all.
+_TRANSIENT_POLL_CODE_NAMES = ("_TRANSPORT", "_ALL_BROKERS_DOWN",
+                              "_TIMED_OUT", "_RESOLVE")
+
+
+def _transient_poll_codes():
+    ke = getattr(_ck, "KafkaError", None)
+    return {getattr(ke, n) for n in _TRANSIENT_POLL_CODE_NAMES
+            if ke is not None and hasattr(ke, n)}
+
+
+def _translate_poll_error(err) -> None:
+    """Handle a non-None ``message.error()`` from poll/consume: raise
+    TransientBrokerError for transport-class codes (the supervisor's
+    retriable class), pass silently for anything else (informational events
+    like _PARTITION_EOF keep today's drop-the-message behavior)."""
+    code = err.code() if hasattr(err, "code") else None
+    if code in _transient_poll_codes():
+        raise TransientBrokerError(
+            f"transient broker transport failure while polling: {err}")
 
 
 def kafka_available() -> bool:
@@ -96,16 +125,26 @@ class KafkaConsumer:
 
     def poll(self, timeout: float = 1.0) -> Optional[Message]:
         msg = self._consumer.poll(timeout)
-        if msg is None or msg.error():
+        if msg is None:
+            return None
+        if msg.error():
+            _translate_poll_error(msg.error())
             return None
         return Message(topic=msg.topic(), value=msg.value(), key=msg.key(),
                        partition=msg.partition(), offset=msg.offset())
 
     def poll_batch(self, max_messages: int, timeout: float) -> List[Message]:
         msgs = self._consumer.consume(num_messages=max_messages, timeout=timeout)
-        return [Message(topic=m.topic(), value=m.value(), key=m.key(),
-                        partition=m.partition(), offset=m.offset())
-                for m in msgs if m is not None and not m.error()]
+        out = []
+        for m in msgs:
+            if m is None:
+                continue
+            if m.error():
+                _translate_poll_error(m.error())
+                continue
+            out.append(Message(topic=m.topic(), value=m.value(), key=m.key(),
+                               partition=m.partition(), offset=m.offset()))
+        return out
 
     def commit(self) -> None:
         try:

@@ -18,7 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (<0.5) predates this option; the XLA_FLAGS env route above
+    # still provides the 8 virtual CPU devices.
+    pass
 
 # Persistent compilation cache: the tree trainers unroll depth-wise programs
 # whose CPU compiles dominate suite wall-clock (~half of the slowest tests'

@@ -229,6 +229,79 @@ def test_engine_async_requires_dedicated_producer(pipeline):
             explain_async=True)
 
 
+def test_lane_close_bounded_and_honest_with_hung_backend():
+    """A backend that hangs forever must not hang close(): the drain phase
+    is capped by the timeout, the join by a short window scaled to it, and
+    the result is an HONEST False (rows unprocessed, worker still stuck) —
+    the caller is never deadlocked behind a dead LLM endpoint."""
+    broker = InProcessBroker()
+    started = threading.Event()
+    release = threading.Event()        # never set during the test: a hang
+
+    def hung_fn(texts, labels, confs):
+        started.set()
+        release.wait(30.0)
+        return ["late"] * len(texts)
+
+    lane = _lane(broker, hung_fn)
+    lane.submit([(b"k1", "text", 1, 0.9), (b"k2", "text", 1, 0.8)])
+    assert started.wait(5.0)           # the worker is now stuck in the hook
+    t0 = time.perf_counter()
+    ok = lane.close(timeout=0.3)
+    dt = time.perf_counter() - t0
+    assert ok is False                 # honest: NOT a clean drain
+    assert dt < 2.0, f"close() blocked {dt:.1f}s behind a hung backend"
+    assert lane._thread.is_alive()     # daemon worker still stuck — by design
+    release.set()                      # unblock it for test hygiene
+    lane._thread.join(timeout=5.0)
+
+
+def test_lane_close_bounded_with_raising_backend_and_backlog():
+    """A 100%-raising backend drains the queue through the error path:
+    close() reports True (everything drained, worker exited) and every
+    failed batch is counted — no deadlock, no silent loss of accounting."""
+    broker = InProcessBroker()
+
+    def bad_fn(texts, labels, confs):
+        raise ConnectionError("endpoint down")
+
+    lane = _lane(broker, bad_fn, max_batch=4)
+    lane.submit([(None, f"t{i}", 1, 0.5) for i in range(12)])
+    assert lane.close(timeout=10.0) is True
+    assert not lane._thread.is_alive()
+    s = lane.stats()
+    assert s["queue_depth"] == 0 and s["annotated"] == 0
+    assert s["backend_errors"] == 3    # 12 rows / max_batch 4
+    assert broker.messages("annotations") == []
+
+
+def test_lane_drain_deadline_uses_injected_clock():
+    """drain()'s deadline runs on the injectable clock — a test can expire
+    it instantly instead of sleeping through a real timeout."""
+    broker = InProcessBroker()
+    gate = threading.Event()
+    fake_now = [0.0]
+
+    def fast_clock():                  # every read jumps a minute forward
+        fake_now[0] += 60.0
+        return fake_now[0]
+
+    def slow_fn(texts, labels, confs):
+        gate.wait(10.0)
+        return ["a"] * len(texts)
+
+    lane = AsyncAnnotationLane(slow_fn, broker.producer(), "annotations",
+                               clock=fast_clock)
+    lane.submit([(b"k", "t", 1, 0.5)])
+    t0 = time.perf_counter()
+    assert lane.drain(timeout=50.0) is False
+    assert time.perf_counter() - t0 < 1.0   # expired via clock, not sleeping
+    gate.set()
+    lane.close(timeout=10.0)           # drain verdict also rides the fast
+    lane._thread.join(timeout=5.0)     # clock; just check the worker exits
+    assert not lane._thread.is_alive()
+
+
 def test_lane_close_is_idempotent_and_latching():
     """serve's supervised-restart path closes the replaced engine's lane and
     finish_annotations() closes every built engine again at exit — double

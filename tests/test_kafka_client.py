@@ -90,6 +90,13 @@ class FakeKafkaError:
     UNKNOWN_MEMBER_ID = 25
     REBALANCE_IN_PROGRESS = 27
     _STATE = -172
+    # transport-class codes (librdkafka rdkafka.h values)
+    _TRANSPORT = -195
+    _ALL_BROKERS_DOWN = -187
+    _TIMED_OUT = -185
+    _RESOLVE = -193
+    _PARTITION_EOF = -191
+    _FATAL = -150
 
     def __init__(self, code):
         self._code = code
@@ -265,6 +272,45 @@ def test_engine_end_to_end_over_stubbed_kafka(kafka_mod):
     assert commits, "no offsets committed"
     tps = [tp for offsets, _ in commits for tp in offsets]
     assert {(tp.topic, tp.partition) for tp in tps} <= {("raw", 0), ("raw", 1), ("raw", 2)}
+
+
+def test_poll_transient_transport_errors_raise_retriable(kafka_mod):
+    """Transport-class poll errors (_TRANSPORT, _ALL_BROKERS_DOWN while
+    retrying, ...) must surface as TransientBrokerError — the supervisor's
+    retriable class — instead of being silently dropped forever while the
+    consumer spins on a dead link. Mirrors the _translate_commit_error
+    contract: same behavior in tests (chaos wrappers) and production."""
+    from fraud_detection_tpu.stream.broker import TransientBrokerError
+
+    c = kafka_mod.KafkaConsumer(config=CFG)
+    c._consumer.queue = [
+        FakeKafkaMessage(error=FakeKafkaError(FakeKafkaError._TRANSPORT))]
+    with pytest.raises(TransientBrokerError, match="transient broker"):
+        c.poll(0.1)
+
+    # poll_batch: a transient error anywhere in the batch raises too (the
+    # incarnation dies, uncommitted offsets replay after restart)
+    c._consumer.queue = [
+        FakeKafkaMessage("t", b"1", offset=0),
+        FakeKafkaMessage(error=FakeKafkaError(FakeKafkaError._ALL_BROKERS_DOWN)),
+    ]
+    with pytest.raises(TransientBrokerError):
+        c.poll_batch(10, 0.1)
+
+
+def test_poll_informational_errors_still_dropped(kafka_mod):
+    """_PARTITION_EOF (and other non-transient event codes) keep today's
+    drop-the-message behavior — EOF is not an error, and fatal states must
+    crash through untranslated elsewhere, not masquerade as messages."""
+    c = kafka_mod.KafkaConsumer(config=CFG)
+    c._consumer.queue = [
+        FakeKafkaMessage(error=FakeKafkaError(FakeKafkaError._PARTITION_EOF))]
+    assert c.poll(0.1) is None
+    c._consumer.queue = [
+        FakeKafkaMessage("t", b"1", offset=0),
+        FakeKafkaMessage(error=FakeKafkaError(FakeKafkaError._PARTITION_EOF)),
+        FakeKafkaMessage("t", b"2", offset=1)]
+    assert [m.value for m in c.poll_batch(10, 0.1)] == [b"1", b"2"]
 
 
 def test_commit_rebalance_error_translates(kafka_mod):

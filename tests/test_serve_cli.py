@@ -18,6 +18,17 @@ def artifact_spec(reference_artifact_path):
     return f"spark:{reference_artifact_path}"
 
 
+@pytest.fixture()
+def model_spec():
+    """Reference artifact when present, else the synthetic quick-train model
+    — the robustness CLI tests exercise transport/fault paths, not parity,
+    so they must not skip in artifact-less environments."""
+    import os
+
+    ref = "/root/reference/dialogue_classification_model"
+    return f"spark:{ref}" if os.path.isdir(ref) else "synthetic"
+
+
 def test_demo_single_worker(artifact_spec, capsys):
     rc = serve_main(["--model", artifact_spec, "--demo", "150",
                      "--batch-size", "64", "--max-wait", "0.01"])
@@ -176,6 +187,84 @@ def test_annotations_topic_requires_async():
     with pytest.raises(SystemExit, match="annotations-topic"):
         serve_main(["--model", "synthetic", "--demo", "10",
                     "--explain", "canned", "--annotations-topic", "audit"])
+
+
+def test_chaos_demo_smoke(model_spec, capsys):
+    """--chaos --demo: the serve loop survives a seeded fault plan (poll
+    errors, lossy flushes, commit fences, duplicates, corruption) end to
+    end, reports the injection counts, and exits clean — the CLI surface of
+    stream/faults.py + run_supervised."""
+    rc = serve_main(["--model", model_spec, "--demo", "300",
+                     "--batch-size", "64", "--max-wait", "0.01",
+                     "--chaos", "--chaos-seed", "7", "--dlq"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = json.loads([l for l in out.splitlines() if l.startswith("{")][0])
+    assert stats["chaos"]["total"] > 0, "the chaos plan never fired"
+    assert stats["processed"] >= 1
+    h = stats["health"]
+    assert h["dlq"]["topic"] == "dialogues-classified-dlq"
+    assert h["consecutive_flush_failures"] == 0   # converged
+
+
+def test_chaos_requires_demo():
+    with pytest.raises(SystemExit, match="chaos"):
+        serve_main(["--model", "synthetic", "--kafka", "--chaos"])
+
+
+def test_health_file_and_stats_health(model_spec, capsys, tmp_path):
+    """--health-file: the path holds a JSON snapshot after the run (final
+    state written at exit) and the stats JSON carries the same health()
+    shape — fields present, counters consistent with the run."""
+    path = tmp_path / "health.json"
+    rc = serve_main(["--model", model_spec, "--demo", "150",
+                     "--batch-size", "64", "--max-wait", "0.01",
+                     "--health-file", str(path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = json.loads([l for l in out.splitlines() if l.startswith("{")][0])
+    h = stats["health"]
+    for field in ("running", "uptime_sec", "last_batch_age_sec",
+                  "in_flight_depth", "consecutive_flush_failures",
+                  "processed", "dead_lettered", "dlq", "annotations",
+                  "breaker"):
+        assert field in h
+    assert h["processed"] == 150 and h["running"] is False
+    assert h["dlq"] is None and h["breaker"] is None
+    snap = json.loads(path.read_text())
+    (file_h,) = snap["engines"]
+    assert file_h["processed"] == 150      # final dump reflects the end state
+    assert file_h["last_batch_age_sec"] >= 0
+
+
+def test_supervised_give_up_exits_nonzero(model_spec, capsys, monkeypatch):
+    """When run_supervised exhausts max_restarts the CLI must exit non-zero
+    with a clear message AND still print the stats JSON with final health —
+    not die with a raw traceback (orchestration reads exit codes; operators
+    read the message)."""
+    from fraud_detection_tpu.stream import StreamingClassifier
+
+    class DoomedEngine(StreamingClassifier):
+        def run(self, *a, **k):
+            raise ConnectionError("broker unreachable")
+
+    monkeypatch.setattr("fraud_detection_tpu.stream.StreamingClassifier",
+                        DoomedEngine)
+    rc = serve_main(["--model", model_spec, "--demo", "50",
+                     "--batch-size", "32", "--supervise", "2"])
+    assert rc == 3
+    captured = capsys.readouterr()
+    assert "gave up after 2 restarts" in captured.err
+    assert "broker unreachable" in captured.err
+    stats = json.loads([l for l in captured.out.splitlines()
+                        if l.startswith("{")][0])
+    assert stats["processed"] == 0 and stats["restarts"] == 2
+    assert stats["health"]["running"] is False
+
+
+def test_breaker_requires_explain():
+    with pytest.raises(SystemExit, match="breaker"):
+        serve_main(["--model", "synthetic", "--demo", "10", "--breaker", "3"])
 
 
 def test_supervised_restart_closes_replaced_async_lane(artifact_spec,
