@@ -17,11 +17,15 @@ Layer map (mirrors SURVEY.md §7):
   stream/      Kafka micro-batching engine + in-process broker for tests
   eval/        metrics (accuracy/P/R/F1/AUC), confusion matrices, plots
   explain/     LLM explanation backends (OpenAI-compatible HTTP, on-pod JAX)
+  registry/    model lifecycle: versioned registry, hot swap, shadow, promotion
   app/         Streamlit UI + CLI entry points
   utils/       config, logging, profiling
 """
 
-__version__ = "0.1.0"
+# Single source of truth for the package version: pyproject.toml reads this
+# attribute via [tool.setuptools.dynamic] (tests/test_packaging.py pins the
+# linkage so the two can never drift again).
+__version__ = "0.3.0"
 
 from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer  # noqa: F401
 from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline  # noqa: F401

@@ -85,8 +85,40 @@ def build_pipeline(spec: str, batch_size: int):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--model", required=True,
-                    help="native checkpoint dir | spark:<artifact dir> | synthetic")
+    ap.add_argument("--model", default=None,
+                    help="native checkpoint dir | spark:<artifact dir> | "
+                         "synthetic (or use --registry)")
+    ap.add_argument("--registry", default=None, metavar="DIR",
+                    help="serve from a model registry "
+                         "(registry/registry.py layout) instead of a fixed "
+                         "--model; loads are content-hash verified "
+                         "(docs/model_lifecycle.md)")
+    ap.add_argument("--model-version", type=int, default=None, metavar="N",
+                    help="registry version to serve (--registry; "
+                         "default: latest)")
+    ap.add_argument("--watch", action="store_true",
+                    help="poll --registry for new versions and hot-swap "
+                         "them in with zero downtime (pre-warmed RCU swap "
+                         "between batches; registry/hotswap.py)")
+    ap.add_argument("--watch-interval", type=float, default=2.0,
+                    help="seconds between registry polls (--watch)")
+    ap.add_argument("--shadow", action="store_true",
+                    help="stage new versions as shadow candidates instead "
+                         "of swapping immediately: each micro-batch is "
+                         "also scored by the candidate asynchronously and "
+                         "divergence stats accumulate in health() "
+                         "(registry/shadow.py; requires --watch)")
+    ap.add_argument("--shadow-sample", type=float, default=1.0,
+                    help="fraction of micro-batches shadow-scored "
+                         "(--shadow)")
+    ap.add_argument("--shadow-queue", type=int, default=8,
+                    help="bounded shadow queue depth; overflow drops + "
+                         "counts, never blocks the primary (--shadow)")
+    ap.add_argument("--promote-policy", default=None, metavar="SPEC",
+                    help="auto promote/reject the staged candidate, e.g. "
+                         "'min_batches=5,min_rows=200,max_disagreement="
+                         "0.02,max_psi=0.25' (--shadow; every transition "
+                         "is audited to <registry>/audit.jsonl)")
     ap.add_argument("--batch-size", type=int, default=1024)
     ap.add_argument("--max-wait", type=float, default=0.05,
                     help="micro-batch assembly deadline (seconds)")
@@ -164,6 +196,34 @@ def main(argv=None) -> int:
 
     if args.kafka and args.demo:
         raise SystemExit("--kafka and --demo are mutually exclusive")
+    if (args.model is None) == (args.registry is None):
+        raise SystemExit("choose exactly one of --model or --registry")
+    if args.registry is None and (args.model_version is not None or args.watch
+                                  or args.shadow):
+        raise SystemExit("--model-version/--watch/--shadow need --registry")
+    if args.shadow and not args.watch:
+        raise SystemExit("--shadow needs --watch (candidates arrive via "
+                         "registry polling)")
+    if args.promote_policy is not None and not args.shadow:
+        raise SystemExit("--promote-policy needs --shadow (there is no "
+                         "candidate to judge without shadow scoring)")
+    if args.watch_interval <= 0:
+        raise SystemExit(
+            f"--watch-interval must be > 0, got {args.watch_interval}")
+    if not 0.0 < args.shadow_sample <= 1.0:
+        raise SystemExit(
+            f"--shadow-sample must be in (0, 1], got {args.shadow_sample}")
+    if args.shadow_queue < 1:
+        raise SystemExit(
+            f"--shadow-queue must be >= 1, got {args.shadow_queue}")
+    promote_policy = None
+    if args.promote_policy is not None:
+        from fraud_detection_tpu.registry import PromotionPolicy
+
+        try:
+            promote_policy = PromotionPolicy.parse(args.promote_policy)
+        except ValueError as e:
+            raise SystemExit(f"bad --promote-policy: {e}")
     if args.pipeline_depth < 1:
         # Fail fast: inside --supervise this would read as a transient
         # incarnation failure and burn restarts on a pure config error.
@@ -273,7 +333,30 @@ def main(argv=None) -> int:
         explain_hook = make_stream_explain_hook(
             backend, temperature=temp, max_tokens=args.explain_tokens)
 
-    pipe = build_pipeline(args.model, args.batch_size)
+    registry = None
+    shadow = None
+    lifecycle = None
+    model_desc = args.model
+    if args.registry is not None:
+        from fraud_detection_tpu.registry import (HotSwapPipeline,
+                                                  LifecycleController,
+                                                  ModelRegistry, RegistryError,
+                                                  RegistryIntegrityError,
+                                                  ShadowScorer)
+
+        registry = ModelRegistry(args.registry)
+        try:
+            mv, inner = registry.load(args.model_version,
+                                      batch_size=args.batch_size)
+        except (RegistryError, RegistryIntegrityError) as e:
+            raise SystemExit(f"--registry: {e}")
+        pipe = HotSwapPipeline(inner, version=mv.version)
+        model_desc = f"registry:{args.registry}@{mv.name}"
+        if args.shadow:
+            shadow = ShadowScorer(max_queue=args.shadow_queue,
+                                  sample=args.shadow_sample)
+    else:
+        pipe = build_pipeline(args.model, args.batch_size)
 
     broker = None
     if args.kafka:
@@ -349,7 +432,8 @@ def main(argv=None) -> int:
                                 dlq_topic=dlq_topic,
                                 dlq_max_attempts=args.dlq_max_attempts,
                                 dlq_attempts=dlq_attempts,
-                                breaker=breaker)
+                                breaker=breaker,
+                                shadow=shadow)
         engines_built.append(e)
         return e
 
@@ -367,7 +451,36 @@ def main(argv=None) -> int:
                 agg[k] += s.get(k, 0)
         return agg
 
-    print(f"serving: model={args.model} in={args.input_topic} out={args.output_topic} "
+    watch_stop = None
+    if args.watch:
+        # One watcher for the whole process (all workers share ``pipe``, so
+        # a swap lands everywhere at once): poll the registry, verify + pre-
+        # warm new versions, swap or stage+judge per the flags. Runs on a
+        # daemon thread; tick() failures log and never kill serving.
+        lifecycle = LifecycleController(
+            registry, pipe, shadow=shadow, policy=promote_policy,
+            batch_size=args.batch_size,
+            health_fn=lambda: (engines_built[-1].health()
+                               if engines_built else None))
+        _watch_thread, watch_stop = lifecycle.run_in_thread(
+            args.watch_interval)
+
+    def finish_lifecycle():
+        """Stop the watcher + shadow worker; returns the audit-event list
+        for the stats JSON (None when not serving from a registry)."""
+        if watch_stop is not None:
+            watch_stop.set()
+            _watch_thread.join(timeout=5.0)
+        if shadow is not None:
+            shadow.close(timeout=5.0)
+        if registry is None:
+            return None
+        return {"active_version": pipe.active_version,
+                "staged_version": pipe.staged_version,
+                "swaps": pipe.swaps,
+                "events": lifecycle.events if lifecycle is not None else []}
+
+    print(f"serving: model={model_desc} in={args.input_topic} out={args.output_topic} "
           f"batch={args.batch_size} workers={args.workers}", flush=True)
     if args.workers > 1:
         # Horizontal scale-out: N engines, ONE group — the broker (in-process
@@ -481,6 +594,9 @@ def main(argv=None) -> int:
         annotations = finish_annotations()
         if annotations is not None:
             merged["annotations"] = annotations
+        lifecycle_out = finish_lifecycle()
+        if lifecycle_out is not None:
+            merged["lifecycle"] = lifecycle_out
         finish_health()
         print(json.dumps(merged))
         if args.demo:
@@ -533,6 +649,9 @@ def main(argv=None) -> int:
     annotations = finish_annotations()
     if annotations is not None:
         out["annotations"] = annotations
+    lifecycle_out = finish_lifecycle()
+    if lifecycle_out is not None:
+        out["lifecycle"] = lifecycle_out
     finish_health()
     print(json.dumps(out))
     if args.demo:

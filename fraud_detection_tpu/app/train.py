@@ -102,6 +102,13 @@ def main(argv=None) -> int:
                     help="model=dir pairs, e.g. dt=./fraud_model_dt (repeatable); "
                          "model=spark:<dir> exports the Spark PipelineModel "
                          "layout instead of the native format")
+    ap.add_argument("--publish", action="append", default=[],
+                    help="model=registry-root pairs (repeatable): publish "
+                         "the trained model as the next version of a model "
+                         "registry — atomic, content-hashed, with this "
+                         "run's metrics in the manifest; a serve --registry "
+                         "--watch picks it up live "
+                         "(docs/model_lifecycle.md)")
     ap.add_argument("--mesh", action="store_true",
                     help="train data-parallel over all available devices")
     ap.add_argument("--json", action="store_true", help="emit metrics as JSON")
@@ -148,6 +155,14 @@ def main(argv=None) -> int:
                 f"--save expects model=dir or model=spark:dir with the model in "
                 f"--models (got {pair!r}, models: {chosen})")
         save_pairs.append((name, out_dir))
+    publish_pairs = []
+    for pair in args.publish:
+        name, _, root = pair.partition("=")
+        if not root or name not in chosen:
+            raise SystemExit(
+                f"--publish expects model=registry-root with the model in "
+                f"--models (got {pair!r}, models: {chosen})")
+        publish_pairs.append((name, root))
 
     corpus = load_corpus(args)
     train, val, test = train_val_test_split(corpus, seed=args.seed)
@@ -317,6 +332,19 @@ def main(argv=None) -> int:
         else:
             save_checkpoint(out_dir, feat, trained[name])
             print(f"saved {name} -> {out_dir}")
+
+    for name, root in publish_pairs:
+        from fraud_detection_tpu.registry import ModelRegistry
+
+        registry = ModelRegistry(root)
+        mv = registry.publish(
+            feat, trained[name],
+            metrics=all_metrics.get(name),
+            extra={"trained_with": {"model": name, "data": args.data,
+                                    "seed": args.seed,
+                                    "featurizer": args.featurizer}})
+        print(f"published {name} -> {root} as {mv.name} "
+              f"(parent: {mv.manifest['parent']})")
     return 0
 
 

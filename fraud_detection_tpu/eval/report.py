@@ -95,6 +95,54 @@ def plot_confusion_matrices(
     return paths
 
 
+def plot_shadow_comparison(
+    snapshot: dict,
+    path: str = "shadow_comparison.png",
+) -> Optional[str]:
+    """Render a ShadowScorer snapshot (registry/shadow.py) — the candidate
+    vs primary comparison an operator reads before trusting an
+    auto-promotion: overlaid score-distribution histograms (the PSI's
+    input), the agreement/flag-rate bars, and the headline divergence
+    numbers. Returns None when the snapshot holds no scored rows."""
+    rows = snapshot.get("rows") or 0
+    if rows == 0:
+        return None
+    p_hist = np.asarray(snapshot["score_hist_primary"], np.float64)
+    c_hist = np.asarray(snapshot["score_hist_candidate"], np.float64)
+    n_bins = len(p_hist)
+    edges = np.linspace(0.0, 1.0, n_bins + 1)[:-1]
+    width = 1.0 / n_bins
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4.2))
+    ax1.bar(edges, p_hist / max(p_hist.sum(), 1), width, align="edge",
+            alpha=0.6, label="primary", color="#5bc0de")
+    ax1.bar(edges, c_hist / max(c_hist.sum(), 1), width, align="edge",
+            alpha=0.6, label=f"candidate v{snapshot.get('candidate_version')}",
+            color="#d9534f")
+    ax1.set_xlabel("p(scam)")
+    ax1.set_ylabel("fraction of rows")
+    ax1.set_title(f"score distribution (PSI = {snapshot.get('psi'):.4f})")
+    ax1.legend(fontsize=8)
+
+    labels = ["agreement", "flag rate\n(primary)", "flag rate\n(candidate)"]
+    vals = [snapshot.get("agreement_rate") or 0.0,
+            snapshot.get("flag_rate_primary") or 0.0,
+            snapshot.get("flag_rate_candidate") or 0.0]
+    bars = ax2.bar(labels, vals, color=["#5cb85c", "#5bc0de", "#d9534f"])
+    for rect, v in zip(bars, vals):
+        ax2.annotate(f"{v:.4f}", (rect.get_x() + rect.get_width() / 2, v),
+                     ha="center", va="bottom", fontsize=8)
+    ax2.set_ylim(0, 1.1)
+    ax2.set_title(f"{rows} rows / {snapshot.get('batches')} batches — "
+                  f"mean |Δp| = {snapshot.get('mean_abs_dp'):.4f}, "
+                  f"dropped = {snapshot.get('dropped')}", fontsize=9)
+
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
 def plot_word_associations(
     associations: Sequence[WordAssociation],
     path: str = "word_associations.png",

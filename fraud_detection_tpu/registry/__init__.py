@@ -1,0 +1,31 @@
+"""Model lifecycle subsystem: versioned registry, hot swap, shadow, promotion.
+
+The reference serves one frozen Spark ``PipelineModel`` directory forever
+(SURVEY.md L1) — updating the fraud model means stopping the app, and a bad
+model is only discovered in production. This package turns the static scorer
+into an operable inference system:
+
+  registry.py   filesystem model registry — versioned dirs, atomic publish,
+                content-hash verification, poll-based watch, JSONL audit log
+  hotswap.py    HotSwapPipeline — RCU-style zero-downtime model swap with
+                pre-warming (XLA compile off the hot path)
+  shadow.py     ShadowScorer — async candidate scoring with divergence stats
+                (agreement, mean |Δp|, flag-rate delta, PSI)
+  promote.py    PromotionPolicy + LifecycleController — auto promote/reject
+                staged candidates, explicit rollback, audited transitions
+
+See docs/model_lifecycle.md for the full contract.
+"""
+
+from fraud_detection_tpu.registry.hotswap import HotSwapPipeline
+from fraud_detection_tpu.registry.promote import (LifecycleController,
+                                                  PromotionDecision,
+                                                  PromotionPolicy)
+from fraud_detection_tpu.registry.registry import (ModelRegistry, ModelVersion,
+                                                   RegistryError,
+                                                   RegistryIntegrityError)
+from fraud_detection_tpu.registry.shadow import ShadowScorer
+
+__all__ = ["HotSwapPipeline", "LifecycleController", "ModelRegistry",
+           "ModelVersion", "PromotionDecision", "PromotionPolicy",
+           "RegistryError", "RegistryIntegrityError", "ShadowScorer"]

@@ -1,0 +1,173 @@
+"""Zero-downtime model swapping for the streaming engine.
+
+``HotSwapPipeline`` stands where a ``ServingPipeline`` does — the engine
+scores through it untouched — and swaps the pipeline underneath RCU-style:
+readers (the engine's dispatch path, any thread) take NO lock; each scoring
+call reads the active ``(version, pipeline)`` reference exactly once, so a
+batch dispatched concurrently with a swap scores wholly with one model or
+wholly with the other, never a mix. Writers (the lifecycle watcher thread)
+serialize on a small lock that the hot path never touches.
+
+The swap contract that keeps p99 flat: a candidate is PRE-WARMED before it
+becomes active — a representative dummy batch runs through every jitted
+program it will serve (text path, and the raw-JSON path when available), so
+the XLA compile happens off the hot path, at stage/swap time, not on the
+first production batch after the swap.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+_PREWARM_TEXTS = [
+    "urgent your account has been suspended verify your social security "
+    "number immediately to avoid arrest and pay the processing fee now",
+    "good morning thank you for calling the clinic i would like to confirm "
+    "my appointment for tomorrow afternoon please bring your insurance card",
+]
+
+
+class HotSwapPipeline:
+    """A ServingPipeline holder whose model can be replaced between batches.
+
+    Engine-facing surface: ``predict_async`` / ``predict_json_async`` (the
+    two calls the streaming engine makes) plus ``predict``/``predict_one``
+    and attribute delegation for everything else — drop-in wherever a
+    ``ServingPipeline`` is accepted.
+    """
+
+    def __init__(self, pipeline, version: Optional[int] = None, *,
+                 prewarm_texts: Optional[Sequence[str]] = None,
+                 clock=time.monotonic):
+        # Single-reference RCU publish point: one tuple, swapped atomically
+        # under the GIL; every reader dereferences it exactly once per call.
+        self._active: Tuple[Optional[int], object] = (version, pipeline)
+        self._staged: Optional[Tuple[Optional[int], object]] = None
+        self._lock = threading.Lock()   # writers only; readers never touch it
+        self._clock = clock
+        self._prewarm_texts = list(prewarm_texts or _PREWARM_TEXTS)
+        self.swaps = 0
+        self._last_swap_at: Optional[float] = None
+
+    # ------------------------------------------------------------------
+    # reader surface (lock-free)
+    # ------------------------------------------------------------------
+
+    def predict_async(self, texts):
+        return self._active[1].predict_async(texts)
+
+    def predict_json_async(self, values, text_field: str = "text"):
+        return self._active[1].predict_json_async(values, text_field)
+
+    def predict(self, texts):
+        return self._active[1].predict(texts)
+
+    def predict_one(self, text: str):
+        return self._active[1].predict_one(text)
+
+    @property
+    def batch_size(self) -> int:
+        return self._active[1].batch_size
+
+    @property
+    def active_version(self) -> Optional[int]:
+        return self._active[0]
+
+    @property
+    def active_pipeline(self):
+        return self._active[1]
+
+    @property
+    def staged_version(self) -> Optional[int]:
+        staged = self._staged
+        return staged[0] if staged is not None else None
+
+    @property
+    def staged_pipeline(self):
+        staged = self._staged
+        return staged[1] if staged is not None else None
+
+    def __getattr__(self, name):
+        # Anything beyond the scoring surface (featurizer, model, mesh…)
+        # reads from the CURRENT active pipeline.
+        return getattr(self._active[1], name)
+
+    # ------------------------------------------------------------------
+    # writer surface (lifecycle thread)
+    # ------------------------------------------------------------------
+
+    def prewarm(self, pipeline) -> None:
+        """Run a representative dummy batch through every jitted program the
+        pipeline will serve, so compiles happen HERE, not on the first
+        post-swap production batch. Blocks until device results land."""
+        n = max(int(getattr(pipeline, "batch_size", 1)), 1)
+        texts = [self._prewarm_texts[i % len(self._prewarm_texts)]
+                 for i in range(min(n, 256))]
+        pipeline.predict(texts)
+        # The raw-JSON fast path compiles a separate program; warm it when
+        # the featurizer supports it (mirrors the engine's own probe).
+        values = [json.dumps({"text": t}).encode() for t in texts]
+        fast = pipeline.predict_json_async(values)
+        if fast is not None:
+            fast[0].resolve()
+
+    def swap(self, pipeline, version: Optional[int] = None, *,
+             prewarm: bool = True) -> Optional[int]:
+        """Make ``pipeline`` active (pre-warming it first, off the hot
+        path); returns the version it replaced. Readers mid-batch keep the
+        old model for that batch — nothing blocks, nothing tears."""
+        if prewarm:
+            self.prewarm(pipeline)
+        with self._lock:
+            old_version = self._active[0]
+            self._active = (version, pipeline)
+            self.swaps += 1
+            self._last_swap_at = self._clock()
+        return old_version
+
+    def stage(self, pipeline, version: Optional[int] = None, *,
+              prewarm: bool = True) -> None:
+        """Hold a candidate next to the active model (shadow scoring reads
+        it; ``promote_staged`` makes it active). Pre-warms at stage time so
+        promotion itself is instant."""
+        if prewarm:
+            self.prewarm(pipeline)
+        with self._lock:
+            self._staged = (version, pipeline)
+
+    def promote_staged(self) -> Optional[int]:
+        """Swap the staged candidate in; returns its version. The candidate
+        was pre-warmed at stage time, so this is a pure pointer swap."""
+        with self._lock:
+            if self._staged is None:
+                raise RuntimeError("no staged candidate to promote")
+            version, pipeline = self._staged
+            self._staged = None
+            self._active = (version, pipeline)
+            self.swaps += 1
+            self._last_swap_at = self._clock()
+        return version
+
+    def discard_staged(self) -> Optional[int]:
+        with self._lock:
+            staged, self._staged = self._staged, None
+        return staged[0] if staged is not None else None
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def lifecycle_snapshot(self) -> dict:
+        """The ``model`` block of ``StreamingClassifier.health()`` (minus
+        the shadow stats, which the engine merges in from its scorer)."""
+        now = self._clock()
+        return {
+            "active_version": self.active_version,
+            "staged_version": self.staged_version,
+            "swaps": self.swaps,
+            "last_swap_age_sec": (None if self._last_swap_at is None
+                                  else now - self._last_swap_at),
+        }

@@ -209,6 +209,7 @@ class StreamingClassifier:
         dlq_max_attempts: int = 3,
         dlq_attempts: Optional[dict] = None,
         breaker: Optional[object] = None,
+        shadow: Optional[object] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if pipeline_depth < 1:
@@ -282,6 +283,12 @@ class StreamingClassifier:
         # ``snapshot()``) — health() surfaces its state; the engine never
         # calls it directly (the explain hook / annotation lane own calls).
         self._breaker = breaker
+        # Optional registry/shadow.ShadowScorer: each scored batch's inputs
+        # + primary results are offered to the candidate's async scorer
+        # (non-blocking bounded queue — registry/shadow.py). The hot loop
+        # pays one ``wants()`` gate per batch while a candidate is staged,
+        # nothing when idle.
+        self._shadow = shadow
         # Injectable monotonic clock for health ages (tests drive it).
         self._clock = clock
         self._created_at = clock()
@@ -435,6 +442,9 @@ class StreamingClassifier:
         if preds is not None and self._annotation_lane is not None:
             self._submit_annotations(inflight, preds)
 
+        if preds is not None and self._shadow is not None:
+            self._submit_shadow(inflight, preds)
+
         if inflight.splice is not None and preds is not None:
             wires = self._assemble_frames_native(inflight, preds)
             return self._deliver(inflight, wires, t1)
@@ -549,6 +559,30 @@ class StreamingClassifier:
         if items:
             self._annotation_lane.submit(items)
 
+    def _submit_shadow(self, inflight: "_InFlight", preds) -> None:
+        """Offer this batch's valid rows + primary results to the shadow
+        scorer. Non-blocking by contract (bounded queue, drop + count on
+        overflow); payloads are REFERENCES (message bytes in raw mode,
+        decoded texts otherwise) — the candidate decode/score happens on
+        the shadow worker, never here."""
+        sh = self._shadow
+        if not sh.wants():
+            return
+        valid = inflight.valid_idx
+        if not valid:
+            return
+        if inflight.raw:
+            # Predictions are positional over ALL rows; slice to valid.
+            payloads = [inflight.msgs[i].value for i in valid]
+            labels = np.asarray(preds.labels)[valid]
+            probs = np.asarray(preds.probabilities)[valid]
+        else:
+            # Predictions already cover exactly the valid rows, in order.
+            payloads = [inflight.texts[i] for i in valid]
+            labels, probs = preds.labels, preds.probabilities
+        sh.submit(payloads, labels, probs, raw=inflight.raw,
+                  text_field=self.text_field)
+
     def _dead_letter(self, inflight: "_InFlight", msg: Message, reason: str,
                      error: str, attempts: Optional[int] = None) -> None:
         """Divert one row to the DLQ: its record rides THIS batch's delivery
@@ -597,6 +631,19 @@ class StreamingClassifier:
         now = self._clock()
         lane = self._annotation_lane
         breaker = self._breaker
+        # Model-lifecycle block (docs/model_lifecycle.md): present when the
+        # engine scores through a HotSwapPipeline (active/staged versions,
+        # swap count) and/or a ShadowScorer is attached (divergence stats);
+        # None for a plain static pipeline.
+        snap_fn = getattr(self.pipeline, "lifecycle_snapshot", None)
+        model = snap_fn() if callable(snap_fn) else None
+        if self._shadow is not None:
+            if model is None:
+                model = {"active_version": None, "staged_version": None,
+                         "swaps": 0, "last_swap_age_sec": None}
+            model["shadow"] = self._shadow.snapshot()
+        elif model is not None:
+            model["shadow"] = None
         return {
             "running": self._running,
             "stopped": self._stopped,
@@ -619,6 +666,7 @@ class StreamingClassifier:
             "breaker": (breaker.snapshot()
                         if breaker is not None and hasattr(breaker, "snapshot")
                         else None),
+            "model": model,
         }
 
     def close_annotations(self, timeout: float = 30.0) -> bool:

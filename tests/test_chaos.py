@@ -536,7 +536,8 @@ def test_health_snapshot_fields_and_monotonic_ages(pipeline):
                        "last_batch_age_sec", "in_flight_depth",
                        "consecutive_flush_failures", "processed",
                        "malformed", "dead_lettered", "dlq", "annotations",
-                       "breaker"}
+                       "breaker", "model"}
+    assert h1["model"] is None          # plain pipeline: no lifecycle block
     assert h1["running"] is False
     assert h1["uptime_sec"] == 5.0
     assert h1["last_batch_age_sec"] == 0.0      # delivered at t=105

@@ -11,8 +11,11 @@ import json
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from fraud_detection_tpu.featurize import native as native_mod
 from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer

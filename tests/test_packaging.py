@@ -5,15 +5,24 @@ declared dependency set must cover what the package actually imports
 import ast
 import importlib
 import pathlib
+import re
 import sys
-import tomllib
+
+try:
+    import tomllib
+except ImportError:          # Python < 3.11: the baked image ships tomli
+    import tomli as tomllib
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
-def _project():
+def _pyproject():
     with open(ROOT / "pyproject.toml", "rb") as f:
-        return tomllib.load(f)["project"]
+        return tomllib.load(f)
+
+
+def _project():
+    return _pyproject()["project"]
 
 
 def test_console_script_targets_resolve():
@@ -54,6 +63,24 @@ def test_declared_dependencies_cover_package_imports():
     missing = {m for m in _top_level_imports()
                if m not in stdlib and m not in declared}
     assert not missing, f"imported but not declared in pyproject: {sorted(missing)}"
+
+
+def test_version_single_source():
+    """The package version must have ONE source of truth: pyproject declares
+    it dynamic and reads ``fraud_detection_tpu.__version__`` — the two
+    drifted (0.1.0 vs 0.2.0) when both were hand-edited."""
+    data = _pyproject()
+    proj = data["project"]
+    assert "version" not in proj, \
+        "pyproject pins a static version; it must be dynamic from the package"
+    assert "version" in proj.get("dynamic", [])
+    attr = data["tool"]["setuptools"]["dynamic"]["version"]["attr"]
+    assert attr == "fraud_detection_tpu.__version__"
+    import fraud_detection_tpu as pkg
+
+    # PEP 440-ish shape check — catches a typo'd or placeholder version.
+    assert re.fullmatch(r"\d+\.\d+\.\d+([ab]\d+|rc\d+|\.dev\d+)?",
+                        pkg.__version__), pkg.__version__
 
 
 def test_declared_dependencies_importable():

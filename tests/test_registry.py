@@ -1,0 +1,214 @@
+"""Model registry core: versioned layout, atomic publish, integrity, watch.
+
+The acceptance contract (ISSUE 2): a torn/partial publish is NEVER visible
+to ``latest()``, and a corrupted checkpoint file fails manifest hash
+verification with a clear error instead of loading.
+"""
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.checkpoint.native import save_checkpoint
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+from fraud_detection_tpu.models.linear import LogisticRegression
+from fraud_detection_tpu.registry import (ModelRegistry, RegistryError,
+                                          RegistryIntegrityError)
+from tests.fixtures import BENIGN_DIALOGUE, SCAM_DIALOGUE
+
+pytestmark = pytest.mark.lifecycle
+
+
+def make_featurizer(num_features=256):
+    feat = HashingTfIdfFeaturizer(num_features=num_features)
+    feat.fit_idf([SCAM_DIALOGUE, BENIGN_DIALOGUE])
+    return feat
+
+
+def const_model(logit, num_features=256):
+    """LR with zero weights: every input scores sigmoid(logit) — lets tests
+    build models whose outputs are constant and distinguishable."""
+    return LogisticRegression.from_arrays(
+        np.zeros(num_features, np.float32), float(logit))
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    return ModelRegistry(str(tmp_path / "registry"))
+
+
+def test_publish_versioned_layout_and_manifest(registry):
+    feat = make_featurizer()
+    mv1 = registry.publish(feat, const_model(-5.0), metrics={"auc": 0.91})
+    mv2 = registry.publish(feat, const_model(5.0))
+
+    assert registry.list_versions() == [1, 2]
+    assert registry.latest().version == 2
+    assert mv1.name == "v0001" and os.path.isdir(mv1.checkpoint_path)
+
+    m = registry.get(1).manifest
+    assert m["schema_version"] == 1
+    assert m["model_kind"] == "logistic_regression"
+    assert m["metrics"] == {"auc": 0.91}
+    assert m["parent"] is None
+    assert isinstance(m["created_at"], float)
+    # Every checkpoint file is hashed (manifest.json + arrays.npz at least).
+    files = m["files"]
+    assert set(files) >= {"checkpoint/manifest.json", "checkpoint/arrays.npz"}
+    for meta in files.values():
+        assert len(meta["sha256"]) == 64 and meta["bytes"] > 0
+    # Lineage: v2's parent is v1.
+    assert registry.get(2).manifest["parent"] == 1
+
+
+def test_load_round_trips_servable_pipeline(registry):
+    feat = make_featurizer()
+    registry.publish(feat, const_model(-8.0))
+    registry.publish(feat, const_model(8.0))
+    _, benign = registry.load(1, batch_size=32)
+    _, scam = registry.load(2, batch_size=32)
+    assert benign.predict_one("anything")[0] == 0
+    assert scam.predict_one("anything")[0] == 1
+
+
+def test_publish_dir_copies_existing_checkpoint(registry, tmp_path):
+    feat = make_featurizer()
+    src = str(tmp_path / "ckpt")
+    save_checkpoint(src, feat, const_model(3.0))
+    mv = registry.publish_dir(src, metrics={"f1": 0.8})
+    assert mv.version == 1 and mv.manifest["metrics"] == {"f1": 0.8}
+    registry.verify(1)
+    with pytest.raises(RegistryError, match="not a native checkpoint"):
+        registry.publish_dir(str(tmp_path / "nonexistent"))
+
+
+def test_torn_publish_never_visible(registry):
+    """A crash mid-publish leaves only a hidden temp dir; a hand-torn
+    version dir (files but no manifest) is equally invisible — ``latest()``
+    and ``list_versions()`` only ever see fully-published versions."""
+    feat = make_featurizer()
+    registry.publish(feat, const_model(-5.0))
+
+    # Crash between files: the temp dir exists, the rename never happened.
+    leftover = os.path.join(registry.root, ".publish-crashed")
+    os.makedirs(os.path.join(leftover, "checkpoint"))
+    with open(os.path.join(leftover, "checkpoint", "arrays.npz"), "wb") as fh:
+        fh.write(b"partial bytes")
+
+    # Torn version dir: checkpoint files present, manifest missing (a
+    # non-atomic publisher could expose this state; ours cannot).
+    torn = os.path.join(registry.root, "v0002")
+    shutil.copytree(os.path.join(registry.root, "v0001", "checkpoint"),
+                    os.path.join(torn, "checkpoint"))
+
+    assert registry.list_versions() == [1]
+    assert registry.latest().version == 1
+    assert registry.poll_new(0) and registry.poll_new(0)[-1].version == 1
+    with pytest.raises(RegistryError, match="does not exist"):
+        registry.get(2)
+
+
+def test_corrupted_checkpoint_fails_verification(registry):
+    feat = make_featurizer()
+    mv = registry.publish(feat, const_model(-5.0))
+    arrays = os.path.join(mv.checkpoint_path, "arrays.npz")
+    blob = bytearray(open(arrays, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(arrays, "wb") as fh:
+        fh.write(bytes(blob))
+    with pytest.raises(RegistryIntegrityError, match="hash mismatch"):
+        registry.verify(1)
+    with pytest.raises(RegistryIntegrityError, match="arrays.npz"):
+        registry.load(1)
+
+
+def test_truncated_and_missing_files_fail_verification(registry):
+    feat = make_featurizer()
+    mv = registry.publish(feat, const_model(-5.0))
+    arrays = os.path.join(mv.checkpoint_path, "arrays.npz")
+    blob = open(arrays, "rb").read()
+    with open(arrays, "wb") as fh:
+        fh.write(blob[: len(blob) // 2])
+    with pytest.raises(RegistryIntegrityError, match="truncated"):
+        registry.verify(1)
+    os.remove(arrays)
+    with pytest.raises(RegistryIntegrityError, match="missing"):
+        registry.verify(1)
+
+
+def test_version_number_race_retries(registry, monkeypatch):
+    """Two publishers racing the same version number: the loser's rename
+    hits the existing (non-empty) dir and must retry with the next number —
+    never clobber, never fail the publish."""
+    feat = make_featurizer()
+    registry.publish(feat, const_model(-5.0))
+    # Stale listing forces the next publish to aim at the taken v0001.
+    monkeypatch.setattr(registry, "list_versions", lambda: [])
+    mv = registry._publish_with(
+        lambda d: save_checkpoint(d, feat, const_model(5.0)),
+        metrics=None, parent=None, extra=None)
+    assert mv.version == 2
+    monkeypatch.undo()
+    assert registry.list_versions() == [1, 2]
+    registry.verify(2)
+
+
+def test_empty_registry_load_is_clear_error(registry):
+    with pytest.raises(RegistryError, match="no published versions"):
+        registry.load()
+
+
+def test_watch_yields_new_versions(registry):
+    feat = make_featurizer()
+    registry.publish(feat, const_model(-5.0))
+    stop = threading.Event()
+    seen = []
+    gen = registry.watch(interval=0.01, after=0, stop=stop)
+    seen.append(next(gen).version)          # existing version surfaces
+    registry.publish(feat, const_model(5.0))
+    seen.append(next(gen).version)          # new publish detected via mtime
+    stop.set()
+    assert seen == [1, 2]
+    assert list(gen) == []                  # stopped generator ends
+
+
+def test_train_cli_publish(tmp_path, capsys):
+    """`train --publish lr=<root>` lands the trained model as the next
+    registry version with the run's metrics in the manifest."""
+    from fraud_detection_tpu.app.train import main as train_main
+
+    root = str(tmp_path / "registry")
+    rc = train_main(["--data", "synthetic", "--n", "240", "--models", "lr",
+                     "--publish", f"lr={root}"])
+    assert rc == 0
+    reg = ModelRegistry(root)
+    assert reg.list_versions() == [1]
+    m = reg.get(1).manifest
+    assert m["model_kind"] == "logistic_regression"
+    assert "Validation" in m["metrics"] and "Test" in m["metrics"]
+    assert m["trained_with"]["model"] == "lr"
+    _, pipe = reg.load(1)                      # verified + servable
+    assert pipe.predict_one("hello")[0] in (0, 1)
+    assert "published lr ->" in capsys.readouterr().out
+    with pytest.raises(SystemExit, match="--publish expects"):
+        train_main(["--data", "synthetic", "--n", "100", "--models", "lr",
+                    "--publish", "dt=somewhere"])
+
+
+def test_audit_log_append_and_read(registry):
+    feat = make_featurizer()
+    registry.publish(feat, const_model(-5.0), metrics={"auc": 0.9})
+    registry.audit("rollback", version=1, previous=2)
+    events = registry.read_audit()
+    assert [e["event"] for e in events] == ["publish", "rollback"]
+    assert events[0]["version"] == 1 and events[0]["metrics"] == {"auc": 0.9}
+    assert events[1]["previous"] == 2
+    assert all("ts" in e for e in events)
+    # Append-only JSONL: one valid JSON object per line.
+    with open(os.path.join(registry.root, "audit.jsonl")) as fh:
+        assert [json.loads(line)["event"] for line in fh] == \
+            ["publish", "rollback"]
