@@ -417,6 +417,115 @@ def tree_streaming_bench(texts, batch_size: int, depth: int,
     return out
 
 
+def _paced_point(pipe, texts, rate: float, duration_s: float,
+                 batch_size: int, depth: int,
+                 target_p99_ms) -> dict:
+    """One offered-load point: a feeder thread produces at ``rate`` rows/sec
+    (paced in ~5ms bursts) while the engine — scheduler attached — drains.
+    Returns offered vs delivered rate, per-row enqueue->produce latency
+    quantiles, and shed accounting."""
+    import threading
+
+    from fraud_detection_tpu.sched import AdaptiveScheduler, SchedulerConfig
+    from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+
+    n = max(batch_size, int(rate * duration_s))
+    broker = InProcessBroker(num_partitions=3)
+    producer = broker.producer()
+    payloads = [json.dumps({"text": texts[i % len(texts)], "id": i}).encode()
+                for i in range(n)]
+
+    def feeder():
+        t0 = time.perf_counter()
+        chunk = max(1, int(rate * 0.005))
+        for start in range(0, n, chunk):
+            wait = t0 + start / rate - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            for i in range(start, min(start + chunk, n)):
+                producer.produce("sweep-in", payloads[i],
+                                 key=str(i).encode())
+
+    cfg = SchedulerConfig(
+        batch_deadline_ms=10.0,
+        shed_policy="adaptive" if target_p99_ms else "none",
+        target_p99_ms=target_p99_ms,
+        # Watermark sized to the latency target at this offered rate (rows
+        # the queue may hold before shedding); no target -> no shedding.
+        max_queue=(max(batch_size, int(rate * target_p99_ms / 1e3))
+                   if target_p99_ms else None))
+    sched = AdaptiveScheduler(cfg, batch_size)
+    engine = StreamingClassifier(
+        pipe, broker.consumer(["sweep-in"], "sweep"), broker.producer(),
+        "sweep-out", batch_size=batch_size, max_wait=0.01,
+        pipeline_depth=depth, scheduler=sched,
+        dlq_topic="sweep-dlq" if cfg.shed_policy != "none" else None)
+    thread = threading.Thread(target=feeder, daemon=True)
+    t0 = time.perf_counter()
+    thread.start()
+    stats = engine.run(max_messages=n, idle_timeout=max(2.0, duration_s))
+    wall = time.perf_counter() - t0
+    thread.join(timeout=duration_s + 10)
+    delivered = broker.topic_size("sweep-out")
+    return {
+        "offered_per_s": round(rate, 1),
+        "delivered_per_s": round(delivered / wall, 1) if wall > 0 else 0.0,
+        "fed": n, "delivered": delivered, "shed": stats.shed,
+        "p50_row_ms": stats.row_latency_ms(0.50),
+        "p99_row_ms": stats.row_latency_ms(0.99),
+    }
+
+
+def load_sweep_bench(pipe, texts, batch_size: int, depth: int,
+                     target_p99_ms=None) -> dict:
+    """Offered-load sweep: latency-vs-throughput curve for the scheduled
+    serving path. Estimates capacity with one unpaced drain, then sweeps
+    offered load across it (under to 3x over); reports the saturation knee
+    (highest offered load the engine still tracks within 10%) and — when a
+    target is set — the highest offered load whose per-row p99 met it,
+    with the adaptive shed policy keeping latency bounded past saturation.
+    BENCH_SWEEP_SEC sizes each point's window; BENCH_LOAD_SWEEP=0 skips
+    the leg entirely."""
+    from fraud_detection_tpu.sched import default_ladder, prewarm_ladder
+
+    duration_s = float(os.environ.get("BENCH_SWEEP_SEC", "2.0"))
+    # Ladder shapes compile here, off the timed points — warmed with the
+    # SWEEP corpus so token-width padding buckets match too; the bare-
+    # pipeline padding contract is restored afterward so later legs are
+    # unaffected.
+    prewarm_ladder(pipe, default_ladder(batch_size), texts=texts)
+    try:
+        cap_stats = _stream_run(pipe, texts, batch_size, depth,
+                                n_msgs=min(20_000, 10 * batch_size))
+        capacity = cap_stats.msgs_per_sec
+        points = []
+        for frac in (0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0):
+            rate = max(500.0, capacity * frac)
+            point = _paced_point(pipe, texts, rate, duration_s, batch_size,
+                                 depth, target_p99_ms)
+            point["offered_frac_of_capacity"] = frac
+            points.append(point)
+    finally:
+        pipe.pad_ladder = None
+    knee = None
+    for p in points:
+        if p["delivered_per_s"] >= 0.9 * p["offered_per_s"]:
+            knee = p["offered_per_s"]
+    meets = None
+    if target_p99_ms is not None:
+        for p in points:
+            if p["p99_row_ms"] is not None and p["p99_row_ms"] <= target_p99_ms:
+                meets = p["offered_per_s"]
+    return {
+        "capacity_est_per_s": round(capacity, 1),
+        "point_sec": duration_s,
+        "target_p99_ms": target_p99_ms,
+        "saturation_knee_per_s": knee,
+        "max_load_meeting_target_p99_per_s": meets,
+        "points": points,
+    }
+
+
 GEMMA2B_HF_CONFIG = {
     # Gemma-2B's actual architecture (BASELINE config 5 names "Gemma-2B via
     # JAX" as the on-pod scale target): MQA with one 256-wide KV head, GeGLU
@@ -1014,6 +1123,22 @@ def main() -> None:
         line["tree_streaming"] = leg(lambda: tree_streaming_bench(
             texts, batch_size, depth, n_msgs=min(n_msgs, 10_000),
             lr_pipe=pipe))
+    # Offered-load sweep (bench.py --load-sweep, default-on so the committed
+    # artifact carries the latency-vs-throughput trajectory, not just one
+    # drain rate): saturation knee + max load meeting --target-p99-ms.
+    argv = sys.argv[1:]
+    want_sweep = ("--load-sweep" in argv
+                  or os.environ.get("BENCH_LOAD_SWEEP", "1") != "0")
+    target_p99 = None
+    if "--target-p99-ms" in argv:
+        target_p99 = float(argv[argv.index("--target-p99-ms") + 1])
+    elif os.environ.get("BENCH_TARGET_P99_MS"):
+        target_p99 = float(os.environ["BENCH_TARGET_P99_MS"])
+    else:
+        target_p99 = 250.0  # default SLO so the shedding path is exercised
+    if want_sweep:
+        line["load_sweep"] = leg(lambda: load_sweep_bench(
+            pipe, texts, batch_size, depth, target_p99_ms=target_p99))
     if os.environ.get("BENCH_TRAIN", "1") != "0":
         line["training"] = leg(training_bench)
     # LLM leg: default-on only where it's fast (real TPU). Off-TPU the

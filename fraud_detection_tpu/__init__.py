@@ -18,6 +18,7 @@ Layer map (mirrors SURVEY.md §7):
   eval/        metrics (accuracy/P/R/F1/AUC), confusion matrices, plots
   explain/     LLM explanation backends (OpenAI-compatible HTTP, on-pod JAX)
   registry/    model lifecycle: versioned registry, hot swap, shadow, promotion
+  sched/       adaptive serving scheduler: dynamic batching, admission, SLO
   app/         Streamlit UI + CLI entry points
   utils/       config, logging, profiling
 """
@@ -25,7 +26,7 @@ Layer map (mirrors SURVEY.md §7):
 # Single source of truth for the package version: pyproject.toml reads this
 # attribute via [tool.setuptools.dynamic] (tests/test_packaging.py pins the
 # linkage so the two can never drift again).
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer  # noqa: F401
 from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline  # noqa: F401

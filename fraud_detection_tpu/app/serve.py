@@ -124,6 +124,34 @@ def main(argv=None) -> int:
                     help="micro-batch assembly deadline (seconds)")
     ap.add_argument("--pipeline-depth", type=int, default=2,
                     help="device batches kept in flight (hides round-trip latency)")
+    ap.add_argument("--batch-deadline-ms", type=float, default=None,
+                    help="adaptive scheduler: ship a partial micro-batch "
+                         "this many ms after its first row instead of "
+                         "waiting to fill --batch-size; partial batches "
+                         "pad to a pre-warmed bucket ladder, so no XLA "
+                         "compile ever lands on the hot path "
+                         "(docs/scheduling.md)")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="queue-depth high watermark (rows backlogged at "
+                         "the broker): above it a shedding --shed-policy "
+                         "diverts the excess to the DLQ lane as explicit "
+                         "shed records")
+    ap.add_argument("--shed-policy", default="none",
+                    choices=["none", "reject", "adaptive"],
+                    help="load shedding: 'none' never sheds (a --max-rate "
+                         "then paces polls instead), 'reject' sheds over "
+                         "--max-queue/--max-rate, 'adaptive' also sheds an "
+                         "AIMD-controlled fraction while p99 exceeds "
+                         "--target-p99-ms; shedding implies --dlq (shed "
+                         "rows are records, never silent drops)")
+    ap.add_argument("--target-p99-ms", type=float, default=None,
+                    help="SLO target for per-row enqueue->produce p99 "
+                         "latency; feeds the backpressure governor and the "
+                         "'adaptive' shed policy, surfaced in health()")
+    ap.add_argument("--max-rate", type=float, default=None,
+                    help="token-bucket admission limit, rows/sec (paces "
+                         "polls under --shed-policy none, sheds the "
+                         "overflow otherwise)")
     ap.add_argument("--kafka", action="store_true",
                     help="use real Kafka via confluent_kafka + KAFKA_* env vars")
     ap.add_argument("--demo", type=int, metavar="N", default=0,
@@ -249,6 +277,26 @@ def main(argv=None) -> int:
         raise SystemExit("--chaos needs --demo N (faults are injected into "
                          "the in-process broker; against real Kafka use a "
                          "real chaos tool)")
+    sched_config = None
+    if (args.batch_deadline_ms is not None or args.max_queue is not None
+            or args.shed_policy != "none" or args.target_p99_ms is not None
+            or args.max_rate is not None):
+        from fraud_detection_tpu.sched import SchedulerConfig
+
+        try:
+            sched_config = SchedulerConfig(
+                batch_deadline_ms=args.batch_deadline_ms,
+                max_queue=args.max_queue,
+                shed_policy=args.shed_policy,
+                target_p99_ms=args.target_p99_ms,
+                max_rate=args.max_rate)
+        except ValueError as e:
+            raise SystemExit(f"bad scheduler config: {e}")
+        if args.shed_policy != "none":
+            # Shed rows are structured DLQ records by contract — a shedding
+            # scheduler without the DLQ lane would have nowhere non-silent
+            # to put them.
+            args.dlq = True
     if args.dlq_topic is not None:
         args.dlq = True
     if args.dlq_max_attempts < 1:
@@ -358,6 +406,15 @@ def main(argv=None) -> int:
     else:
         pipe = build_pipeline(args.model, args.batch_size)
 
+    if sched_config is not None:
+        # Pre-warm the padding-bucket ladder ONCE, before any engine runs:
+        # every rung's XLA shape compiles here, off the hot path. A
+        # HotSwapPipeline adopts the ladder for all future swap candidates
+        # too (registry/hotswap.py configure_ladder).
+        from fraud_detection_tpu.sched import AdaptiveScheduler
+
+        AdaptiveScheduler(sched_config, args.batch_size).prewarm(pipe)
+
     broker = None
     if args.kafka:
         if not kafka_available():
@@ -406,6 +463,7 @@ def main(argv=None) -> int:
         dlq_topic = args.dlq_topic or f"{args.output_topic}-dlq"
 
     engines_built = []   # async lanes to drain + aggregate at exit
+    sched_per_worker: dict = {}
 
     def make_engine(replacing=None, worker=0):
         """Build an engine; ``replacing`` is the previous incarnation on a
@@ -414,11 +472,22 @@ def main(argv=None) -> int:
         a producer. The DLQ poison tracker is shared across one WORKER's
         incarnations (so counts survive restarts) but never across workers:
         they own disjoint partitions, and a cross-thread dict would race a
-        worker's cleanup iteration against another's inserts."""
+        worker's cleanup iteration against another's inserts. The adaptive
+        scheduler follows the same per-worker sharing: one scheduler per
+        worker keeps the SLO window and EWMAs warm across supervised
+        restarts (incarnations of one worker run sequentially, so the
+        single-driver contract holds), never across workers (collect/admit
+        state is single-driver by contract)."""
         if replacing is not None:
             replacing.close_annotations(timeout=5.0)
         dlq_attempts = (dlq_trackers.setdefault(worker, {})
                         if args.dlq else None)
+        scheduler = None
+        if sched_config is not None:
+            from fraud_detection_tpu.sched import AdaptiveScheduler
+
+            scheduler = sched_per_worker.setdefault(
+                worker, AdaptiveScheduler(sched_config, args.batch_size))
         c, p = make_clients()
         e = StreamingClassifier(pipe, c, p, args.output_topic,
                                 batch_size=args.batch_size, max_wait=args.max_wait,
@@ -433,7 +502,8 @@ def main(argv=None) -> int:
                                 dlq_max_attempts=args.dlq_max_attempts,
                                 dlq_attempts=dlq_attempts,
                                 breaker=breaker,
-                                shadow=shadow)
+                                shadow=shadow,
+                                scheduler=scheduler)
         engines_built.append(e)
         return e
 

@@ -82,6 +82,13 @@ class ServingPipeline:
         self.featurizer = featurizer
         self.batch_size = batch_size
         self.mesh = mesh  # data-parallel serving: rows sharded on "data"
+        # Padding-bucket ladder (sched/batcher.py): when set (ascending
+        # rungs, e.g. (64, 256, 1024)), a partial chunk pads to the smallest
+        # rung that fits instead of to batch_size — small batches pay small
+        # device programs, and the rung set is the FIXED menu of compiled
+        # shapes (pre-warmed at startup so the hot path never compiles).
+        # None keeps the single batch_size shape of the bare pipeline.
+        self.pad_ladder: Optional[Tuple[int, ...]] = None
         self.model = model
         if isinstance(model, LogisticRegression):
             # Fold IDF into the weights so the sparse fast path sees raw counts.
@@ -92,6 +99,16 @@ class ServingPipeline:
             # matrix (one scatter + traversal, still one device program).
             self._fused_model = None
         self._tree_idf = None  # device IDF cache for the tree fast path
+
+    def _pad_rows(self, n: int) -> int:
+        """Row-padding target for an n-row chunk: the smallest ladder rung
+        that fits (ladder configured), else batch_size (the bare contract)."""
+        ladder = self.pad_ladder
+        if ladder:
+            for b in ladder:
+                if n <= b:
+                    return b
+        return self.batch_size
 
     @property
     def fused_model(self) -> LogisticRegression:
@@ -189,7 +206,8 @@ class ServingPipeline:
         ctxs: Optional[List[Tuple[object, int]]] = []
         for start in range(0, len(values), self.batch_size):
             chunk = values[start : start + self.batch_size]
-            out = encode_json(chunk, text_field, batch_size=self.batch_size,
+            out = encode_json(chunk, text_field,
+                              batch_size=self._pad_rows(len(chunk)),
                               keep_splice_ctx=True)
             if out is None:
                 return None
@@ -281,11 +299,13 @@ class ServingPipeline:
             chunk = list(texts[start : start + self.batch_size])
             n = len(chunk)
             if self._fused_model is not None:
-                enc = self.featurizer.encode(chunk, batch_size=self.batch_size)
+                enc = self.featurizer.encode(chunk,
+                                             batch_size=self._pad_rows(n))
                 parts.append((self._dispatch_fused(enc), n))
                 threshold = self._fused_model.threshold
                 continue
-            dense = self.featurizer.featurize_dense(chunk, batch_size=self.batch_size)
+            dense = self.featurizer.featurize_dense(
+                chunk, batch_size=self._pad_rows(n))
             proba = trees_mod.predict_proba(self.model, jnp.asarray(dense))
             p = proba[:, 1] if tree_binary else proba
             argmax = not tree_binary

@@ -41,6 +41,7 @@ class HotSwapPipeline:
 
     def __init__(self, pipeline, version: Optional[int] = None, *,
                  prewarm_texts: Optional[Sequence[str]] = None,
+                 prewarm_buckets: Optional[Sequence[int]] = None,
                  clock=time.monotonic):
         # Single-reference RCU publish point: one tuple, swapped atomically
         # under the GIL; every reader dereferences it exactly once per call.
@@ -49,8 +50,14 @@ class HotSwapPipeline:
         self._lock = threading.Lock()   # writers only; readers never touch it
         self._clock = clock
         self._prewarm_texts = list(prewarm_texts or _PREWARM_TEXTS)
+        # Scheduler padding-bucket ladder (sched/batcher.py): once
+        # configured, EVERY candidate is pre-warmed at every rung, so
+        # neither a swap nor a first small batch compiles on the hot path.
+        self._pad_buckets: Optional[Tuple[int, ...]] = None
         self.swaps = 0
         self._last_swap_at: Optional[float] = None
+        if prewarm_buckets is not None:
+            self.configure_ladder(prewarm_buckets, prewarm=False)
 
     # ------------------------------------------------------------------
     # reader surface (lock-free)
@@ -99,10 +106,37 @@ class HotSwapPipeline:
     # writer surface (lifecycle thread)
     # ------------------------------------------------------------------
 
+    def configure_ladder(self, buckets: Sequence[int], *,
+                         prewarm: bool = True) -> None:
+        """Adopt a scheduler padding-bucket ladder (sched/batcher.py): the
+        active pipeline (and any staged candidate) starts padding partial
+        batches to ladder rungs, and every future ``prewarm`` — i.e. every
+        swap/stage candidate — compiles every rung, keeping the hot path
+        compile-free across swaps AND across batch sizes."""
+        self._pad_buckets = tuple(sorted(set(int(b) for b in buckets)))
+        for target in (self.active_pipeline, self.staged_pipeline):
+            if target is not None:
+                if prewarm:
+                    self.prewarm(target)
+                else:
+                    target.pad_ladder = self._pad_buckets
+
+    @property
+    def pad_buckets(self) -> Optional[Tuple[int, ...]]:
+        return self._pad_buckets
+
     def prewarm(self, pipeline) -> None:
         """Run a representative dummy batch through every jitted program the
         pipeline will serve, so compiles happen HERE, not on the first
-        post-swap production batch. Blocks until device results land."""
+        post-swap production batch. Blocks until device results land. With a
+        ladder configured, every rung's shape is warmed (a partial batch
+        then pads to a rung, so the rung set IS the compiled-shape menu)."""
+        if self._pad_buckets is not None:
+            from fraud_detection_tpu.sched.batcher import prewarm_ladder
+
+            prewarm_ladder(pipeline, self._pad_buckets,
+                           texts=self._prewarm_texts)
+            return
         n = max(int(getattr(pipeline, "batch_size", 1)), 1)
         texts = [self._prewarm_texts[i % len(self._prewarm_texts)]
                  for i in range(min(n, 256))]
@@ -121,6 +155,8 @@ class HotSwapPipeline:
         old model for that batch — nothing blocks, nothing tears."""
         if prewarm:
             self.prewarm(pipeline)
+        elif self._pad_buckets is not None:
+            pipeline.pad_ladder = self._pad_buckets  # ladder survives swaps
         with self._lock:
             old_version = self._active[0]
             self._active = (version, pipeline)
@@ -135,6 +171,8 @@ class HotSwapPipeline:
         promotion itself is instant."""
         if prewarm:
             self.prewarm(pipeline)
+        elif self._pad_buckets is not None:
+            pipeline.pad_ladder = self._pad_buckets  # ladder survives swaps
         with self._lock:
             self._staged = (version, pipeline)
 

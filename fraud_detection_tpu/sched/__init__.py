@@ -1,0 +1,41 @@
+"""Adaptive serving scheduler — the consume->score handoff, made load-aware.
+
+The engine's original loop drains the consumer into a fixed-size micro-batch
+and scores it, with no notion of offered load: a trickle pays full-batch
+padding compute, and a flood has nowhere to go but queue growth. This
+subsystem owns that handoff (docs/scheduling.md):
+
+* :mod:`sketch` — bounded-memory streaming quantile sketch + EWMA; the
+  per-row enqueue->produce latency accounting everything else reads.
+* :mod:`batcher` — deadline-driven dynamic batching over a padding-bucket
+  ladder, so partial batches ship early without fresh XLA compiles.
+* :mod:`admission` — token-bucket rate limiting and queue-depth watermarks
+  with EXPLICIT load shedding (structured records to the DLQ lane).
+* :mod:`governor` — backpressure pacing from EWMAs of batch latency, so the
+  engine degrades to bounded latency instead of unbounded memory.
+* :mod:`scheduler` — the facade the engine drives
+  (:class:`AdaptiveScheduler` + :class:`SchedulerConfig`).
+"""
+
+from fraud_detection_tpu.sched.admission import (AdmissionController,
+                                                 TokenBucket)
+from fraud_detection_tpu.sched.batcher import (DynamicBatcher, default_ladder,
+                                               prewarm_ladder)
+from fraud_detection_tpu.sched.governor import BackpressureGovernor
+from fraud_detection_tpu.sched.scheduler import (AdaptiveScheduler,
+                                                 SchedulerConfig)
+from fraud_detection_tpu.sched.sketch import Ewma, LatencySketch, SloTracker
+
+__all__ = [
+    "AdaptiveScheduler",
+    "AdmissionController",
+    "BackpressureGovernor",
+    "DynamicBatcher",
+    "Ewma",
+    "LatencySketch",
+    "SchedulerConfig",
+    "SloTracker",
+    "TokenBucket",
+    "default_ladder",
+    "prewarm_ladder",
+]

@@ -1,0 +1,227 @@
+"""Admission control: token-bucket rate limiting + queue watermarks + shedding.
+
+The engine's only overload response used to be implicit: the broker backlog
+grows without bound until the consumer session dies. This module makes the
+response EXPLICIT and accountable:
+
+* a :class:`TokenBucket` meters admitted rows/sec against a configured rate;
+* a queue-depth watermark (``max_queue``) bounds how much backlog the engine
+  tolerates before shedding toward the watermark;
+* an AIMD controller (policy ``adaptive``) sheds a growing fraction of each
+  batch while the SLO tracker reports p99 over target, and backs off when
+  latency recovers.
+
+Shedding NEVER silently drops: every shed row becomes a structured record on
+the DLQ lane, delivered and committed with the batch it was polled into —
+the same flush/commit accounting as classified output, so a commit can never
+advance past a lost shed record, and key-set accounting stays exact
+(tests/test_sched.py). Rows are only ever shed at admission time, before
+their batch dispatches; rows already in flight are never shed.
+
+With policy ``none`` nothing is shed — the token bucket then degrades to a
+pacing signal (``pending_pause``) the governor turns into poll backpressure.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional, Tuple
+
+SHED_POLICIES = ("none", "reject", "adaptive")
+
+# Shed-record reasons (DLQ ``reason`` field + health counters).
+SHED_QUEUE = "shed_queue_full"
+SHED_RATE = "shed_rate_limit"
+SHED_SLO = "shed_slo"
+SHED_DEADLINE = "shed_deadline"
+
+# With a latency target configured, rows already older than this fraction of
+# the target at admission are shed (CoDel's insight: a row that has burned
+# most of its deadline queueing will breach the SLO anyway — serving it
+# spends capacity that fresh rows could still convert into on-target
+# responses). Kept rows are young by construction, which is what actually
+# bounds produced-row p99 under sustained overload.
+SHED_AGE_FRACTION = 0.5
+
+
+class TokenBucket:
+    """Rows/sec token bucket with a burst ceiling.
+
+    ``grant(n)`` returns how many of n rows fit the current budget (shedding
+    policies divert the remainder). ``drain(n)`` admits all n unconditionally
+    and returns the pacing debt in seconds — the no-shed policy's
+    backpressure signal: polls slow down instead of rows dying."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None, *,
+                 clock=time.monotonic):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(self.rate, 1.0)
+        if self.burst <= 0:
+            raise ValueError(f"burst must be > 0, got {self.burst}")
+        self._clock = clock
+        self._tokens = self.burst
+        self._at = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._at) * self.rate)
+        self._at = now
+
+    def grant(self, n: int) -> int:
+        self._refill()
+        take = min(n, int(self._tokens))
+        self._tokens -= take
+        return take
+
+    def drain(self, n: int) -> float:
+        """Admit n rows, going into debt if needed; returns seconds of pacing
+        required to repay the debt (0 when the budget covered the batch)."""
+        self._refill()
+        self._tokens -= n
+        return max(0.0, -self._tokens) / self.rate
+
+    @property
+    def available(self) -> float:
+        self._refill()
+        return self._tokens
+
+
+class AdmissionController:
+    """Decides, per freshly polled batch, which rows score and which shed.
+
+    Single-driver by contract (the scheduler's ExclusiveRegion enforces it);
+    ``counters`` is read racily by health snapshots, which is fine for
+    monotonic ints. Shedding always takes the NEWEST rows (the tail of the
+    polled batch): the oldest rows have waited longest and are closest to
+    their deadline, so shedding them would waste the queue time already
+    spent — classic tail-dropping."""
+
+    def __init__(self, policy: str = "none", *,
+                 max_queue: Optional[int] = None,
+                 bucket: Optional[TokenBucket] = None,
+                 slo=None,
+                 shed_step: float = 0.05,
+                 shed_decay: float = 0.7,
+                 wall=time.time):
+        if policy not in SHED_POLICIES:
+            raise ValueError(
+                f"shed policy must be one of {SHED_POLICIES}, got {policy!r}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.policy = policy
+        self.max_queue = max_queue
+        self.bucket = bucket
+        self.slo = slo
+        self.shed_step = shed_step
+        self.shed_decay = shed_decay
+        self._wall = wall   # timestamps are broker wall-clock; ages must match
+        # Age ceiling for kept rows under the adaptive policy (see
+        # SHED_AGE_FRACTION); None = no age-based shedding.
+        self.max_age_sec = (
+            slo.target_p99_ms / 1e3 * SHED_AGE_FRACTION
+            if (policy == "adaptive" and slo is not None
+                and slo.target_p99_ms is not None) else None)
+        # AIMD shed fraction for the adaptive policy: additive-ish increase
+        # while p99 is over target, multiplicative decrease when it recovers.
+        self.shed_fraction = 0.0
+        self.counters = {SHED_QUEUE: 0, SHED_RATE: 0, SHED_SLO: 0,
+                         SHED_DEADLINE: 0}
+        self._pending_pause = 0.0
+        self.last_backlog: Optional[int] = None
+
+    @property
+    def sheds(self) -> bool:
+        return self.policy != "none"
+
+    def pending_pause(self) -> float:
+        """Seconds of poll pacing owed (policy ``none`` + token debt);
+        cleared on read — the governor applies it exactly once."""
+        pause, self._pending_pause = self._pending_pause, 0.0
+        return pause
+
+    def _update_shed_fraction(self) -> None:
+        over = self.slo.over_target() if self.slo is not None else None
+        if over is None:
+            return
+        if over:
+            self.shed_fraction = min(
+                1.0, self.shed_fraction * 1.5 + self.shed_step)
+        else:
+            f = self.shed_fraction * self.shed_decay
+            self.shed_fraction = f if f > 1e-3 else 0.0
+
+    def admit(self, msgs: List, backlog: Optional[int]
+              ) -> Tuple[List, List[Tuple[object, str]]]:
+        """Split a polled batch into (kept, [(msg, shed_reason)]).
+
+        ``backlog`` is the rows still queued BEHIND this batch at the broker
+        (None when the transport can't report it — watermark shedding is
+        then inert and only rate/SLO shedding applies)."""
+        self.last_backlog = backlog
+        if not msgs:
+            return msgs, []
+        if self.policy == "none":
+            if self.bucket is not None:
+                self._pending_pause = self.bucket.drain(len(msgs))
+            return msgs, []
+
+        keep = msgs
+        shed: List[Tuple[object, str]] = []
+
+        def cut(n_keep: int, reason: str) -> None:
+            nonlocal keep
+            if n_keep < len(keep):
+                shed.extend((m, reason) for m in keep[n_keep:])
+                self.counters[reason] += len(keep) - n_keep
+                keep = keep[:n_keep]
+
+        # Deadline shedding (adaptive policy with a target): rows that have
+        # already burned SHED_AGE_FRACTION of the latency target queueing
+        # cannot be served on-target — shed them, regardless of position,
+        # so every KEPT row is young enough to finish inside the SLO. Rows
+        # without a broker timestamp (0.0) are exempt (age unknowable).
+        if self.max_age_sec is not None:
+            cutoff = self._wall() - self.max_age_sec
+            stale = [m for m in keep if 0.0 < m.timestamp < cutoff]
+            if stale:
+                shed.extend((m, SHED_DEADLINE) for m in stale)
+                self.counters[SHED_DEADLINE] += len(stale)
+                keep = [m for m in keep
+                        if not 0.0 < m.timestamp < cutoff]
+
+        # Queue watermark: over the high-water mark, shed proportionally to
+        # the excess — a controller that drives backlog toward max_queue
+        # while keeping some useful work flowing (shedding everything would
+        # turn overload into an outage; shedding nothing lets the queue,
+        # and therefore every row's latency, grow without bound).
+        if (self.max_queue is not None and backlog is not None
+                and backlog > self.max_queue):
+            frac = (backlog - self.max_queue) / backlog
+            cut(len(keep) - int(math.ceil(frac * len(keep))), SHED_QUEUE)
+
+        if self.bucket is not None and keep:
+            cut(self.bucket.grant(len(keep)), SHED_RATE)
+
+        if self.policy == "adaptive" and keep:
+            self._update_shed_fraction()
+            if self.shed_fraction > 0.0:
+                cut(len(keep) - int(math.ceil(
+                    self.shed_fraction * len(keep))), SHED_SLO)
+
+        return keep, shed
+
+    def snapshot(self) -> dict:
+        return {
+            "policy": self.policy,
+            "max_queue": self.max_queue,
+            "rate_limit": self.bucket.rate if self.bucket is not None else None,
+            "tokens_available": (round(self.bucket.available, 1)
+                                 if self.bucket is not None else None),
+            "shed_fraction": round(self.shed_fraction, 4),
+            "shed": dict(self.counters),
+            "backlog": self.last_backlog,
+        }

@@ -1,0 +1,127 @@
+"""Deadline-driven dynamic batching over a padding-bucket ladder.
+
+Two problems with the engine's fixed ``poll_batch(batch_size, max_wait)``:
+
+* **Latency floor at low traffic.** A 3-row trickle either waits out
+  ``max_wait`` hoping for more rows or ships immediately and pays the full
+  ``batch_size`` padded device program either way (the pipeline pads every
+  chunk to one compiled shape).
+* **No accumulation window at medium traffic.** Rows arriving 1ms apart ship
+  as many tiny batches instead of one efficient one, because the poll drains
+  whatever is buffered and dispatches.
+
+:class:`DynamicBatcher` forms batches by size OR deadline: after the first
+row arrives, it keeps polling until the batch fills or ``deadline_ms``
+elapses, then ships whatever it has. The partial batch then pads not to
+``batch_size`` but to the smallest rung of a pre-warmed **bucket ladder**
+(:func:`default_ladder`, e.g. 64/256/1024) — XLA's static-shape world means
+every new shape is a fresh compile, so the ladder is the fixed menu of
+shapes, each compiled once at startup (:func:`prewarm_ladder`), and the hot
+path only ever snaps to one of them.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional, Sequence
+
+_MIN_BUCKET = 16
+
+_PREWARM_TEXTS = [
+    "urgent your account has been suspended verify your social security "
+    "number immediately to avoid arrest and pay the processing fee now",
+    "good morning thank you for calling the clinic i would like to confirm "
+    "my appointment for tomorrow afternoon please bring your insurance card",
+]
+
+
+def default_ladder(batch_size: int, factor: int = 4,
+                   levels: int = 3) -> tuple:
+    """The padding-bucket ladder for a given max batch size: ``levels``
+    geometric rungs ending at ``batch_size`` (1024 -> (64, 256, 1024)),
+    floored at a minimum rung so tiny configs don't explode into one-row
+    shapes. Ascending, deduplicated, always containing ``batch_size``."""
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    if factor < 2:
+        raise ValueError(f"factor must be >= 2, got {factor}")
+    rungs = {max(_MIN_BUCKET, batch_size // factor ** i)
+             for i in range(levels)}
+    rungs.add(batch_size)
+    return tuple(sorted(b for b in rungs if b <= batch_size))
+
+
+def bucket_for(n: int, ladder: Sequence[int]) -> int:
+    """Smallest rung >= n (the padding target for an n-row partial batch);
+    the top rung for anything larger."""
+    for b in ladder:
+        if n <= b:
+            return b
+    return ladder[-1]
+
+
+def prewarm_ladder(pipeline, buckets: Sequence[int],
+                   texts: Optional[Sequence[str]] = None) -> int:
+    """Compile every ladder shape off the hot path: configure the pipeline's
+    ladder, then run one representative batch of EXACTLY each rung's row
+    count through both scoring paths (plain predict + raw-JSON when the
+    native featurizer supports it). Returns the number of rungs warmed.
+
+    Must run with the ladder already applied — a 256-row dummy batch pads to
+    the 256 rung, not to ``batch_size``, so warming each rung requires a
+    batch of that exact size (the pre-ladder prewarm's single capped dummy
+    batch no longer covers the shapes the hot path will use)."""
+    pool = list(texts or _PREWARM_TEXTS)
+    pipeline.pad_ladder = tuple(sorted(set(buckets)))
+    warmed = 0
+    for b in pipeline.pad_ladder:
+        rows = [pool[i % len(pool)] for i in range(b)]
+        pipeline.predict(rows)
+        fast = pipeline.predict_json_async(
+            [json.dumps({"text": t}).encode() for t in rows])
+        if fast is not None:
+            fast[0].resolve()
+        warmed += 1
+    return warmed
+
+
+class DynamicBatcher:
+    """Form micro-batches by size or deadline from a Consumer.
+
+    ``collect`` is the engine's poll replacement: wait up to ``first_wait``
+    for the first row (the engine's existing idle cadence), then accumulate
+    until the batch fills or ``deadline_ms`` has elapsed since the first
+    poll returned rows. ``deadline_ms=None`` degrades to a single plain
+    poll — the scheduler without a deadline batches exactly like the bare
+    engine. Single-driver by contract (the owning scheduler's region
+    enforces it)."""
+
+    def __init__(self, deadline_ms: Optional[float] = None, *,
+                 poll_slice: float = 0.005, clock=time.monotonic):
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if poll_slice <= 0:
+            raise ValueError(f"poll_slice must be > 0, got {poll_slice}")
+        self.deadline_ms = deadline_ms
+        self.poll_slice = poll_slice
+        self._clock = clock
+
+    def collect(self, consumer, budget: int, first_wait: float) -> List:
+        msgs = consumer.poll_batch(budget, first_wait)
+        if not msgs or self.deadline_ms is None or len(msgs) >= budget:
+            return msgs
+        # The deadline anchors at the first non-empty poll's return — the
+        # closest host-side proxy for the first row's arrival. Remaining
+        # capacity is topped up in short poll slices so a burst landing
+        # mid-window ships as one batch instead of many.
+        deadline = self._clock() + self.deadline_ms / 1e3
+        while len(msgs) < budget:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                break
+            more = consumer.poll_batch(budget - len(msgs),
+                                       min(remaining, self.poll_slice))
+            if more:
+                msgs.extend(more)
+        return msgs

@@ -1,0 +1,193 @@
+"""The scheduler facade the streaming engine drives.
+
+:class:`SchedulerConfig` is the validated knob set (the serve CLI's
+``--batch-deadline-ms/--max-queue/--shed-policy/--target-p99-ms/--max-rate``
+map straight onto it); :class:`AdaptiveScheduler` wires the four parts —
+dynamic batcher, admission controller, backpressure governor, windowed SLO
+tracker — behind the three calls the engine makes per batch:
+
+* ``collect(consumer, budget, first_wait)`` — governor-paced, deadline-driven
+  poll (replaces the bare ``poll_batch``);
+* ``admit(msgs, backlog)`` — split the fresh batch into kept rows and
+  explicit shed records (empty under policy ``none``);
+* ``observe_batch(n_rows, batch_sec, row_latencies)`` — feed the EWMAs and
+  the SLO window after delivery.
+
+One scheduler instance serves ONE engine: collect/admit share mutable batch
+state and are guarded by an :class:`ExclusiveRegion` (the same single-driver
+contract the engine itself checks), while ``snapshot()`` is safe from any
+thread (health pollers read it live).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from fraud_detection_tpu.sched.admission import (SHED_POLICIES,
+                                                 AdmissionController,
+                                                 TokenBucket)
+from fraud_detection_tpu.sched.batcher import (DynamicBatcher, bucket_for,
+                                               default_ladder, prewarm_ladder)
+from fraud_detection_tpu.sched.governor import BackpressureGovernor
+from fraud_detection_tpu.sched.sketch import SloTracker
+from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Validated scheduler knobs (docs/scheduling.md has the tuning guide).
+
+    All-defaults means "scheduler attached but maximally transparent":
+    no deadline (single poll), no shedding, no rate limit, a generous
+    batch-wall bound. Anything the operator doesn't set stays out of the
+    control loop."""
+
+    batch_deadline_ms: Optional[float] = None
+    max_queue: Optional[int] = None
+    shed_policy: str = "none"
+    target_p99_ms: Optional[float] = None
+    max_rate: Optional[float] = None      # admitted rows/sec; None = off
+    burst: Optional[float] = None         # token burst; None = 1s of rate
+    window_sec: float = 10.0              # SLO tracker rotation window
+    max_batch_sec: Optional[float] = None  # None = derived (see resolve)
+    buckets: Optional[Tuple[int, ...]] = None  # None = default_ladder
+
+    def __post_init__(self):
+        if self.shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"shed_policy must be one of {SHED_POLICIES}, "
+                f"got {self.shed_policy!r}")
+        if self.batch_deadline_ms is not None and self.batch_deadline_ms <= 0:
+            raise ValueError(
+                f"batch_deadline_ms must be > 0, got {self.batch_deadline_ms}")
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.target_p99_ms is not None and self.target_p99_ms <= 0:
+            raise ValueError(
+                f"target_p99_ms must be > 0, got {self.target_p99_ms}")
+        if self.max_rate is not None and self.max_rate <= 0:
+            raise ValueError(f"max_rate must be > 0, got {self.max_rate}")
+        if self.window_sec <= 0:
+            raise ValueError(f"window_sec must be > 0, got {self.window_sec}")
+        if self.shed_policy == "adaptive" and self.target_p99_ms is None:
+            raise ValueError(
+                "shed_policy='adaptive' sheds on SLO pressure and needs "
+                "target_p99_ms")
+        if self.shed_policy == "reject" and (self.max_queue is None
+                                             and self.max_rate is None):
+            raise ValueError(
+                "shed_policy='reject' needs a limit to enforce: set "
+                "max_queue and/or max_rate")
+
+    def resolved_max_batch_sec(self) -> float:
+        """The governor's batch-wall bound. Explicit value wins; with a
+        latency target, half the target (queue wait needs the other half);
+        otherwise a 2s backstop that exists to keep poll cadence inside any
+        sane broker session timeout."""
+        if self.max_batch_sec is not None:
+            return self.max_batch_sec
+        if self.target_p99_ms is not None:
+            return self.target_p99_ms / 2e3
+        return 2.0
+
+
+class AdaptiveScheduler:
+    """One engine's consume->score scheduler (see module docstring)."""
+
+    def __init__(self, config: SchedulerConfig, batch_size: int, *,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.config = config
+        self.buckets: Tuple[int, ...] = tuple(
+            config.buckets if config.buckets
+            else default_ladder(batch_size))
+        self.slo = SloTracker(target_p99_ms=config.target_p99_ms,
+                              window_sec=config.window_sec, clock=clock)
+        self.batcher = DynamicBatcher(config.batch_deadline_ms, clock=clock)
+        bucket = (TokenBucket(config.max_rate, config.burst, clock=clock)
+                  if config.max_rate is not None else None)
+        self.admission = AdmissionController(
+            config.shed_policy, max_queue=config.max_queue,
+            bucket=bucket, slo=self.slo)
+        self.governor = BackpressureGovernor(
+            config.resolved_max_batch_sec(),
+            min_budget=self.buckets[0])
+        self._sleep = sleep
+        # collect/admit mutate shared control state (token bucket, EWMAs,
+        # AIMD fraction) and are single-driver by the same contract as the
+        # engine loop that calls them; snapshot() deliberately does NOT
+        # enter the region (health pollers read from other threads).
+        self._region = ExclusiveRegion("AdaptiveScheduler.drive")
+
+    # ------------------------------------------------------------------
+    # engine-facing surface (engine thread only)
+    # ------------------------------------------------------------------
+
+    @property
+    def sheds(self) -> bool:
+        """True when the policy can divert rows (the engine then requires a
+        DLQ topic for the shed records to land on)."""
+        return self.admission.sheds
+
+    def collect(self, consumer, budget: int, first_wait: float) -> List:
+        """Governor-paced, deadline-driven poll of up to ``budget`` rows."""
+        with self._region:
+            budget, pause = self.governor.advise(
+                budget, self.admission.pending_pause())
+            if pause > 0:
+                self._sleep(pause)
+            return self.batcher.collect(consumer, budget, first_wait)
+
+    def backlog_of(self, consumer) -> Optional[int]:
+        """Rows still queued behind the current poll position, when the
+        transport can report it (InProcessConsumer.backlog; None otherwise —
+        watermark shedding is then inert)."""
+        backlog = getattr(consumer, "backlog", None)
+        if backlog is None:
+            return None
+        try:
+            return backlog()
+        except Exception:  # noqa: BLE001 — lag reporting must never kill serving
+            return None
+
+    def admit(self, msgs: List, backlog: Optional[int]
+              ) -> Tuple[List, List[Tuple[object, str]]]:
+        with self._region:
+            return self.admission.admit(msgs, backlog)
+
+    def observe_batch(self, n_rows: int, batch_sec: float,
+                      row_latencies: Optional[Sequence[float]] = None) -> None:
+        with self._region:
+            self.governor.observe(n_rows, batch_sec)
+            if row_latencies is not None and len(row_latencies):
+                self.slo.record(row_latencies)
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_for(n, self.buckets)
+
+    def prewarm(self, pipeline,
+                texts: Optional[Sequence[str]] = None) -> int:
+        """Apply the ladder to the pipeline and compile every rung off the
+        hot path (sched/batcher.py prewarm_ladder). HotSwapPipelines route
+        through their own ladder-aware prewarm so future swap candidates
+        inherit the same shapes (registry/hotswap.py)."""
+        configure = getattr(pipeline, "configure_ladder", None)
+        if configure is not None:
+            configure(self.buckets, prewarm=True)
+            return len(self.buckets)
+        return prewarm_ladder(pipeline, self.buckets, texts)
+
+    # ------------------------------------------------------------------
+    # observability (any thread)
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``sched`` block of ``StreamingClassifier.health()``."""
+        return {
+            "batch_deadline_ms": self.config.batch_deadline_ms,
+            "buckets": list(self.buckets),
+            "slo": self.slo.snapshot(),
+            "admission": self.admission.snapshot(),
+            "governor": self.governor.snapshot(),
+        }

@@ -499,6 +499,21 @@ class InProcessConsumer:
     def committed_offsets(self) -> Dict[tuple, int]:
         return dict(self._committed)
 
+    def backlog(self) -> int:
+        """Rows appended to this member's owned partitions but not yet
+        polled — the queue-depth signal the scheduler's admission watermark
+        reads (sched/admission.py). Engine-thread only (same single-driver
+        contract as poll/commit; the region enforces it)."""
+        with self._region, self.broker._lock:
+            self._refresh_locked()
+            total = 0
+            for topic, p in self._owned:
+                parts = self.broker._topics.get(topic)
+                if parts is not None:
+                    total += max(0, len(parts[p])
+                                 - self._position.get((topic, p), 0))
+            return total
+
     def seek_to_committed(self) -> None:
         """Simulate a restart: resume every owned partition from the GROUP's
         durable offsets. (Local ``_committed`` can never exceed these:

@@ -14,6 +14,12 @@ offsets, Q2: its restart semantics reprocessed the topic from earliest).
 Malformed messages (bad JSON / missing text field) are counted and routed to
 the output with an error marker instead of killing the loop (the reference
 raised and died — app_ui.py:200-201).
+
+The consume->score handoff can be delegated to an adaptive scheduler
+(``scheduler=`` / sched/scheduler.py): deadline-driven dynamic batching
+over a pre-warmed padding-bucket ladder, admission control with explicit
+load shedding onto the DLQ lane, governor-paced polls, and per-row
+enqueue->produce SLO tracking (docs/scheduling.md).
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ import numpy as np
 
 from fraud_detection_tpu.explain.prompts import label_name
 from fraud_detection_tpu.models.pipeline import ServingPipeline
+from fraud_detection_tpu.sched.sketch import LatencySketch
 from fraud_detection_tpu.stream.broker import (CommitFailedError, Consumer,
                                                Message, Producer)
 from fraud_detection_tpu.utils import get_logger
@@ -115,6 +122,8 @@ class StreamStats:
     processed: int = 0
     malformed: int = 0
     dead_lettered: int = 0    # rows routed to the DLQ topic (subset of processed)
+    shed: int = 0             # rows shed by admission control (subset of
+                              # dead_lettered: every shed row leaves a record)
     batches: int = 0
     commits_skipped: int = 0  # producer didn't drain; offsets left uncommitted
     rebalanced_commits: int = 0  # commit fenced by a group rebalance (routine)
@@ -126,6 +135,11 @@ class StreamStats:
     # replacement keeps a uniform sample (reservoir) so a week-long run
     # doesn't grow memory while p50/p99 stay honest.
     latencies: List[float] = field(default_factory=list)
+    # Per-ROW enqueue->produce latency (includes queue wait — the number a
+    # caller actually experiences under load, which per-batch device latency
+    # undercounts). Bounded-memory streaming sketch, mergeable across
+    # supervised incarnations (sched/sketch.py).
+    row_sketch: LatencySketch = field(default_factory=LatencySketch)
     _latency_cap: int = 4096
     _seen: int = 0
 
@@ -159,11 +173,18 @@ class StreamStats:
     def mean_batch_latency(self) -> float:
         return self.batch_latency_sum / self.batches if self.batches else 0.0
 
+    def row_latency_ms(self, q: float) -> Optional[float]:
+        """Per-row enqueue->produce latency quantile in ms (None until the
+        first delivered batch)."""
+        sec = self.row_sketch.quantile(q)
+        return None if sec is None else round(sec * 1e3, 3)
+
     def as_dict(self) -> dict:
         return {
             "processed": self.processed,
             "malformed": self.malformed,
             "dead_lettered": self.dead_lettered,
+            "shed": self.shed,
             "batches": self.batches,
             "commits_skipped": self.commits_skipped,
             "rebalanced_commits": self.rebalanced_commits,
@@ -174,6 +195,8 @@ class StreamStats:
             "p50_batch_latency_sec": round(self.latency_percentile(50), 5),
             "p99_batch_latency_sec": round(self.latency_percentile(99), 5),
             "max_batch_latency_sec": round(self.batch_latency_max, 5),
+            "p50_row_latency_ms": self.row_latency_ms(0.50),
+            "p99_row_latency_ms": self.row_latency_ms(0.99),
         }
 
 
@@ -210,6 +233,7 @@ class StreamingClassifier:
         dlq_attempts: Optional[dict] = None,
         breaker: Optional[object] = None,
         shadow: Optional[object] = None,
+        scheduler: Optional[object] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if pipeline_depth < 1:
@@ -283,6 +307,20 @@ class StreamingClassifier:
         # ``snapshot()``) — health() surfaces its state; the engine never
         # calls it directly (the explain hook / annotation lane own calls).
         self._breaker = breaker
+        # Optional sched/scheduler.AdaptiveScheduler: owns the consume->
+        # score handoff — deadline-driven dynamic batching over the padding
+        # ladder, admission control (explicit shedding to the DLQ lane),
+        # governor-paced polls, and the windowed SLO tracker health()
+        # surfaces. One scheduler per engine (single-driver contract). A
+        # shedding policy REQUIRES a DLQ topic: shed rows are structured
+        # records delivered and committed with their batch, never silent
+        # drops (docs/scheduling.md).
+        if (scheduler is not None and getattr(scheduler, "sheds", False)
+                and dlq_topic is None):
+            raise ValueError(
+                "scheduler sheds (shed_policy != 'none') but no dlq_topic is "
+                "set — shed rows must land as explicit DLQ records")
+        self._sched = scheduler
         # Optional registry/shadow.ShadowScorer: each scored batch's inputs
         # + primary results are offered to the candidate's async scorer
         # (non-blocking bounded queue — registry/shadow.py). The hot loop
@@ -345,8 +383,28 @@ class StreamingClassifier:
 
         dead: Optional[List[tuple]] = None
         dead_reasons: Optional[dict] = None
+        shed_n = 0
+        if self._sched is not None and msgs:
+            # Admission control runs FIRST, on freshly polled rows only —
+            # rows already in flight are never shed, and a shed row's record
+            # rides THIS batch's delivery/commit (exactly like poison/
+            # malformed DLQ records), so key-set accounting stays exact.
+            keep, shed_rows = self._sched.admit(
+                msgs, self._sched.backlog_of(self.consumer))
+            if shed_rows:
+                dead, dead_reasons = [], {}
+                for m, reason in shed_rows:
+                    dead.append((_dlq_record(
+                        m, reason,
+                        "shed by admission control (docs/scheduling.md); "
+                        "replay from the DLQ record's source coordinates"),
+                        m.key))
+                    dead_reasons[reason] = dead_reasons.get(reason, 0) + 1
+                shed_n = len(shed_rows)
+                msgs = keep
         if self._dlq_attempts is not None:
-            dead, dead_reasons = [], {}
+            if dead is None:
+                dead, dead_reasons = [], {}
             msgs = self._screen_poison(msgs, dead, dead_reasons)
 
         inflight = None
@@ -362,10 +420,15 @@ class StreamingClassifier:
         if dead:
             inflight.dead = dead
             inflight.dead_reasons = dead_reasons
-            # Screened rows are OUTSIDE inflight.msgs — message accounting
-            # (processed, budget) must add them back; rows diverted later in
-            # _finish stay inside msgs and must not be added twice.
+            # Screened/shed rows are OUTSIDE inflight.msgs — message
+            # accounting (processed, budget) must add them back; rows
+            # diverted later in _finish stay inside msgs and must not be
+            # added twice.
             inflight.dead_screened = len(dead)
+            inflight.shed_n = shed_n
+        # Wall-clock receipt stamp: the enqueue->produce fallback origin for
+        # transports whose messages carry no producer timestamp.
+        inflight.recv_wall = time.time()
         return inflight
 
     def _screen_poison(self, msgs: List[Message], dead: List[tuple],
@@ -657,6 +720,11 @@ class StreamingClassifier:
             "processed": self.stats.processed,
             "malformed": self.stats.malformed,
             "dead_lettered": self.stats.dead_lettered,
+            "shed": self.stats.shed,
+            "row_latency_ms": {"p50": self.stats.row_latency_ms(0.50),
+                               "p99": self.stats.row_latency_ms(0.99)},
+            "sched": (self._sched.snapshot()
+                      if self._sched is not None else None),
             "dlq": (None if self.dlq_topic is None else {
                 "topic": self.dlq_topic,
                 "routed": dict(self._dlq_counts),
@@ -801,8 +869,22 @@ class StreamingClassifier:
         finish_dt = time.perf_counter() - t1
         dt = inflight.dispatch_time + finish_dt
         self.stats.processed += len(msgs) + inflight.dead_screened
+        self.stats.shed += inflight.shed_n
         self.stats.batches += 1
         self.stats.record_latency(dt)
+        if msgs:
+            # Per-row enqueue->produce latency (the number a caller sees,
+            # queue wait included): producer timestamp when the transport
+            # carries one, else this batch's poll-receipt stamp. One
+            # vectorized pass + one sketch insert per batch.
+            now_wall = time.time()
+            ts = np.fromiter((m.timestamp for m in msgs), np.float64,
+                             len(msgs))
+            lats = np.where(ts > 0.0, now_wall - ts,
+                            now_wall - inflight.recv_wall)
+            self.stats.row_sketch.add_many(lats)
+            if self._sched is not None:
+                self._sched.observe_batch(len(msgs), dt, lats)
         self._last_batch_at = self._clock()
         if self.tracer is not None:
             self.tracer.record("dispatch", inflight.dispatch_time)
@@ -862,7 +944,13 @@ class StreamingClassifier:
                         self._inflight_depth = len(in_flight)
                         continue
                     break
-                msgs = self.consumer.poll_batch(budget, self.max_wait)
+                if self._sched is not None:
+                    # Scheduler-owned handoff: governor-paced, deadline-
+                    # driven accumulation (sched/scheduler.py collect).
+                    msgs = self._sched.collect(self.consumer, budget,
+                                               self.max_wait)
+                else:
+                    msgs = self.consumer.poll_batch(budget, self.max_wait)
                 if not msgs:
                     if in_flight:
                         # Drain the tail rather than idling behind it.
@@ -921,7 +1009,9 @@ class _InFlight:
     # the batch. None = nothing diverted (the common case costs nothing).
     dead: Optional[List[tuple]] = None
     dead_reasons: Optional[dict] = None
-    dead_screened: int = 0      # dead rows NOT in msgs (poison screening)
+    dead_screened: int = 0      # dead rows NOT in msgs (poison screen + shed)
+    shed_n: int = 0             # of dead_screened, rows shed by admission
+    recv_wall: float = 0.0      # wall-clock poll receipt (latency fallback)
 
 
 def run_supervised(make_engine: Callable[[], StreamingClassifier], *,
@@ -1029,6 +1119,7 @@ def _merge_stats(total: StreamStats, part: StreamStats) -> None:
     total.processed += part.processed
     total.malformed += part.malformed
     total.dead_lettered += part.dead_lettered
+    total.shed += part.shed
     total.batches += part.batches
     total.commits_skipped += part.commits_skipped
     total.rebalanced_commits += part.rebalanced_commits
@@ -1040,3 +1131,5 @@ def _merge_stats(total: StreamStats, part: StreamStats) -> None:
     total.batch_latency_max = max(total.batch_latency_max, part.batch_latency_max)
     for dt in part.latencies:
         total._reservoir_add(dt)
+    # The row-latency sketch merges losslessly (bucket counts add).
+    total.row_sketch.merge(part.row_sketch)

@@ -107,6 +107,18 @@ def _security_config(cfg: KafkaConfig) -> dict:
     return {}
 
 
+def _msg_timestamp(m) -> float:
+    """Kafka record timestamp in epoch SECONDS (broker.Message units), or
+    0.0 when unavailable — the engine's per-row enqueue->produce latency
+    accounting falls back to its poll-receipt stamp for 0 timestamps."""
+    try:
+        ts_type, ts_ms = m.timestamp()
+    except Exception:  # noqa: BLE001 — latency accounting is best-effort
+        return 0.0
+    # type 0 = TIMESTAMP_NOT_AVAILABLE; 1/2 = create/log-append time.
+    return ts_ms / 1e3 if ts_type and ts_ms and ts_ms > 0 else 0.0
+
+
 class KafkaConsumer:
     """confluent_kafka consumer adapted to the engine's poll_batch protocol."""
 
@@ -131,7 +143,8 @@ class KafkaConsumer:
             _translate_poll_error(msg.error())
             return None
         return Message(topic=msg.topic(), value=msg.value(), key=msg.key(),
-                       partition=msg.partition(), offset=msg.offset())
+                       partition=msg.partition(), offset=msg.offset(),
+                       timestamp=_msg_timestamp(msg))
 
     def poll_batch(self, max_messages: int, timeout: float) -> List[Message]:
         msgs = self._consumer.consume(num_messages=max_messages, timeout=timeout)
@@ -143,7 +156,8 @@ class KafkaConsumer:
                 _translate_poll_error(m.error())
                 continue
             out.append(Message(topic=m.topic(), value=m.value(), key=m.key(),
-                               partition=m.partition(), offset=m.offset()))
+                               partition=m.partition(), offset=m.offset(),
+                               timestamp=_msg_timestamp(m)))
         return out
 
     def commit(self) -> None:

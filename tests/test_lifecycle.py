@@ -373,6 +373,9 @@ ENGINE_HEALTH_SCHEMA = {
     "processed": (int,),
     "malformed": (int,),
     "dead_lettered": (int,),
+    "shed": (int,),
+    "row_latency_ms": (dict,),
+    "sched": (type(None), dict),
     "dlq": (type(None), dict),
     "annotations": (type(None), dict),
     "breaker": (type(None), dict),
