@@ -21,17 +21,33 @@ north-star sentence). A Pallas-vs-XLA histogram parity check runs on the real
 backend first so the measured path is also a verified-correct one. Disable
 with BENCH_TRAIN=0.
 
-Prints exactly one JSON line; the training numbers ride along as a
-"training" object inside it:
+UN-KILLABLE HARNESS CONTRACT (round-6 verdict item 1 — a timeout must never
+again erase a number captured in the first two minutes): the run is a
+sequence of independently budgeted SECTIONS (streaming headline first, then
+featurize, tree families, load sweep, training, LLM), each of which — the
+moment it finishes — merges its result into the one artifact dict, flushes
+it to an on-disk partial file (``BENCH_PARTIAL`` env / ``--partial-file``,
+default ``bench_partial.json``; atomic replace), and RE-PRINTS the merged
+line. So stdout carries one complete JSON line per completed section and
+the LAST parseable line is always the full artifact so far; the headline
+appears as soon as the streaming section lands. ``BENCH_BUDGET_S`` (env or
+``--budget-s``) is a wall-clock budget: sections that would start past it
+record ``{"skipped": "budget"}``, and a SIGALRM cuts a section that
+overruns its share mid-flight (whatever it already measured is kept).
+SIGTERM at any point flushes + re-prints and exits cleanly.
+
+Shape of the final line (training/llm/... ride along as objects):
   {"metric": ..., "value": N, "unit": "dialogues/sec", "vs_baseline": N,
-   "training": {...}}
+   "featurize_encode_rows_per_sec": N, "training": {...}, ...}
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -47,6 +63,149 @@ from fraud_detection_tpu.utils.jax_cache import enable_persistent_compile_cache
 enable_persistent_compile_cache()
 
 NORTH_STAR = 10_000.0  # dialogues/sec, BASELINE.json
+
+
+# ---------------------------------------------------------------------------
+# Incremental bench harness (tentpole a): sectioned, budgeted, un-killable.
+# ---------------------------------------------------------------------------
+
+
+class BudgetExceeded(Exception):
+    """SIGALRM verdict: the section overran its wall-clock share."""
+
+
+class BenchInterrupted(Exception):
+    """SIGTERM verdict: flush whatever is measured and exit cleanly."""
+
+
+def _raise_budget(signum, frame):
+    raise BudgetExceeded()
+
+
+def _raise_interrupted(signum, frame):
+    raise BenchInterrupted()
+
+
+def _can_use_signals() -> bool:
+    return (hasattr(signal, "setitimer")
+            and threading.current_thread() is threading.main_thread())
+
+
+def install_sigterm_handler():
+    """Route SIGTERM (the driver's `timeout`, operator kills) through
+    BenchInterrupted so main() flushes + re-prints instead of dying mid-
+    write. Returns the previous handler (tests restore it)."""
+    if not _can_use_signals():
+        return None
+    return signal.signal(signal.SIGTERM, _raise_interrupted)
+
+
+class BenchHarness:
+    """One artifact dict, grown section by section, never lost.
+
+    ``section(name, fn)`` runs ``fn(scratch)`` under this section's alarm
+    window, merges the result (top-level fields or a named object), flushes
+    the merged artifact to the partial file (atomic replace) and re-prints
+    it as one JSON line — so both the disk artifact and the last stdout
+    line are complete after EVERY section, whatever kills the process next.
+    ``scratch`` is kept even when the section is cut mid-flight: sections
+    deposit partial measurements there as they land (e.g. the streaming
+    best-of updates it per run).
+    """
+
+    def __init__(self, partial_path=None, budget_s=None, *,
+                 clock=time.monotonic, out=None):
+        self.line: dict = {}
+        self.partial_path = partial_path
+        self.budget_s = budget_s
+        self._clock = clock
+        self._t0 = clock()
+        self._out = out if out is not None else sys.stdout
+
+    def elapsed(self) -> float:
+        return self._clock() - self._t0
+
+    def remaining(self):
+        """Seconds left in the budget; None when unbudgeted."""
+        if self.budget_s is None:
+            return None
+        return max(0.0, self.budget_s - self.elapsed())
+
+    def flush(self) -> None:
+        """Write the merged artifact to the partial file (atomic replace;
+        a torn read is impossible, a failed write never kills the bench)."""
+        if not self.partial_path:
+            return
+        tmp = f"{self.partial_path}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self.line, f)
+            os.replace(tmp, self.partial_path)
+        except OSError:
+            pass
+
+    def emit(self) -> None:
+        print(json.dumps(self.line), file=self._out, flush=True)
+
+    def _store(self, name, result, scratch, top_level) -> None:
+        if top_level and isinstance(result, dict) and "skipped" not in result \
+                and "error" not in result:
+            self.line.update(result)
+        else:
+            # Cut/failed sections keep whatever scratch already measured:
+            # top-level sections merge it at the root (a budget-cut headline
+            # still headlines), named sections fold it into their object.
+            if isinstance(result, dict) and scratch and not top_level:
+                result = {**scratch, **result}
+            elif top_level and scratch:
+                self.line.update(scratch)
+            self.line[name] = result
+
+    def section(self, name, fn, *, fraction=1.0, min_s=2.0,
+                top_level=False):
+        """Run one section: ``fn(scratch) -> dict``.
+
+        ``fraction`` is this section's share of the REMAINING budget (its
+        SIGALRM window, floored at ``min_s``); a section that would start
+        with less than ``min_s`` left records ``{"skipped": "budget"}``
+        without running. Exceptions degrade to an ``error`` field — only
+        BenchInterrupted (SIGTERM) propagates, after flushing."""
+        rem = self.remaining()
+        scratch: dict = {}
+        t0 = self._clock()
+        if rem is not None and rem < min_s:
+            result = {"skipped": "budget"}
+        else:
+            armed = rem is not None and _can_use_signals()
+            prev = None
+            try:
+                if armed:
+                    window = min(rem, max(min_s, rem * fraction))
+                    prev = signal.signal(signal.SIGALRM, _raise_budget)
+                    signal.setitimer(signal.ITIMER_REAL, window)
+                result = fn(scratch)
+            except BudgetExceeded:
+                result = {"skipped": "budget",
+                          "elapsed_s": round(self._clock() - t0, 1)}
+            except BenchInterrupted:
+                self._store(name, {"skipped": "sigterm"}, scratch, top_level)
+                self.flush()
+                self.emit()
+                raise
+            except Exception as e:  # noqa: BLE001 — a failed leg must
+                # degrade to an error field, never erase earlier sections
+                result = {"error": repr(e)[:300]}
+            finally:
+                if armed:
+                    signal.setitimer(signal.ITIMER_REAL, 0.0)
+                    if prev is not None:
+                        signal.signal(signal.SIGALRM, prev)
+        self._store(name, result, scratch, top_level)
+        self.line.setdefault("section_s", {})[name] = round(
+            self._clock() - t0, 1)
+        self.flush()
+        self.emit()
+        return result
 
 # TPU v5e (v5litepod) public per-chip peaks — the denominators for every
 # mfu/roofline field in the bench line. Off-TPU the fields are omitted
@@ -373,6 +532,55 @@ def _attribution(tracer) -> dict:
     }
 
 
+def featurize_bench(texts) -> dict:
+    """Host featurization throughput: the DEFAULT encode path (native
+    batch-shard entry points under a thread pool when the toolchain is
+    present — featurize/parallel.py) against the serial pure-Python
+    reference loop, on the same rows. ``featurize_encode_rows_per_sec`` is
+    the committed evidence for the parallel-featurize tentpole; the paths
+    are byte-identical by property test, so this is a pure rate comparison.
+    """
+    from fraud_detection_tpu.featurize.parallel import resolve_workers
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+
+    n = int(os.environ.get("BENCH_FEAT_ROWS", "4096"))
+    reps = int(os.environ.get("BENCH_FEAT_REPS", "3"))
+    batch = [texts[i % len(texts)] for i in range(n)]
+
+    def best_rate(feat, k: int) -> float:
+        feat.encode(batch[: min(n, 256)],
+                    batch_size=min(n, 256))     # warm: lib build, pool spawn
+        best = 0.0
+        for _ in range(max(1, k)):
+            t0 = time.perf_counter()
+            feat.encode(batch, batch_size=n)
+            best = max(best, n / (time.perf_counter() - t0))
+        return best
+
+    serial_py = HashingTfIdfFeaturizer(num_features=10000, parallel_workers=1)
+    serial_py._native_tried, serial_py._native = True, None  # pure-Python ref
+    par = HashingTfIdfFeaturizer(num_features=10000)         # default path
+    workers = resolve_workers(None)
+    serial_rate = best_rate(serial_py, min(reps, 2))
+    par_rate = best_rate(par, reps)
+    native = par._native_featurizer() is not None
+    path = ("native-sharded" if native and workers > 1 else
+            "native" if native else
+            "python-threads" if workers > 1 else "python")
+    return {
+        "featurize_encode_rows_per_sec": round(par_rate, 1),
+        "featurize": {
+            "rows": n,
+            "workers": workers,
+            "path": path,
+            "parallel_rows_per_sec": round(par_rate, 1),
+            "serial_python_rows_per_sec": round(serial_rate, 1),
+            "speedup_vs_serial_python": (round(par_rate / serial_rate, 2)
+                                         if serial_rate > 0 else None),
+        },
+    }
+
+
 def tree_streaming_bench(texts, batch_size: int, depth: int,
                          n_msgs: int = 10_000, lr_pipe=None) -> dict:
     """Streaming throughput for the tree families through the raw-JSON path
@@ -419,7 +627,7 @@ def tree_streaming_bench(texts, batch_size: int, depth: int,
 
 def _paced_point(pipe, texts, rate: float, duration_s: float,
                  batch_size: int, depth: int,
-                 target_p99_ms) -> dict:
+                 target_p99_ms, buckets=None) -> dict:
     """One offered-load point: a feeder thread produces at ``rate`` rows/sec
     (paced in ~5ms bursts) while the engine — scheduler attached — drains.
     Returns offered vs delivered rate, per-row enqueue->produce latency
@@ -450,6 +658,10 @@ def _paced_point(pipe, texts, rate: float, duration_s: float,
         batch_deadline_ms=10.0,
         shed_policy="adaptive" if target_p99_ms else "none",
         target_p99_ms=target_p99_ms,
+        # The measured cost-aware ladder from the sweep prewarm — keeps the
+        # scheduler's rung set (governor floor, snapshot) aligned with the
+        # shapes the pipeline actually compiled.
+        buckets=tuple(buckets) if buckets else None,
         # Watermark sized to the latency target at this offered rate (rows
         # the queue may hold before shedding); no target -> no shedding.
         max_queue=(max(batch_size, int(rate * target_p99_ms / 1e3))
@@ -479,21 +691,36 @@ def _paced_point(pipe, texts, rate: float, duration_s: float,
 def load_sweep_bench(pipe, texts, batch_size: int, depth: int,
                      target_p99_ms=None) -> dict:
     """Offered-load sweep: latency-vs-throughput curve for the scheduled
-    serving path. Estimates capacity with one unpaced drain, then sweeps
-    offered load across it (under to 3x over); reports the saturation knee
-    (highest offered load the engine still tracks within 10%) and — when a
-    target is set — the highest offered load whose per-row p99 met it,
-    with the adaptive shed policy keeping latency bounded past saturation.
-    BENCH_SWEEP_SEC sizes each point's window; BENCH_LOAD_SWEEP=0 skips
-    the leg entirely."""
-    from fraud_detection_tpu.sched import default_ladder, prewarm_ladder
+    serving path. Prewarm measures every candidate rung's device cost
+    (compile excluded) and derives the COST-AWARE ladder the sweep then
+    serves on (sched/batcher.py cost_aware_ladder — the measured geometry
+    replaces the fixed /16 /4 /1 menu); the per-rung cost table is part of
+    the committed artifact. Estimates capacity with one unpaced drain, then
+    sweeps offered load across it (under to 3x over); reports the
+    saturation knee (highest offered load the engine still tracks within
+    10%) and — when a target is set — the highest offered load whose
+    per-row p99 met it, with the adaptive shed policy keeping latency
+    bounded past saturation. BENCH_SWEEP_SEC sizes each point's window;
+    BENCH_LOAD_SWEEP=0 skips the leg entirely."""
+    from fraud_detection_tpu.sched import (cost_aware_ladder,
+                                           ladder_candidates,
+                                           measure_rung_costs)
 
     duration_s = float(os.environ.get("BENCH_SWEEP_SEC", "2.0"))
-    # Ladder shapes compile here, off the timed points — warmed with the
-    # SWEEP corpus so token-width padding buckets match too; the bare-
-    # pipeline padding contract is restored afterward so later legs are
-    # unaffected.
-    prewarm_ladder(pipe, default_ladder(batch_size), texts=texts)
+    # Candidate rungs compile + get timed here, off the timed points —
+    # measured with the SWEEP corpus so token-width padding buckets match
+    # too; the bare-pipeline padding contract is restored afterward so
+    # later legs are unaffected.
+    candidates = ladder_candidates(batch_size)
+    costs = measure_rung_costs(pipe, candidates, texts=texts)
+    buckets = cost_aware_ladder(costs, batch_size)
+    pipe.pad_ladder = buckets
+    ladder = {
+        "candidates": list(candidates),
+        "buckets": list(buckets),
+        "cost_ms": {str(b): round(s * 1e3, 3)
+                    for b, s in sorted(costs.items())},
+    }
     try:
         cap_stats = _stream_run(pipe, texts, batch_size, depth,
                                 n_msgs=min(20_000, 10 * batch_size))
@@ -502,7 +729,7 @@ def load_sweep_bench(pipe, texts, batch_size: int, depth: int,
         for frac in (0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0):
             rate = max(500.0, capacity * frac)
             point = _paced_point(pipe, texts, rate, duration_s, batch_size,
-                                 depth, target_p99_ms)
+                                 depth, target_p99_ms, buckets=buckets)
             point["offered_frac_of_capacity"] = frac
             points.append(point)
     finally:
@@ -520,6 +747,7 @@ def load_sweep_bench(pipe, texts, batch_size: int, depth: int,
         "capacity_est_per_s": round(capacity, 1),
         "point_sec": duration_s,
         "target_p99_ms": target_p99_ms,
+        "ladder": ladder,
         "saturation_knee_per_s": knee,
         "max_load_meeting_target_p99_per_s": meets,
         "points": points,
@@ -1033,8 +1261,24 @@ def _explain_serve_bench(lm) -> dict:
     return out
 
 
-def main() -> None:
+def _cli_value(argv, flag):
+    if flag in argv and argv.index(flag) + 1 < len(argv):
+        return argv[argv.index(flag) + 1]
+    return None
+
+
+def main() -> int:
     from fraud_detection_tpu.data import generate_corpus
+
+    argv = sys.argv[1:]
+    budget_raw = _cli_value(argv, "--budget-s") or os.environ.get(
+        "BENCH_BUDGET_S")
+    harness = BenchHarness(
+        partial_path=(_cli_value(argv, "--partial-file")
+                      or os.environ.get("BENCH_PARTIAL",
+                                        "bench_partial.json")),
+        budget_s=float(budget_raw) if budget_raw else None)
+    install_sigterm_handler()
 
     batch_size = int(os.environ.get("BENCH_BATCH", "4096"))
     n_msgs = int(os.environ.get("BENCH_MSGS", "20000"))
@@ -1048,121 +1292,148 @@ def main() -> None:
     corpus = generate_corpus(n=2000, seed=123)
     texts = [d.text for d in corpus]
 
-    pipe = build_pipeline(batch_size, model=model)
-    _warm(pipe, texts, batch_size)  # compile steady-state shapes, BOTH paths
+    metric = "kafka_stream_classification_throughput"
+    if model != "lr":
+        metric += f"_{model}"
+    harness.line.update({"metric": metric, "unit": "dialogues/sec"})
 
     from fraud_detection_tpu.utils.tracing import Tracer
 
-    best = 0.0
-    best_stats = None
-    best_attr = None
-    run_rates = []
-    for _ in range(max(runs, 1)):
-        tracer = Tracer()
-        stats = _stream_run(pipe, texts, batch_size, depth, n_msgs,
-                            tracer=tracer)
-        run_rates.append(round(stats.msgs_per_sec, 1))
-        if best_stats is None or stats.msgs_per_sec > best:
-            best, best_stats = stats.msgs_per_sec, stats
-            best_attr = _attribution(tracer)
+    # Shared across sections: the warm headline pipeline and the best-of
+    # accounting the final resample section extends.
+    state = {"pipe": None, "best": 0.0, "best_stats": None, "best_attr": None,
+             "flops_peak": None, "L_pad": None}
+    run_rates: list = []
 
-    # Device FLOPs per dialogue on the fused LR path: one gather-MAC per
-    # padded token slot (2L FLOPs at this corpus's padded width L). The
-    # resulting fraction of MXU peak is ~1e-6 % — recorded to make the
-    # bottleneck attribution explicit: streaming is bound by host transport
-    # and featurization, the device is essentially idle (round-2 verdict
-    # item 3, "stream scoring" row). LR-only: the tree families do different
-    # device work, so these fields would misattribute under BENCH_MODEL=dt.
-    L_pad = pipe.featurizer.encode(texts[:256]).ids.shape[1]
-    flops_peak, _ = _peaks_if_tpu()
-    if model != "lr":
-        flops_peak = None
-
-    def _headline_fields(best, best_stats) -> dict:
+    def _headline_fields() -> dict:
         # Active per-batch processing latency of the best run (dispatch +
         # finish legs; excludes pipeline queueing) — evidence for the
         # "sub-second per dialogue" parity claim (report-paper.pdf §III.H).
+        best_stats = state["best_stats"]
         fields = {
-            "value": round(best, 1),
-            "vs_baseline": round(best / NORTH_STAR, 4),
-            "runs": run_rates,   # every run, so contention reads as variance
+            "value": round(state["best"], 1),
+            "vs_baseline": round(state["best"] / NORTH_STAR, 4),
+            "runs": list(run_rates),  # every run: contention reads as variance
             "batch_latency_ms": {
                 "p50": round(best_stats.latency_percentile(50) * 1e3, 2),
                 "p99": round(best_stats.latency_percentile(99) * 1e3, 2),
             },
-            "attribution": best_attr,
+            "attribution": state["best_attr"],
         }
-        if flops_peak:
-            fields["device_flops_per_dialogue"] = 2 * L_pad
+        if state["flops_peak"]:
+            fields["device_flops_per_dialogue"] = 2 * state["L_pad"]
             fields["device_pct_of_peak"] = round(
-                100 * best * 2 * L_pad / flops_peak, 9)
+                100 * state["best"] * 2 * state["L_pad"]
+                / state["flops_peak"], 9)
         return fields
 
-    line = {
-        "metric": "kafka_stream_classification_throughput",
-        "unit": "dialogues/sec",
-        **_headline_fields(best, best_stats),
-    }
-    if model != "lr":
-        line["metric"] += f"_{model}"
-    # Leg isolation: the driver runs this file ONCE per round and records
-    # the single JSON line — a failure in a secondary leg (disk pressure
-    # during the 5GB checkpoint synth, a neighbor holding HBM, ...) must
-    # degrade that leg to an "error" field, not erase the headline.
-    def leg(fn):
-        try:
-            return fn()
-        except Exception as e:  # noqa: BLE001 — recorded, not raised
-            return {"error": repr(e)[:300]}
+    def _sample_runs(n: int, scratch) -> None:
+        for _ in range(n):
+            tracer = Tracer()
+            stats = _stream_run(pipe_or_raise(), texts, batch_size, depth,
+                                n_msgs, tracer=tracer)
+            run_rates.append(round(stats.msgs_per_sec, 1))
+            if state["best_stats"] is None or stats.msgs_per_sec > state["best"]:
+                state["best"] = stats.msgs_per_sec
+                state["best_stats"] = stats
+                state["best_attr"] = _attribution(tracer)
+            # Partial headline after EVERY run: a budget/TERM cut mid-best-of
+            # still commits whatever was measured.
+            scratch.update(_headline_fields())
+
+    def pipe_or_raise():
+        if state["pipe"] is None:
+            raise RuntimeError("streaming section did not build a pipeline")
+        return state["pipe"]
+
+    def streaming_section(scratch):
+        state["pipe"] = pipe = build_pipeline(batch_size, model=model)
+        _warm(pipe, texts, batch_size)  # compile steady shapes, BOTH paths
+        # Device FLOPs per dialogue on the fused LR path: one gather-MAC per
+        # padded token slot (2L FLOPs at this corpus's padded width L). The
+        # resulting fraction of MXU peak is ~1e-6 % — recorded to make the
+        # bottleneck attribution explicit: streaming is bound by host
+        # transport and featurization, the device is essentially idle
+        # (round-2 verdict item 3). LR-only: the tree families do different
+        # device work, so these fields would misattribute under
+        # BENCH_MODEL=dt.
+        if model == "lr":
+            state["L_pad"] = pipe.featurizer.encode(texts[:256]).ids.shape[1]
+            state["flops_peak"], _ = _peaks_if_tpu()
+        _sample_runs(max(runs, 1), scratch)
+        return _headline_fields()
+
+    # The headline is the first and most protected section: it gets (nearly)
+    # the whole remaining budget, and its per-run scratch updates mean even
+    # a mid-best-of cut leaves a headline on disk and stdout.
+    harness.section("streaming", streaming_section, fraction=0.9,
+                    min_s=5.0, top_level=True)
+
+    # Host featurization throughput (cheap; right behind the headline so a
+    # tight budget still captures the tentpole's evidence).
+    harness.section("featurize", lambda scratch: featurize_bench(texts),
+                    fraction=0.25, top_level=True)
 
     if model == "lr" and os.environ.get("BENCH_TREES", "1") != "0":
         # Tree-family streaming rides the same raw-JSON path (the
         # reference's primary trained family, fraud_detection_spark.py:
         # 56-91); record it in the same line so the driver's artifact
         # carries the evidence, not just README prose.
-        line["tree_streaming"] = leg(lambda: tree_streaming_bench(
-            texts, batch_size, depth, n_msgs=min(n_msgs, 10_000),
-            lr_pipe=pipe))
+        harness.section(
+            "tree_streaming",
+            lambda scratch: tree_streaming_bench(
+                texts, batch_size, depth, n_msgs=min(n_msgs, 10_000),
+                lr_pipe=pipe_or_raise()),
+            fraction=0.4)
+
     # Offered-load sweep (bench.py --load-sweep, default-on so the committed
     # artifact carries the latency-vs-throughput trajectory, not just one
-    # drain rate): saturation knee + max load meeting --target-p99-ms.
-    argv = sys.argv[1:]
+    # drain rate): cost-aware ladder table, saturation knee, max load
+    # meeting --target-p99-ms.
     want_sweep = ("--load-sweep" in argv
                   or os.environ.get("BENCH_LOAD_SWEEP", "1") != "0")
-    target_p99 = None
-    if "--target-p99-ms" in argv:
-        target_p99 = float(argv[argv.index("--target-p99-ms") + 1])
-    elif os.environ.get("BENCH_TARGET_P99_MS"):
-        target_p99 = float(os.environ["BENCH_TARGET_P99_MS"])
-    else:
-        target_p99 = 250.0  # default SLO so the shedding path is exercised
+    target_raw = (_cli_value(argv, "--target-p99-ms")
+                  or os.environ.get("BENCH_TARGET_P99_MS"))
+    # Default SLO so the shedding path is exercised.
+    target_p99 = float(target_raw) if target_raw else 250.0
     if want_sweep:
-        line["load_sweep"] = leg(lambda: load_sweep_bench(
-            pipe, texts, batch_size, depth, target_p99_ms=target_p99))
+        harness.section(
+            "load_sweep",
+            lambda scratch: load_sweep_bench(
+                pipe_or_raise(), texts, batch_size, depth,
+                target_p99_ms=target_p99),
+            fraction=0.5)
     if os.environ.get("BENCH_TRAIN", "1") != "0":
-        line["training"] = leg(training_bench)
+        harness.section("training", lambda scratch: training_bench(),
+                        fraction=0.7)
     # LLM leg: default-on only where it's fast (real TPU). Off-TPU the
     # T=2048 prefill runs the flash kernel in interpret mode — minutes of
     # per-cell Python — so it must be explicitly requested there.
     want_llm = os.environ.get("BENCH_LLM")
     if model == "lr" and (want_llm == "1" or (want_llm is None and _on_tpu())):
-        line["llm"] = leg(llm_bench)
+        harness.section("llm", lambda scratch: llm_bench(), fraction=0.9)
+
     # The shared host's contention windows can span the whole initial
     # best-of-N; the training/LLM sections above took minutes, so a final
     # pair of streaming samples spreads the estimate in TIME as well — the
     # best across both phases is the headline.
-    if "training" in line or "llm" in line:
-        for _ in range(2):
-            tracer = Tracer()
-            stats = _stream_run(pipe, texts, batch_size, depth, n_msgs,
-                                tracer=tracer)
-            run_rates.append(round(stats.msgs_per_sec, 1))  # headline ∈ runs
-            if stats.msgs_per_sec > best:
-                best, best_stats = stats.msgs_per_sec, stats
-                best_attr = _attribution(tracer)
-        line.update(_headline_fields(best, best_stats))
-    print(json.dumps(line))
+    if (state["pipe"] is not None
+            and ("training" in harness.line or "llm" in harness.line)):
+        def resample_section(scratch):
+            _sample_runs(2, scratch)
+            return _headline_fields()
+
+        harness.section("streaming_resample", resample_section,
+                        top_level=True)
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except (BenchInterrupted, BudgetExceeded):
+        # SIGTERM between sections (the in-section path already flushed),
+        # or an alarm landing in the disarm window: the partial artifact
+        # and the last printed line stand; exit cleanly so the driver
+        # records what was captured.
+        sys.exit(0)

@@ -406,14 +406,26 @@ def main(argv=None) -> int:
     else:
         pipe = build_pipeline(args.model, args.batch_size)
 
+    sched_ladder_costs = None
     if sched_config is not None:
-        # Pre-warm the padding-bucket ladder ONCE, before any engine runs:
-        # every rung's XLA shape compiles here, off the hot path. A
-        # HotSwapPipeline adopts the ladder for all future swap candidates
-        # too (registry/hotswap.py configure_ladder).
+        # Measure + pre-warm the padding-bucket ladder ONCE, before any
+        # engine runs: candidate rungs are timed (compile excluded) and the
+        # cost-aware geometry compiles here, off the hot path. A
+        # HotSwapPipeline adopts ladder AND cost table for all future swap
+        # candidates too (registry/hotswap.py configure_ladder). The
+        # MEASURED buckets are pinned back into the config so every
+        # per-worker scheduler built later agrees with the shapes the
+        # pipeline actually compiled (governor floor, snapshot), and the
+        # cost table is copied into each so health() carries it.
+        import dataclasses
+
         from fraud_detection_tpu.sched import AdaptiveScheduler
 
-        AdaptiveScheduler(sched_config, args.batch_size).prewarm(pipe)
+        prewarmer = AdaptiveScheduler(sched_config, args.batch_size)
+        prewarmer.prewarm(pipe)
+        sched_config = dataclasses.replace(sched_config,
+                                           buckets=tuple(prewarmer.buckets))
+        sched_ladder_costs = prewarmer.ladder_costs
 
     broker = None
     if args.kafka:
@@ -462,17 +474,26 @@ def main(argv=None) -> int:
     if args.dlq:
         dlq_topic = args.dlq_topic or f"{args.output_topic}-dlq"
 
-    engines_built = []   # async lanes to drain + aggregate at exit
+    engines_built = []   # LIVE engines only — replaced ones are harvested
+    # Aggregated lane counters of engines already replaced+closed: replaced
+    # engines are dropped from engines_built (holding every dead incarnation
+    # — consumer/producer references included — for the process lifetime was
+    # a slow leak under --kafka --supervise N; ADVICE round 5), so their
+    # contribution to the exit stats lives here instead.
+    annotations_harvested = {"submitted": 0, "annotated": 0, "dropped": 0,
+                             "backend_errors": 0}
     sched_per_worker: dict = {}
 
     def make_engine(replacing=None, worker=0):
         """Build an engine; ``replacing`` is the previous incarnation on a
         supervised-restart path — its async lane is stopped first (briefly
         drained) so restarts don't accumulate worker threads, each pinning
-        a producer. The DLQ poison tracker is shared across one WORKER's
-        incarnations (so counts survive restarts) but never across workers:
-        they own disjoint partitions, and a cross-thread dict would race a
-        worker's cleanup iteration against another's inserts. The adaptive
+        a producer; its lane counters are harvested into the exit aggregate
+        and the dead engine is dropped from ``engines_built``. The DLQ
+        poison tracker is shared across one WORKER's incarnations (so
+        counts survive restarts) but never across workers: they own
+        disjoint partitions, and a cross-thread dict would race a worker's
+        cleanup iteration against another's inserts. The adaptive
         scheduler follows the same per-worker sharing: one scheduler per
         worker keeps the SLO window and EWMAs warm across supervised
         restarts (incarnations of one worker run sequentially, so the
@@ -480,14 +501,28 @@ def main(argv=None) -> int:
         state is single-driver by contract)."""
         if replacing is not None:
             replacing.close_annotations(timeout=5.0)
+            harvested = replacing.annotation_stats()
+            if harvested:
+                for k in annotations_harvested:
+                    annotations_harvested[k] += harvested.get(k, 0)
+            try:
+                engines_built.remove(replacing)
+            except ValueError:
+                pass
         dlq_attempts = (dlq_trackers.setdefault(worker, {})
                         if args.dlq else None)
         scheduler = None
         if sched_config is not None:
             from fraud_detection_tpu.sched import AdaptiveScheduler
 
-            scheduler = sched_per_worker.setdefault(
-                worker, AdaptiveScheduler(sched_config, args.batch_size))
+            scheduler = sched_per_worker.get(worker)
+            if scheduler is None:
+                scheduler = AdaptiveScheduler(sched_config, args.batch_size)
+                # The startup measurement's per-rung cost table (None when
+                # measurement was skipped) — workers report it in health().
+                scheduler.ladder_costs = (dict(sched_ladder_costs)
+                                          if sched_ladder_costs else None)
+                sched_per_worker[worker] = scheduler
         c, p = make_clients()
         e = StreamingClassifier(pipe, c, p, args.output_topic,
                                 batch_size=args.batch_size, max_wait=args.max_wait,
@@ -508,12 +543,12 @@ def main(argv=None) -> int:
         return e
 
     def finish_annotations():
-        """Drain every engine's async lane; aggregated counters for the
-        stats JSON (None when running inline)."""
+        """Drain every LIVE engine's async lane; aggregated counters for
+        the stats JSON include the already-harvested replaced incarnations
+        (None when running inline)."""
         if not args.explain_async:
             return None
-        agg = {"submitted": 0, "annotated": 0, "dropped": 0,
-               "backend_errors": 0}
+        agg = dict(annotations_harvested)
         for e in engines_built:
             e.close_annotations(timeout=30.0)
             s = e.annotation_stats() or {}

@@ -103,6 +103,25 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib._has_fill16 = True
         except AttributeError:
             lib._has_fill16 = False
+        try:  # stateless batch-shard encode (featurize/parallel.py drives it)
+            lib.ftok_shard_begin.restype = ctypes.c_void_p
+            lib.ftok_shard_begin.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+            lib.ftok_shard_fill.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                ctypes.c_int, ctypes.c_int]
+            lib.ftok_shard_fill16.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS"),
+                ctypes.c_int, ctypes.c_int]
+            lib.ftok_shard_destroy.argtypes = [ctypes.c_void_p]
+            lib._has_shards = True
+        except AttributeError:
+            lib._has_shards = False
         try:  # batch output-frame assembly (stateless)
             lib.ftok_build_frames.restype = ctypes.c_longlong
             lib.ftok_build_frames.argtypes = [
@@ -207,6 +226,45 @@ class NativeFeaturizer:
                 return self._fill(rows, length, want16)
             finally:
                 self._pair_check.finish()
+
+    # ---------------- stateless shard API (thread-pool featurization) ------
+
+    def supports_shards(self) -> bool:
+        """True when the loaded library has the stateless batch-shard entry
+        points (ftok_shard_*). Shard calls never touch the handle's begin/
+        fill row state, so they need no ``_call_lock`` — N threads may drive
+        N shards of one batch concurrently over this one handle."""
+        return bool(getattr(self._lib, "_has_shards", False))
+
+    @staticmethod
+    def sanitize(text: str) -> bytes:
+        """The encode() wire prep (NUL-strip + surrogatepass), shared so the
+        sharded path feeds the C ABI byte-identical inputs."""
+        return text.encode("utf-8", "surrogatepass").replace(b"\x00", b"")
+
+    def shard_begin(self, texts: Sequence[bytes]) -> Tuple[int, int]:
+        """Encode one shard (phase 1): tokenize+hash ``texts`` (already
+        ``sanitize``d bytes) into a heap-owned shard object. Returns
+        ``(shard_handle, width)``; the text buffers may be dropped as soon
+        as this returns (rows store bucket ids, not byte references)."""
+        arr = (ctypes.c_char_p * len(texts))(*texts)
+        width = np.zeros(1, np.int32)
+        shard = self._lib.ftok_shard_begin(self._handle, arr, len(texts), width)
+        return shard, int(width[0])
+
+    def shard_fill_into(self, shard: int, ids: np.ndarray, counts: np.ndarray,
+                        rows: int, length: int) -> None:
+        """Phase 2: write one shard's padded rows into a C-contiguous
+        row-slice of the caller's preallocated output arrays (zero-copy
+        assembly — no per-shard arrays, no concatenate)."""
+        if ids.dtype == np.int16:
+            self._lib.ftok_shard_fill16(shard, ids, counts, rows, length)
+        else:
+            self._lib.ftok_shard_fill(shard, ids, counts, rows, length)
+
+    def shard_destroy(self, shard: int) -> None:
+        if shard:
+            self._lib.ftok_shard_destroy(shard)
 
     def encode_json(self, values: Sequence[bytes], key: bytes, rows: int,
                     max_tokens: Optional[int], pad_len,

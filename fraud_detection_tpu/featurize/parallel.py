@@ -1,0 +1,140 @@
+"""Thread-pool sharded host featurization.
+
+The serving hot path is host-bound (bench attribution: dispatch ≈ JSON +
+featurize + launch), and the Python ``encode()`` leg runs on ONE thread. The
+native library's own batch calls already fan out internally
+(``run_sharded`` in native/fast_featurize.cpp), but the per-call state model
+(one in-flight batch per handle) kept Python callers serial. This module
+shards a batch across a process-wide thread pool using the STATELESS shard
+entry points (``ftok_shard_begin`` / ``ftok_shard_fill*``): each worker's
+ctypes call releases the GIL, so N shards tokenize+hash concurrently over a
+single read-only handle, then fill their rows straight into row-slices of
+ONE preallocated output array pair — zero-copy assembly, no per-shard
+arrays, no concatenate.
+
+Without ``libfastfeat.so`` the same sharding runs the pure-Python
+``sparse_row`` chunks through the pool. The GIL bounds that win (only
+numpy's releases help), but the path keeps one code shape for both modes
+and the output is byte-identical to the serial loop by construction —
+pinned by tests/test_featurize_property.py.
+
+Worker count: explicit ``parallel_workers`` on the featurizer, else the
+``FRAUD_TPU_FEAT_WORKERS`` env var, else ``min(cpu_count, 8)``. One core
+(or ``FRAUD_TPU_FEAT_WORKERS=1``) degrades to the serial paths untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_MAX_WORKERS = 8  # matches the native library's own internal cap
+
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_size = 0
+_pool_lock = threading.Lock()
+
+
+def resolve_workers(configured: Optional[int] = None) -> int:
+    """Worker count: explicit config > FRAUD_TPU_FEAT_WORKERS > cpu count."""
+    if configured is not None:
+        return max(1, int(configured))
+    env = os.environ.get("FRAUD_TPU_FEAT_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(os.cpu_count() or 1, _MAX_WORKERS))
+
+
+def _executor(workers: int) -> ThreadPoolExecutor:
+    """The shared process-wide pool, grown (never shrunk) to ``workers``.
+    One pool for every featurizer: encode is bursty, and per-call pools
+    would pay thread spawn on the latency-critical serving path."""
+    global _pool, _pool_size
+    with _pool_lock:
+        if _pool is None or _pool_size < workers:
+            old = _pool
+            _pool = ThreadPoolExecutor(max_workers=workers,
+                                       thread_name_prefix="featurize")
+            _pool_size = workers
+            if old is not None:
+                old.shutdown(wait=False)
+        return _pool
+
+
+def shard_bounds(n: int, workers: int) -> List[Tuple[int, int]]:
+    """Contiguous [lo, hi) shards covering range(n), at most ``workers``."""
+    if n <= 0:
+        return []
+    per = -(-n // max(1, workers))
+    return [(lo, min(n, lo + per)) for lo in range(0, n, per)]
+
+
+def encode_sharded_native(native, texts: Sequence[str], rows: int,
+                          max_tokens: Optional[int], pad_len: Callable,
+                          want16: bool, workers: int
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Sharded native encode: same contract (and bytes) as
+    ``NativeFeaturizer.encode``, assembled in parallel.
+
+    Two phases around one barrier — the padded token length L is the global
+    max over every shard's width, so fills can't start until all begins
+    land: (1) each worker sanitizes + ``shard_begin``s its texts (the
+    expensive tokenize/hash leg, GIL released); (2) each worker
+    ``shard_fill``s its rows into its own row-slice of the preallocated
+    output arrays. Rows past ``len(texts)`` stay all-padding from the
+    single up-front zero allocation.
+    """
+    n = len(texts)
+    bounds = shard_bounds(n, workers)
+    pool = _executor(workers)
+    shards: List[Optional[int]] = [None] * len(bounds)
+    width = 0
+    try:
+        def begin(i: int) -> int:
+            lo, hi = bounds[i]
+            buf = [native.sanitize(t) for t in texts[lo:hi]]
+            shard, w = native.shard_begin(buf)
+            shards[i] = shard  # slot write: no two workers share an index
+            return w
+
+        for w in pool.map(begin, range(len(bounds))):
+            width = max(width, w)
+        length = max_tokens if max_tokens is not None else pad_len(max(width, 1))
+        ids = np.zeros((rows, length), np.int16 if want16 else np.int32)
+        counts = np.zeros((rows, length), np.uint16 if want16 else np.float32)
+
+        def fill(i: int) -> None:
+            lo, hi = bounds[i]
+            native.shard_fill_into(shards[i], ids[lo:hi], counts[lo:hi],
+                                   hi - lo, length)
+
+        list(pool.map(fill, range(len(bounds))))
+        return ids, counts
+    finally:
+        for shard in shards:
+            if shard is not None:
+                native.shard_destroy(shard)
+
+
+def sparse_rows_chunked(sparse_row: Callable, texts: Sequence[str],
+                        workers: int) -> List[tuple]:
+    """Pure-Python fallback: run ``sparse_row`` over contiguous chunks on
+    the pool, preserving row order exactly (the serial loop's output)."""
+    bounds = shard_bounds(len(texts), workers)
+    pool = _executor(workers)
+
+    def run(i: int) -> List[tuple]:
+        lo, hi = bounds[i]
+        return [sparse_row(t) for t in texts[lo:hi]]
+
+    out: List[tuple] = []
+    for part in pool.map(run, range(len(bounds))):
+        out.extend(part)
+    return out

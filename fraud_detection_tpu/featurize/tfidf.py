@@ -54,6 +54,24 @@ def _pad_len(n: int, minimum: int = 16) -> int:
     return max(minimum, 1 << math.ceil(math.log2(max(n, 1))))
 
 
+def _fill_python_rows(rows, ids: np.ndarray, counts: np.ndarray,
+                      length: int) -> None:
+    """Write sparse (idx, val) rows into preallocated padded arrays — the
+    ONE Python fill (serial and thread-sharded encodes share it, so their
+    bytes cannot drift)."""
+    for r, (idx, val) in enumerate(rows):
+        if len(idx) > length:  # extremely long transcript: keep top-count buckets
+            # stable: ties resolve toward the LOWER bucket id (the
+            # documented rule the native fill implements) — default
+            # quicksort breaks ties arbitrarily and diverges from C++
+            # exactly when a tie group straddles the cut
+            keep = np.argsort(-val, kind="stable")[:length]
+            keep.sort()
+            idx, val = idx[keep], val[keep]
+        ids[r, : len(idx)] = idx
+        counts[r, : len(val)] = np.minimum(val, 65535.0)
+
+
 def tfidf_dense(ids: jax.Array, counts: jax.Array, idf: jax.Array) -> jax.Array:
     """Scatter padded sparse rows into a dense (B, F) TF-IDF matrix.
 
@@ -89,6 +107,12 @@ class HashingTfIdfFeaturizer:
     binary_tf: bool = False
     stop_filter: StopWordFilter = field(default_factory=StopWordFilter)
     remove_stopwords: bool = True
+    # Thread-pool sharded encode (featurize/parallel.py): None = auto
+    # (FRAUD_TPU_FEAT_WORKERS env, else cpu count, capped); 1 = serial.
+    # Batches below parallel_min_rows always take the serial paths — shard
+    # fan-out costs more than it saves on small batches.
+    parallel_workers: Optional[int] = None
+    parallel_min_rows: int = 256
 
     def __post_init__(self):
         self._hashing = HashingTF(self.num_features, binary=self.binary_tf)
@@ -151,31 +175,41 @@ class HashingTfIdfFeaturizer:
         b = batch_size if batch_size is not None else len(texts)
         if len(texts) > b:
             raise ValueError(f"{len(texts)} texts > batch_size {b}")
+        workers = (self._encode_workers() if len(texts) >= self.parallel_min_rows
+                   else 1)
         native = self._native_featurizer()
         if native is not None:
-            ids, counts = native.encode(texts, b, max_tokens, _pad_len,
-                                        want16=self._ids_dtype() is np.int16)
+            want16 = self._ids_dtype() is np.int16
+            if workers > 1 and native.supports_shards():
+                from fraud_detection_tpu.featurize import parallel
+
+                ids, counts = parallel.encode_sharded_native(
+                    native, texts, b, max_tokens, _pad_len, want16=want16,
+                    workers=workers)
+            else:
+                ids, counts = native.encode(texts, b, max_tokens, _pad_len,
+                                            want16=want16)
             if ids.dtype == np.int16:  # C++ emitted wire dtypes directly
                 return EncodedBatch(ids=ids, counts=counts)
             return EncodedBatch(*self._narrow(ids, counts))
-        rows = [self.sparse_row(t) for t in texts]
+        if workers > 1:
+            from fraud_detection_tpu.featurize import parallel
+
+            rows = parallel.sparse_rows_chunked(self.sparse_row, texts, workers)
+        else:
+            rows = [self.sparse_row(t) for t in texts]
         width = max((len(i) for i, _ in rows), default=1)
         length = max_tokens if max_tokens is not None else _pad_len(width)
         # Allocate the wire dtypes directly — no second narrowing pass.
         ids = np.zeros((b, length), self._ids_dtype())
         counts = np.zeros((b, length), np.uint16)
-        for r, (idx, val) in enumerate(rows):
-            if len(idx) > length:  # extremely long transcript: keep top-count buckets
-                # stable: ties resolve toward the LOWER bucket id (the
-                # documented rule the native fill implements) — default
-                # quicksort breaks ties arbitrarily and diverges from C++
-                # exactly when a tie group straddles the cut
-                keep = np.argsort(-val, kind="stable")[:length]
-                keep.sort()
-                idx, val = idx[keep], val[keep]
-            ids[r, : len(idx)] = idx
-            counts[r, : len(val)] = np.minimum(val, 65535.0)
+        _fill_python_rows(rows, ids, counts, length)
         return EncodedBatch(ids=ids, counts=counts)
+
+    def _encode_workers(self) -> int:
+        from fraud_detection_tpu.featurize import parallel
+
+        return parallel.resolve_workers(self.parallel_workers)
 
     def encode_json(self, values: Sequence[bytes], text_field: str = "text",
                     batch_size: Optional[int] = None,

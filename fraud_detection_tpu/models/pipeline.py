@@ -288,7 +288,11 @@ class ServingPipeline:
         JAX dispatch is asynchronous, so the caller can overlap host work
         (decode/produce of neighboring batches) with device execution — the
         lever that hides the per-call device round-trip latency in the
-        streaming engine."""
+        streaming engine. The host featurize leg itself fans out for large
+        chunks: ``featurizer.encode`` shards across the thread pool
+        (featurize/parallel.py), so at ``pipeline_depth >= 2`` the engine
+        overlaps a PARALLEL featurize with the in-flight batches' device
+        wait instead of a single-threaded one."""
         parts: List[Tuple[object, int]] = []
         threshold = 0.5
         argmax = False

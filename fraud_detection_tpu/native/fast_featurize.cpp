@@ -726,17 +726,20 @@ int run_sharded(int n, Fn&& encode_range) {
   return width.load(std::memory_order_relaxed);
 }
 
-// Shared fill core: drain handle row state into padded (n_rows, L) output
-// arrays, truncating over-long rows by the parity-critical keep-top-L rule.
+// Shared fill core: drain a row store into padded (n_rows, L) output arrays,
+// truncating over-long rows by the parity-critical keep-top-L rule. Used by
+// the handle-state fills below AND the stateless shard fills (which write a
+// row-slice of a larger caller-owned array — same rule, same bytes).
 template <typename IdT, typename CtT, typename IdCast, typename CtCast>
-void fill_rows(Featurizer* f, IdT* ids, CtT* counts, int n_rows, int L,
-               IdCast id_cast, CtCast ct_cast) {
+void fill_row_store(const std::vector<std::vector<std::pair<int, float>>>& rows,
+                    int n_avail, IdT* ids, CtT* counts, int n_rows, int L,
+                    IdCast id_cast, CtCast ct_cast) {
   std::memset(ids, 0, sizeof(IdT) * size_t(n_rows) * L);
   std::memset(counts, 0, sizeof(CtT) * size_t(n_rows) * L);
-  const int n = std::min<int>(f->n_rows, n_rows);
+  const int n = std::min<int>(n_avail, n_rows);
   std::vector<std::pair<int, float>> kept;
   for (int d = 0; d < n; ++d) {
-    auto* row = &f->rows[d];
+    auto* row = &rows[d];
     if (int(row->size()) > L) {
       // keep the L highest counts; ties resolved toward the lower bucket id
       // (numpy stable argsort(-val) over id-sorted input), then re-sort by id
@@ -754,8 +757,24 @@ void fill_rows(Featurizer* f, IdT* ids, CtT* counts, int n_rows, int L,
       ctp[j] = ct_cast((*row)[j].second);
     }
   }
+}
+
+template <typename IdT, typename CtT, typename IdCast, typename CtCast>
+void fill_rows(Featurizer* f, IdT* ids, CtT* counts, int n_rows, int L,
+               IdCast id_cast, CtCast ct_cast) {
+  fill_row_store(f->rows, f->n_rows, ids, counts, n_rows, L, id_cast, ct_cast);
   f->n_rows = 0;  // rows keep their capacity for the next batch
 }
+
+// One caller-owned shard of a batch: row state for the stateless shard API
+// below. The Featurizer handle is strictly READ-ONLY during shard calls
+// (config + stop tables), so any number of shards may encode concurrently
+// over one handle — this is the batch-shard entry point the Python
+// thread-pool featurizer (featurize/parallel.py) drives, one GIL-releasing
+// ctypes call per shard per phase.
+struct ShardState {
+  std::vector<std::vector<std::pair<int, float>>> rows;
+};
 
 }  // namespace
 
@@ -858,6 +877,53 @@ void ftok_encode_fill16(void* h, int16_t* ids, uint16_t* counts, int n_rows, int
             [](int b) { return int16_t(b); },
             [](float v) { return uint16_t(v > 65535.0f ? 65535u : uint32_t(v)); });
 }
+
+// ---------------------------------------------------------------------------
+// Stateless batch-shard API. ftok_encode_begin/fill keep their row state on
+// the handle (one in-flight batch per handle, caller-locked); these instead
+// return an opaque shard object, so N Python worker threads can encode N
+// shards of one batch CONCURRENTLY over a single handle:
+//   phase 1: shard = ftok_shard_begin(h, texts, n)   (parallel; returns width)
+//   barrier: L = pad(max shard widths)
+//   phase 2: ftok_shard_fill16(shard, ids+lo*L, counts+lo*L, n, L) (parallel —
+//            each shard writes its own row-slice of the caller's arrays)
+//   ftok_shard_destroy(shard)
+// Each phase is one GIL-releasing ctypes call, which is what makes the
+// Python-side thread pool an actual parallelism win.
+// ---------------------------------------------------------------------------
+
+void* ftok_shard_begin(void* h, const char** texts, int n_texts,
+                       int32_t* width_out) {
+  auto* f = static_cast<Featurizer*>(h);
+  auto* s = new ShardState;
+  s->rows.resize(size_t(std::max(n_texts, 0)));
+  StampCounter acc;  // per-shard: no shared mutable state with other shards
+  acc.init(f->num_features);
+  int width = 0;
+  for (int d = 0; d < n_texts; ++d) {
+    encode_text_utf8(f, texts[d], acc, s->rows[d]);
+    width = std::max(width, int(s->rows[d].size()));
+  }
+  *width_out = width;
+  return s;
+}
+
+void ftok_shard_fill(void* sh, int32_t* ids, float* counts, int n_rows, int L) {
+  auto* s = static_cast<ShardState*>(sh);
+  fill_row_store(s->rows, int(s->rows.size()), ids, counts, n_rows, L,
+                 [](int b) { return int32_t(b); },
+                 [](float v) { return v; });
+}
+
+void ftok_shard_fill16(void* sh, int16_t* ids, uint16_t* counts, int n_rows,
+                       int L) {
+  auto* s = static_cast<ShardState*>(sh);
+  fill_row_store(s->rows, int(s->rows.size()), ids, counts, n_rows, L,
+                 [](int b) { return int16_t(b); },
+                 [](float v) { return uint16_t(v > 65535.0f ? 65535u : uint32_t(v)); });
+}
+
+void ftok_shard_destroy(void* sh) { delete static_cast<ShardState*>(sh); }
 
 // %.6f, locale-independent and hard-bounded: a co-loaded library calling
 // setlocale must not turn the decimal point into a comma, and out-of-[0,1]

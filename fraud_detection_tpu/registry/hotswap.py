@@ -54,6 +54,7 @@ class HotSwapPipeline:
         # configured, EVERY candidate is pre-warmed at every rung, so
         # neither a swap nor a first small batch compiles on the hot path.
         self._pad_buckets: Optional[Tuple[int, ...]] = None
+        self._ladder_costs: Optional[dict] = None  # measured once, reused
         self.swaps = 0
         self._last_swap_at: Optional[float] = None
         if prewarm_buckets is not None:
@@ -107,19 +108,50 @@ class HotSwapPipeline:
     # ------------------------------------------------------------------
 
     def configure_ladder(self, buckets: Sequence[int], *,
-                         prewarm: bool = True) -> None:
+                         prewarm: bool = True,
+                         costs: Optional[dict] = None) -> None:
         """Adopt a scheduler padding-bucket ladder (sched/batcher.py): the
         active pipeline (and any staged candidate) starts padding partial
         batches to ladder rungs, and every future ``prewarm`` — i.e. every
         swap/stage candidate — compiles every rung, keeping the hot path
-        compile-free across swaps AND across batch sizes."""
+        compile-free across swaps AND across batch sizes.
+
+        ``costs`` caches the measured per-rung device costs the geometry
+        came from (``measure_ladder`` / sched measure_rung_costs): swap and
+        stage candidates then only COMPILE the selected rungs — the cost
+        curve is a property of the rung shapes, not the weights, so
+        candidates never re-bench."""
         self._pad_buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if costs is not None:
+            self._ladder_costs = dict(costs)
         for target in (self.active_pipeline, self.staged_pipeline):
             if target is not None:
                 if prewarm:
                     self.prewarm(target)
                 else:
                     target.pad_ladder = self._pad_buckets
+
+    def measure_ladder(self, candidates: Sequence[int], *,
+                       texts: Optional[Sequence[str]] = None,
+                       repeats: int = 3) -> dict:
+        """Time candidate rungs on the ACTIVE pipeline (compile excluded —
+        sched/batcher.py measure_rung_costs) and cache the table; the
+        scheduler's cost-aware prewarm calls this instead of re-measuring
+        per swap. The active pipeline is left padded to the candidate set
+        until ``configure_ladder`` applies the selected geometry."""
+        from fraud_detection_tpu.sched.batcher import measure_rung_costs
+
+        costs = measure_rung_costs(self.active_pipeline, tuple(candidates),
+                                   texts=list(texts or self._prewarm_texts),
+                                   repeats=repeats)
+        self._ladder_costs = dict(costs)
+        return costs
+
+    @property
+    def ladder_costs(self) -> Optional[dict]:
+        """Measured per-rung cost table (seconds/batch) the current ladder
+        was derived from; None before any measurement."""
+        return self._ladder_costs
 
     @property
     def pad_buckets(self) -> Optional[Tuple[int, ...]]:

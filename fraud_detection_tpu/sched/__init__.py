@@ -19,7 +19,11 @@ subsystem owns that handoff (docs/scheduling.md):
 
 from fraud_detection_tpu.sched.admission import (AdmissionController,
                                                  TokenBucket)
-from fraud_detection_tpu.sched.batcher import (DynamicBatcher, default_ladder,
+from fraud_detection_tpu.sched.batcher import (DynamicBatcher,
+                                               cost_aware_ladder,
+                                               default_ladder,
+                                               ladder_candidates,
+                                               measure_rung_costs,
                                                prewarm_ladder)
 from fraud_detection_tpu.sched.governor import BackpressureGovernor
 from fraud_detection_tpu.sched.scheduler import (AdaptiveScheduler,
@@ -36,6 +40,9 @@ __all__ = [
     "SchedulerConfig",
     "SloTracker",
     "TokenBucket",
+    "cost_aware_ladder",
     "default_ladder",
+    "ladder_candidates",
+    "measure_rung_costs",
     "prewarm_ladder",
 ]

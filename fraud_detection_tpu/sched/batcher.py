@@ -52,6 +52,86 @@ def default_ladder(batch_size: int, factor: int = 4,
     return tuple(sorted(b for b in rungs if b <= batch_size))
 
 
+def ladder_candidates(batch_size: int) -> tuple:
+    """Probe rungs for cost measurement: geometric doublings from
+    ``batch_size/16`` (floored at the minimum rung) up to ``batch_size`` —
+    1024 -> (64, 128, 256, 512, 1024). A superset of :func:`default_ladder`
+    so the measured cost curve can only refine the fixed geometry, never
+    miss it."""
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    rungs = {batch_size}
+    b = max(_MIN_BUCKET, batch_size // 16)
+    while b < batch_size:
+        rungs.add(b)
+        b *= 2
+    return tuple(sorted(rungs))
+
+
+def measure_rung_costs(pipeline, rungs: Sequence[int],
+                       texts: Optional[Sequence[str]] = None,
+                       repeats: int = 3) -> dict:
+    """Per-rung steady device cost in seconds/batch, compile EXCLUDED.
+
+    For each rung the pipeline's ladder pads an exactly-rung-sized batch to
+    itself; the first run per rung carries the XLA compile (plus warm) and
+    is never timed, then the median of ``repeats`` steady runs is recorded —
+    a contention spike during one repeat shifts a sample, not the median.
+    Times the raw-JSON path when the featurizer supports it (the engine's
+    actual hot path), falling back to ``predict``. Leaves ``pad_ladder``
+    set to ``rungs``; callers re-apply their selected ladder afterwards
+    (every selected rung came from this probe set, so nothing compiles on
+    the hot path later)."""
+    pool = list(texts or _PREWARM_TEXTS)
+    rungs = tuple(sorted({int(b) for b in rungs}))
+    pipeline.pad_ladder = rungs
+    costs = {}
+    for b in rungs:
+        rows = [pool[i % len(pool)] for i in range(b)]
+        values = [json.dumps({"text": t}).encode() for t in rows]
+        pipeline.predict(rows)                 # compile + warm (untimed)
+        fast = pipeline.predict_json_async(values)
+        if fast is not None:
+            fast[0].resolve()
+        samples = []
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            fast = pipeline.predict_json_async(values)
+            if fast is not None:
+                fast[0].resolve()
+            else:
+                pipeline.predict(rows)
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        costs[b] = samples[len(samples) // 2]
+    return costs
+
+
+def cost_aware_ladder(costs: dict, batch_size: int,
+                      min_ratio: float = 1.25) -> tuple:
+    """Derive ladder geometry from a measured cost curve (ROADMAP
+    "Cost-aware bucket ladder") instead of the fixed /16 /4 /1 menu.
+
+    Walk DOWN from the top rung and keep a smaller rung only when it is at
+    least ``min_ratio`` cheaper than the smallest rung kept so far — in a
+    flat region of the curve (fixed dispatch overhead dominating) padding a
+    partial batch up to the next rung costs ~nothing, so the extra compiled
+    shape buys nothing; where cost grows ~linearly every probe survives.
+    The top rung (``batch_size``, else the largest measured) is always
+    kept. The result is a subset of ``costs``' keys, so a caller that
+    measured the candidates has already compiled every selected shape."""
+    if min_ratio <= 1.0:
+        raise ValueError(f"min_ratio must be > 1, got {min_ratio}")
+    if not costs:
+        raise ValueError("no measured rung costs")
+    top = batch_size if batch_size in costs else max(costs)
+    keep = [top]
+    for b in sorted((x for x in costs if x < top), reverse=True):
+        if costs[b] * min_ratio <= costs[keep[-1]]:
+            keep.append(b)
+    return tuple(sorted(keep))
+
+
 def bucket_for(n: int, ladder: Sequence[int]) -> int:
     """Smallest rung >= n (the padding target for an n-row partial batch);
     the top rung for anything larger."""

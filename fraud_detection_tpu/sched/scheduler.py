@@ -29,7 +29,11 @@ from fraud_detection_tpu.sched.admission import (SHED_POLICIES,
                                                  AdmissionController,
                                                  TokenBucket)
 from fraud_detection_tpu.sched.batcher import (DynamicBatcher, bucket_for,
-                                               default_ladder, prewarm_ladder)
+                                               cost_aware_ladder,
+                                               default_ladder,
+                                               ladder_candidates,
+                                               measure_rung_costs,
+                                               prewarm_ladder)
 from fraud_detection_tpu.sched.governor import BackpressureGovernor
 from fraud_detection_tpu.sched.sketch import SloTracker
 from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
@@ -52,7 +56,16 @@ class SchedulerConfig:
     burst: Optional[float] = None         # token burst; None = 1s of rate
     window_sec: float = 10.0              # SLO tracker rotation window
     max_batch_sec: Optional[float] = None  # None = derived (see resolve)
-    buckets: Optional[Tuple[int, ...]] = None  # None = default_ladder
+    buckets: Optional[Tuple[int, ...]] = None  # None = measured (cost_aware)
+                                               # else default_ladder
+    # Cost-aware ladder (docs/scheduling.md): prewarm() times every
+    # candidate rung (compile excluded, median of steady repeats) and
+    # derives the rung set from the measured cost curve; explicit
+    # ``buckets`` pin the geometry but the rungs still get measured for
+    # the health()/bench cost table. cost_ratio is the minimum cost gap
+    # that justifies keeping a smaller rung.
+    cost_aware: bool = True
+    cost_ratio: float = 1.25
 
     def __post_init__(self):
         if self.shed_policy not in SHED_POLICIES:
@@ -80,6 +93,8 @@ class SchedulerConfig:
             raise ValueError(
                 "shed_policy='reject' needs a limit to enforce: set "
                 "max_queue and/or max_rate")
+        if self.cost_ratio <= 1.0:
+            raise ValueError(f"cost_ratio must be > 1, got {self.cost_ratio}")
 
     def resolved_max_batch_sec(self) -> float:
         """The governor's batch-wall bound. Explicit value wins; with a
@@ -99,9 +114,14 @@ class AdaptiveScheduler:
     def __init__(self, config: SchedulerConfig, batch_size: int, *,
                  clock=time.monotonic, sleep=time.sleep):
         self.config = config
+        self.batch_size = batch_size
         self.buckets: Tuple[int, ...] = tuple(
             config.buckets if config.buckets
             else default_ladder(batch_size))
+        # Measured per-rung device cost (seconds/batch, compile excluded) —
+        # populated by prewarm(); the geometry source under cost_aware and
+        # the health()/bench evidence either way.
+        self.ladder_costs: Optional[dict] = None
         self.slo = SloTracker(target_p99_ms=config.target_p99_ms,
                               window_sec=config.window_sec, clock=clock)
         self.batcher = DynamicBatcher(config.batch_deadline_ms, clock=clock)
@@ -168,15 +188,43 @@ class AdaptiveScheduler:
 
     def prewarm(self, pipeline,
                 texts: Optional[Sequence[str]] = None) -> int:
-        """Apply the ladder to the pipeline and compile every rung off the
-        hot path (sched/batcher.py prewarm_ladder). HotSwapPipelines route
-        through their own ladder-aware prewarm so future swap candidates
-        inherit the same shapes (registry/hotswap.py)."""
+        """Measure rung costs, derive the ladder, apply it to the pipeline,
+        and compile every selected rung off the hot path.
+
+        Under ``cost_aware`` (the default, no explicit ``buckets``) the
+        candidate rungs (sched/batcher.py ladder_candidates) are each timed
+        at prewarm — compile excluded, median of steady repeats — and the
+        rung geometry comes from the measured cost curve
+        (``cost_aware_ladder``) instead of the fixed /16 /4 /1 menu.
+        Explicit ``buckets`` pin the geometry; the rungs are still measured
+        so health()/bench carry the cost table. HotSwapPipelines measure on
+        the ACTIVE pipeline and cache the costs, so future swap candidates
+        only compile the selected rungs — they never re-bench
+        (registry/hotswap.py)."""
+        cfg = self.config
+        explicit = cfg.buckets is not None
+        candidates = (self.buckets if explicit or not cfg.cost_aware
+                      else ladder_candidates(self.batch_size))
+        measure = getattr(pipeline, "measure_ladder", None)
+        if measure is not None:         # HotSwapPipeline: measure + cache
+            costs = measure(candidates, texts=texts)
+        else:
+            costs = measure_rung_costs(pipeline, candidates, texts=texts)
+        self.ladder_costs = dict(costs)
+        if not explicit and cfg.cost_aware:
+            self.buckets = cost_aware_ladder(costs, self.batch_size,
+                                             cfg.cost_ratio)
+            # The smallest rung is the governor's budget floor — keep them
+            # aligned when measurement reshapes the ladder.
+            self.governor.min_budget = self.buckets[0]
         configure = getattr(pipeline, "configure_ladder", None)
         if configure is not None:
-            configure(self.buckets, prewarm=True)
+            configure(self.buckets, prewarm=True, costs=costs)
             return len(self.buckets)
-        return prewarm_ladder(pipeline, self.buckets, texts)
+        # Every selected rung was compiled during measurement; this applies
+        # the final ladder and re-warms it (no new compiles).
+        prewarm_ladder(pipeline, self.buckets, texts)
+        return len(self.buckets)
 
     # ------------------------------------------------------------------
     # observability (any thread)
@@ -184,9 +232,15 @@ class AdaptiveScheduler:
 
     def snapshot(self) -> dict:
         """The ``sched`` block of ``StreamingClassifier.health()``."""
+        costs = self.ladder_costs
         return {
             "batch_deadline_ms": self.config.batch_deadline_ms,
             "buckets": list(self.buckets),
+            # Measured per-rung device cost (ms/batch, compile excluded) —
+            # None until prewarm() ran. Keys are strings for JSON pollers.
+            "ladder_cost_ms": (None if costs is None else
+                               {str(b): round(s * 1e3, 3)
+                                for b, s in sorted(costs.items())}),
             "slo": self.slo.snapshot(),
             "admission": self.admission.snapshot(),
             "governor": self.governor.snapshot(),

@@ -79,6 +79,13 @@ class AsyncAnnotationLane:
         self.dropped = 0
         self.annotated = 0
         self.backend_errors = 0
+        # Records handed to the producer across the lane's lifetime: the
+        # ``annotated`` credit is the running delivered total (produced -
+        # flush()'s producer-queue depth), NOT a per-batch subtraction —
+        # flush() counts the whole producer queue, so records a previous
+        # failed flush left behind would otherwise be double-subtracted
+        # (ADVICE round 5). Worker-thread-only, like ``annotated``.
+        self.produced = 0
         self._idle = threading.Event()
         self._idle.set()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -148,13 +155,18 @@ class AsyncAnnotationLane:
             # process exits are LOST, and the drop/annotated counters are
             # the lane's recorded-fact contract. Annotation batches take
             # seconds of decode, so a per-batch flush costs nothing.
+            self.produced += len(out)
             undelivered = self._producer.flush()
             if undelivered:
                 self.backend_errors += 1
                 log.warning("producer left %d annotation records "
                             "undelivered (counted as not annotated)",
                             undelivered)
-            self.annotated += len(out) - min(int(undelivered), len(out))
+            # Running delivered tally: a later successful flush of records a
+            # previous one left queued credits them then, exactly once. The
+            # max() keeps the counter monotonic while the queue is deep.
+            self.annotated = max(self.annotated,
+                                 self.produced - int(undelivered))
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until the queue is empty and the worker is idle (or
@@ -180,19 +192,38 @@ class AsyncAnnotationLane:
     def close(self, timeout: float = 30.0) -> bool:
         """Drain best-effort, then stop the worker. True = clean shutdown
         (queue drained AND worker exited); False is honest about partial
-        failure — rows still queued, or a worker hung in the backend (it is
+        failure — rows discarded, or a worker hung in the backend (it is
         a daemon thread, so an un-joinable worker cannot block process
         exit, and a latched-closed lane drops any late submits).
+
+        After the drain deadline the RESIDUAL QUEUE IS CLEARED under the
+        lock, counting the discards as dropped, before ``_closed`` latches
+        (ADVICE round 5): without this a slow worker kept draining
+        multi-second LLM batches past close(), so ``annotation_stats()``
+        read right after — serve.py's finish_annotations() does exactly
+        that — snapshotted counters that were still mutating, and process
+        exit could kill the daemon mid-flush. Clearing makes post-close
+        stats quiescent up to the single batch already in the worker's
+        hands (bounded by the join below).
 
         Never blocks unboundedly: the drain phase is capped by ``timeout``
         and the join by a short window scaled to it — a backend that
         ignores interruption costs the caller ~timeout, not forever."""
         drained = self.drain(timeout)
         with self._cv:
+            residual = len(self._q)
+            if residual:
+                self.dropped += residual
+                self._q.clear()
             self._closed = True
             self._cv.notify()
         self._thread.join(timeout=min(5.0, max(0.2, timeout)))
-        return drained and not self._thread.is_alive()
+        alive = self._thread.is_alive()
+        if alive:
+            log.warning("annotation worker still running after close() "
+                        "(hung backend?); daemon thread, counters may "
+                        "move for one more batch")
+        return drained and residual == 0 and not alive
 
     def stats(self) -> dict:
         with self._cv:

@@ -253,6 +253,15 @@ class StreamingClassifier:
             raise ValueError(
                 "explain_async requires a dedicated annotations_producer "
                 "(a second producer on the same transport)")
+        if explain_async and annotations_producer is producer:
+            # Same invariant, sneakier violation: handing the engine's OWN
+            # producer object in cross-contaminates the accounting just the
+            # same — enforce the documented contract, don't trust callers.
+            raise ValueError(
+                "annotations_producer is the engine's own producer object — "
+                "the async lane needs a DEDICATED producer (flush() is how "
+                "both sides account delivery; sharing one lets either side "
+                "consume the other's failures)")
         self.pipeline = pipeline
         self.consumer = consumer
         self.producer = producer
@@ -372,7 +381,14 @@ class StreamingClassifier:
 
     def _dispatch(self, msgs: List[Message]) -> "_InFlight":
         """Decode + featurize + launch device scoring; does NOT block on the
-        device. Returns the in-flight batch handle for ``_finish``."""
+        device. Returns the in-flight batch handle for ``_finish``.
+
+        The featurize leg is multi-core on both paths: the raw-JSON encode
+        shards inside one C++ call (native/fast_featurize.cpp run_sharded)
+        and the text fallback shards across the Python thread pool
+        (featurize/parallel.py via ``pipeline.predict_async``) — so at
+        ``pipeline_depth >= 2`` the host leg that overlaps the device wait
+        is itself parallel, not one GIL-bound thread."""
         t0 = time.perf_counter()
         # Offsets cover the ORIGINAL batch — rows screened out below are
         # handled (their DLQ record ships with this batch) and must commit.

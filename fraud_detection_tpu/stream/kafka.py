@@ -16,6 +16,7 @@ environments without it.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 from fraud_detection_tpu.stream.broker import (CommitFailedError, Message,
@@ -120,20 +121,75 @@ def _msg_timestamp(m) -> float:
 
 
 class KafkaConsumer:
-    """confluent_kafka consumer adapted to the engine's poll_batch protocol."""
+    """confluent_kafka consumer adapted to the engine's poll_batch protocol.
+
+    ``client`` injects a pre-built consumer object (tests drive the adapter
+    contract without the wheel or a broker); ``backlog_interval`` rate-limits
+    the watermark queries behind :meth:`backlog`."""
 
     def __init__(self, topics: Optional[List[str]] = None,
-                 config: Optional[KafkaConfig] = None):
-        _require()
-        cfg = config or KafkaConfig.from_env()
-        self._consumer = _ck.Consumer({
-            "bootstrap.servers": cfg.bootstrap_servers,
-            "group.id": cfg.consumer_group,
-            "auto.offset.reset": "earliest",
-            "enable.auto.commit": False,
-            **_security_config(cfg),
-        })
-        self._consumer.subscribe(topics or [cfg.input_topic])
+                 config: Optional[KafkaConfig] = None, *,
+                 client=None, backlog_interval: float = 1.0,
+                 clock=time.monotonic):
+        if client is not None:
+            self._consumer = client
+            if topics:
+                client.subscribe(topics)
+        else:
+            _require()
+            cfg = config or KafkaConfig.from_env()
+            self._consumer = _ck.Consumer({
+                "bootstrap.servers": cfg.bootstrap_servers,
+                "group.id": cfg.consumer_group,
+                "auto.offset.reset": "earliest",
+                "enable.auto.commit": False,
+                **_security_config(cfg),
+            })
+            self._consumer.subscribe(topics or [cfg.input_topic])
+        self._clock = clock
+        self._backlog_interval = backlog_interval
+        self._backlog_at: Optional[float] = None
+        self._backlog_val: Optional[int] = None
+
+    def backlog(self) -> Optional[int]:
+        """Rows queued behind the consumer's position across its assigned
+        partitions — the queue-depth signal the scheduler's ``--max-queue``
+        watermark shed policy reads (ROADMAP "Kafka backlog signal"; the
+        in-process broker's ``InProcessConsumer.backlog`` twin).
+
+        Sums ``high_watermark - position`` per assigned partition from
+        ``get_watermark_offsets``. CACHED and RATE-LIMITED: at most one
+        round of watermark queries per ``backlog_interval`` seconds (the
+        scheduler asks per batch — hundreds of times a second at full
+        rate), with the cached value served in between. Partitions without
+        a valid watermark or position yet contribute 0 (conservative: shed
+        decisions want a floor, not a guess), and any client error caches
+        None — lag reporting must never kill serving; the watermark policy
+        just goes inert until the next refresh."""
+        now = self._clock()
+        if (self._backlog_at is not None
+                and now - self._backlog_at < self._backlog_interval):
+            return self._backlog_val
+        self._backlog_at = now
+        try:
+            total = 0
+            for tp in self._consumer.assignment():
+                lo, hi = self._consumer.get_watermark_offsets(
+                    tp, timeout=0.2, cached=True)
+                if hi is None or hi < 0:
+                    continue  # no cached watermark yet
+                pos = self._consumer.position([tp])[0].offset
+                if pos is None or pos < 0:
+                    # OFFSET_INVALID before the first fetch: with
+                    # auto.offset.reset=earliest the consumer will start at
+                    # the low watermark, so the whole retained range is the
+                    # honest backlog.
+                    pos = lo
+                total += max(0, hi - max(pos, lo))
+            self._backlog_val = total
+        except Exception:  # noqa: BLE001 — see docstring
+            self._backlog_val = None
+        return self._backlog_val
 
     def poll(self, timeout: float = 1.0) -> Optional[Message]:
         msg = self._consumer.poll(timeout)

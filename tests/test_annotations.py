@@ -220,13 +220,21 @@ def test_engine_async_slow_backend_never_blocks_classification(pipeline):
 def test_engine_async_requires_dedicated_producer(pipeline):
     """Sharing the engine's producer would cross-contaminate flush()-based
     delivery accounting (engine: commit-only-if-drained; lane: annotated
-    counters) — the constructor refuses."""
+    counters) — the constructor refuses, both when no producer is given AND
+    when the engine's own producer object is passed in (ADVICE round 5: the
+    documented invariant must actually be enforced)."""
     broker = InProcessBroker()
     with pytest.raises(ValueError, match="annotations_producer"):
         StreamingClassifier(
             pipeline, broker.consumer(["t"], "g"), broker.producer(), "out",
             explain_batch_fn=lambda t, l, c: [None] * len(t),
             explain_async=True)
+    shared = broker.producer()
+    with pytest.raises(ValueError, match="DEDICATED"):
+        StreamingClassifier(
+            pipeline, broker.consumer(["t"], "g"), shared, "out",
+            explain_batch_fn=lambda t, l, c: [None] * len(t),
+            explain_async=True, annotations_producer=shared)
 
 
 def test_lane_close_bounded_and_honest_with_hung_backend():
@@ -300,6 +308,70 @@ def test_lane_drain_deadline_uses_injected_clock():
     lane.close(timeout=10.0)           # drain verdict also rides the fast
     lane._thread.join(timeout=5.0)     # clock; just check the worker exits
     assert not lane._thread.is_alive()
+
+
+def test_lane_close_discards_residual_queue_as_dropped():
+    """ADVICE satellite: after the drain deadline, close() clears the
+    residual queue under the lock (counting discards as dropped) before
+    latching — post-close stats are quiescent, not a racing snapshot."""
+    broker = InProcessBroker()
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_fn(texts, labels, confs):
+        started.set()
+        release.wait(30.0)
+        return ["late"] * len(texts)
+
+    lane = _lane(broker, slow_fn, max_batch=2)
+    lane.submit([(bytes([i]), f"t{i}", 1, 0.5) for i in range(8)])
+    assert started.wait(5.0)          # worker holds a 2-row batch
+    assert lane.close(timeout=0.3) is False
+    s1 = lane.stats()
+    assert s1["queue_depth"] == 0     # residual 6 rows cleared...
+    assert s1["dropped"] == 6         # ...and counted, not silently lost
+    release.set()                     # the in-flight batch may still finish
+    lane._thread.join(timeout=5.0)
+    # dropped/submitted/queue_depth never move again after close
+    s2 = lane.stats()
+    assert (s2["submitted"], s2["dropped"], s2["queue_depth"]) == (8, 6, 0)
+
+
+def test_lane_annotated_credit_survives_producer_backlog():
+    """ADVICE satellite: ``annotated`` is a running delivered tally
+    (produced - flush()'s queue depth), so records a failed flush leaves
+    behind are credited exactly once when a LATER flush delivers them —
+    never double-subtracted from the next batch."""
+    class BacklogProducer:
+        def __init__(self):
+            self.sent = []
+            self.queue = 0
+            self.fail_next = True
+
+        def produce(self, topic, value, key=None):
+            self.sent.append((value, key))
+            self.queue += 1
+
+        def flush(self):
+            if self.fail_next:        # everything stays queued once
+                self.fail_next = False
+                return self.queue
+            self.queue = 0
+            return 0
+
+    prod = BacklogProducer()
+    lane = AsyncAnnotationLane(lambda t, l, c: ["a"] * len(t), prod, "ann")
+    lane.submit([(b"k1", "one", 1, 0.5)])
+    lane.drain(timeout=10.0)
+    assert lane.stats()["annotated"] == 0     # first flush left it queued
+    assert lane.stats()["backend_errors"] == 1
+    lane.submit([(b"k2", "two", 1, 0.5)])
+    assert lane.close(timeout=10.0)
+    s = lane.stats()
+    # Second flush delivered BOTH records: 2 produced - 0 undelivered = 2,
+    # not the per-batch 1 - 0 the old subtraction would have credited on
+    # top of a phantom first-batch loss.
+    assert s["annotated"] == 2
 
 
 def test_lane_close_is_idempotent_and_latching():

@@ -58,6 +58,28 @@ def test_encode_property_parity(texts):
                                   np.asarray(want.counts))
 
 
+_PAR = HashingTfIdfFeaturizer(num_features=1000, parallel_workers=3,
+                              parallel_min_rows=1)
+_PAR_PY = _twin(_PAR)
+_PAR_PY.parallel_workers, _PAR_PY.parallel_min_rows = 3, 1
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(_text, min_size=1, max_size=12),
+       st.sampled_from([None, 4, 16]))
+def test_parallel_encode_property_parity(texts, max_tokens):
+    """Tentpole pin: the thread-pool sharded encode (native batch-shard
+    entry points AND the pure-Python chunked fallback) is byte-identical to
+    the serial path on arbitrary unicode, including the truncation rule."""
+    want = _TWIN.encode(texts, batch_size=16, max_tokens=max_tokens)
+    for feat in (_PAR, _PAR_PY):
+        got = feat.encode(texts, batch_size=16, max_tokens=max_tokens)
+        np.testing.assert_array_equal(np.asarray(got.ids),
+                                      np.asarray(want.ids))
+        np.testing.assert_array_equal(np.asarray(got.counts),
+                                      np.asarray(want.counts))
+
+
 @settings(max_examples=150, deadline=None)
 @given(_text)
 def test_json_path_property_parity(text):

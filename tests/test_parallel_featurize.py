@@ -1,0 +1,135 @@
+"""Thread-pool sharded featurization (featurize/parallel.py): the parallel
+encode paths — native batch-shard entry points and the pure-Python chunked
+fallback — must be byte-identical to the serial paths they accelerate, under
+every dtype/truncation/empty-batch corner the serial contract has.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.featurize import native as native_mod
+from fraud_detection_tpu.featurize import parallel
+from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+
+TEXTS = (
+    [f"urgent verify account {i} now or pay the processing fee İK" * (i % 5 + 1)
+     for i in range(600)]
+    + ["", "   ", "a  b   c", "ALL CAPS 123 $$$", "café naïve ümlaut 🎉",
+       "word " * 400 + "tail"]
+)
+
+
+def _feat(workers, num_features=10000, native=True, **kw):
+    feat = HashingTfIdfFeaturizer(num_features=num_features,
+                                  parallel_workers=workers,
+                                  parallel_min_rows=8, **kw)
+    if not native:
+        feat._native_tried = True
+        feat._native = None
+    return feat
+
+
+def _assert_batches_equal(a, b):
+    assert a.ids.dtype == b.ids.dtype and a.counts.dtype == b.counts.dtype
+    np.testing.assert_array_equal(np.asarray(a.ids), np.asarray(b.ids))
+    np.testing.assert_array_equal(np.asarray(a.counts), np.asarray(b.counts))
+
+
+def test_shard_bounds_cover_range_in_order():
+    assert parallel.shard_bounds(0, 4) == []
+    assert parallel.shard_bounds(3, 4) == [(0, 1), (1, 2), (2, 3)]
+    bounds = parallel.shard_bounds(1000, 7)
+    assert bounds[0][0] == 0 and bounds[-1][1] == 1000
+    for (_, hi), (lo, _) in zip(bounds, bounds[1:]):
+        assert hi == lo
+
+
+def test_resolve_workers_precedence(monkeypatch):
+    assert parallel.resolve_workers(3) == 3
+    assert parallel.resolve_workers(0) == 1          # floored
+    monkeypatch.setenv("FRAUD_TPU_FEAT_WORKERS", "5")
+    assert parallel.resolve_workers(None) == 5
+    monkeypatch.setenv("FRAUD_TPU_FEAT_WORKERS", "junk")
+    assert parallel.resolve_workers(None) >= 1       # falls to cpu count
+
+
+def test_small_batches_stay_serial():
+    feat = HashingTfIdfFeaturizer(num_features=1000, parallel_workers=4,
+                                  parallel_min_rows=256)
+    calls = []
+    feat._encode_workers = lambda: calls.append(1) or 4
+    feat.encode(["tiny batch"], batch_size=4)
+    assert calls == [], "a 1-row batch must not consult the pool at all"
+
+
+@pytest.mark.skipif(not native_mod.available(),
+                    reason="native toolchain unavailable")
+class TestNativeSharded:
+    def test_parity_with_serial_native(self):
+        got = _feat(4).encode(TEXTS, batch_size=1024)
+        want = _feat(1).encode(TEXTS, batch_size=1024)
+        _assert_batches_equal(got, want)
+        assert got.ids.dtype == np.int16  # wire dtypes straight from C++
+
+    def test_parity_int32_wide_feature_space(self):
+        got = _feat(3, num_features=40000).encode(TEXTS, batch_size=1024)
+        want = _feat(1, num_features=40000).encode(TEXTS, batch_size=1024)
+        _assert_batches_equal(got, want)
+        assert got.ids.dtype == np.int32
+
+    def test_parity_under_truncation(self):
+        # max_tokens far below the long rows' widths: the keep-top-L rule
+        # (ties toward the lower bucket id) must match across shards.
+        got = _feat(4).encode(TEXTS, batch_size=1024, max_tokens=16)
+        want = _feat(1).encode(TEXTS, batch_size=1024, max_tokens=16)
+        _assert_batches_equal(got, want)
+
+    def test_parity_binary_tf(self):
+        got = _feat(4, binary_tf=True).encode(TEXTS, batch_size=1024)
+        want = _feat(1, binary_tf=True).encode(TEXTS, batch_size=1024)
+        _assert_batches_equal(got, want)
+
+    def test_shard_width_barrier_sets_global_length(self):
+        # One very wide row in the LAST shard must widen every shard's rows.
+        texts = ["short text"] * 500 + [" ".join(f"w{i}" for i in range(900))]
+        got = _feat(4).encode(texts, batch_size=512)
+        want = _feat(1).encode(texts, batch_size=512)
+        assert got.ids.shape == want.ids.shape
+        _assert_batches_equal(got, want)
+
+    def test_concurrent_encodes_share_one_handle(self):
+        # Two threads (engine + shadow scorer shape) encode through ONE
+        # featurizer concurrently; shard calls never touch handle state, so
+        # both must come out byte-correct.
+        feat = _feat(2)
+        want = _feat(1).encode(TEXTS, batch_size=1024)
+        results, errors = [None, None], []
+
+        def run(slot):
+            try:
+                results[slot] = feat.encode(TEXTS, batch_size=1024)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+        for got in results:
+            _assert_batches_equal(got, want)
+
+
+def test_python_chunked_parity():
+    got = _feat(4, native=False).encode(TEXTS, batch_size=1024)
+    want = _feat(1, native=False).encode(TEXTS, batch_size=1024)
+    _assert_batches_equal(got, want)
+
+
+def test_python_chunked_parity_under_truncation():
+    got = _feat(3, native=False).encode(TEXTS, batch_size=1024, max_tokens=8)
+    want = _feat(1, native=False).encode(TEXTS, batch_size=1024, max_tokens=8)
+    _assert_batches_equal(got, want)

@@ -273,6 +273,148 @@ def test_hotswap_candidates_inherit_ladder_prewarm(pipeline):
 
 
 # ---------------------------------------------------------------------------
+# cost-aware ladder (tentpole c): geometry from the measured cost curve
+# ---------------------------------------------------------------------------
+
+def test_ladder_candidates_geometry():
+    from fraud_detection_tpu.sched import ladder_candidates
+
+    assert ladder_candidates(1024) == (64, 128, 256, 512, 1024)
+    assert ladder_candidates(64) == (16, 32, 64)
+    assert ladder_candidates(16) == (16,)
+    # candidates are a superset of the fixed default geometry
+    assert set(default_ladder(1024)) <= set(ladder_candidates(1024))
+
+
+def test_cost_aware_ladder_flat_curve_collapses():
+    """A flat cost curve (fixed dispatch overhead dominates) means padding
+    up is free — every sub-rung is dropped."""
+    from fraud_detection_tpu.sched import cost_aware_ladder
+
+    costs = {64: 0.010, 128: 0.010, 256: 0.011, 512: 0.010, 1024: 0.011}
+    assert cost_aware_ladder(costs, 1024) == (1024,)
+
+
+def test_cost_aware_ladder_linear_curve_keeps_every_probe():
+    from fraud_detection_tpu.sched import cost_aware_ladder
+
+    costs = {64: 0.001, 128: 0.002, 256: 0.004, 512: 0.008, 1024: 0.016}
+    assert cost_aware_ladder(costs, 1024) == (64, 128, 256, 512, 1024)
+
+
+def test_cost_aware_ladder_knee_curve_keeps_the_cheap_side():
+    """Flat up to 256 then linear: the flat region collapses into the 256
+    rung, the steep region survives."""
+    from fraud_detection_tpu.sched import cost_aware_ladder
+
+    costs = {64: 0.004, 128: 0.004, 256: 0.004, 512: 0.008, 1024: 0.016}
+    assert cost_aware_ladder(costs, 1024) == (256, 512, 1024)
+
+
+def test_cost_aware_ladder_validates():
+    from fraud_detection_tpu.sched import cost_aware_ladder
+
+    with pytest.raises(ValueError, match="min_ratio"):
+        cost_aware_ladder({64: 1.0}, 64, min_ratio=1.0)
+    with pytest.raises(ValueError, match="costs"):
+        cost_aware_ladder({}, 64)
+    # batch_size absent from the probe set: largest measured rung is the top
+    assert cost_aware_ladder({16: 0.1, 64: 0.4}, 1024) == (16, 64)
+
+
+def test_measure_rung_costs_excludes_compile(pipeline):
+    """Per-rung costs are steady-state medians: the compile-carrying first
+    run is untimed, so a rung's recorded cost must be a small fraction of
+    its cold wall (compiles are seconds, steady LR batches are ms)."""
+    from fraud_detection_tpu.models import linear as linear_mod
+    from fraud_detection_tpu.sched import measure_rung_costs
+
+    text = "hello this is a perfectly ordinary dialogue about appointments"
+    try:
+        t0 = time.monotonic()
+        costs = measure_rung_costs(pipeline, (16, 64), texts=[text])
+        wall = time.monotonic() - t0
+        assert set(costs) == {16, 64}
+        for c in costs.values():
+            assert 0 < c < wall / 2    # steady median ≪ total incl. compiles
+        # measurement compiled the probe shapes: the hot path stays clean
+        compiled = linear_mod._prob_encoded._cache_size()
+        for n in (1, 15, 16, 40, 64):
+            pipeline.predict([text] * n)
+        assert linear_mod._prob_encoded._cache_size() == compiled
+    finally:
+        pipeline.pad_ladder = None
+
+
+def test_scheduler_prewarm_derives_cost_aware_geometry(pipeline):
+    """Default config (no explicit buckets): prewarm measures candidates,
+    derives the ladder from the cost curve, records the table for health(),
+    and keeps the governor floor aligned."""
+    sched = AdaptiveScheduler(SchedulerConfig(), batch_size=64)
+    try:
+        n = sched.prewarm(pipeline)
+        assert n == len(sched.buckets)
+        assert set(sched.ladder_costs) == {16, 32, 64}   # candidates measured
+        assert set(sched.buckets) <= {16, 32, 64}
+        assert sched.buckets[-1] == 64                   # top rung pinned
+        assert sched.governor.min_budget == sched.buckets[0]
+        snap = sched.snapshot()
+        assert set(snap["ladder_cost_ms"]) == {"16", "32", "64"}
+        assert all(v > 0 for v in snap["ladder_cost_ms"].values())
+        json.dumps(snap)
+        # pipeline adopted the SELECTED geometry
+        assert pipeline.pad_ladder == sched.buckets
+    finally:
+        pipeline.pad_ladder = None
+
+
+def test_scheduler_prewarm_explicit_buckets_pin_geometry(pipeline):
+    """Operator-pinned buckets: geometry untouched, costs still measured
+    (the health table is evidence either way)."""
+    sched = AdaptiveScheduler(SchedulerConfig(buckets=(16, 64)),
+                              batch_size=64)
+    try:
+        sched.prewarm(pipeline)
+        assert sched.buckets == (16, 64)
+        assert set(sched.ladder_costs) == {16, 64}
+    finally:
+        pipeline.pad_ladder = None
+
+
+def test_hotswap_reuses_measured_costs_for_candidates(pipeline):
+    """Tentpole pin: a HotSwapPipeline measures ONCE on the active model;
+    swap candidates inherit ladder + cached costs and only compile — no
+    re-bench (configure_ladder(costs=...) + prewarm path)."""
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+    from fraud_detection_tpu.registry.hotswap import HotSwapPipeline
+    from fraud_detection_tpu.sched import batcher as batcher_mod
+
+    text = "hello this is a perfectly ordinary dialogue about appointments"
+    hot = HotSwapPipeline(pipeline, version=1, prewarm_texts=[text])
+    sched = AdaptiveScheduler(SchedulerConfig(), batch_size=64)
+    try:
+        sched.prewarm(hot)
+        assert hot.ladder_costs == sched.ladder_costs
+        assert hot.pad_buckets == sched.buckets
+        measured = []
+        orig = batcher_mod.measure_rung_costs
+        batcher_mod.measure_rung_costs = (
+            lambda *a, **k: measured.append(1) or orig(*a, **k))
+        try:
+            candidate = synthetic_demo_pipeline(
+                batch_size=64, n=300, seed=3, num_features=2048,
+                corpus_kwargs=dict(hard_fraction=0.0, label_noise=0.0))
+            hot.swap(candidate, version=2)     # prewarm compiles, no bench
+        finally:
+            batcher_mod.measure_rung_costs = orig
+        assert measured == [], "swap candidate re-benched the ladder"
+        assert candidate.pad_ladder == sched.buckets
+        assert hot.ladder_costs == sched.ladder_costs  # cache survives swap
+    finally:
+        pipeline.pad_ladder = None
+
+
+# ---------------------------------------------------------------------------
 # admission control + shedding
 # ---------------------------------------------------------------------------
 
@@ -577,6 +719,7 @@ def test_row_latency_merges_across_incarnations():
 SCHED_BLOCK_SCHEMA = {
     "batch_deadline_ms": (type(None), int, float),
     "buckets": (list,),
+    "ladder_cost_ms": (type(None), dict),   # measured at prewarm; None before
     "slo": (dict,),
     "admission": (dict,),
     "governor": (dict,),
@@ -722,6 +865,10 @@ def test_serve_cli_scheduler_end_to_end(capsys):
     assert stats["processed"] == 500
     sched = stats["health"]["sched"]
     assert sched["admission"]["policy"] == "reject"
+    # The startup measurement's geometry + cost table reach the per-worker
+    # scheduler (serve.py pins measured buckets back into the config).
+    assert sched["ladder_cost_ms"], "worker scheduler lost the cost table"
+    assert set(sched["buckets"]) <= {int(k) for k in sched["ladder_cost_ms"]}
     assert sched["slo"]["count"] + stats["shed"] == 500
     # Exact accounting through the CLI: classified + shed covers the demo.
     assert stats["shed"] == sum(sched["admission"]["shed"].values())
