@@ -100,6 +100,58 @@ def install_sigterm_handler():
     return signal.signal(signal.SIGTERM, _raise_interrupted)
 
 
+def append_bench_trend(line: dict, path=None, *, keep: int = 500,
+                       now=None):
+    """ROADMAP "Bench trend tracking": append ONE compact record per bench
+    round to ``reports/bench_trend.json`` — headline + featurize + ladder
+    table — so cross-round regressions diff in a few lines instead of
+    whole artifacts.
+
+    The file is a JSON array, rewritten atomically each round and bounded
+    to the last ``keep`` records; a corrupt/legacy file resets rather than
+    killing the bench. ``BENCH_TREND`` overrides the path (``0`` disables;
+    tests point it at tmp). Returns the appended record, or None when
+    disabled/the round produced no headline."""
+    path = path if path is not None else os.environ.get(
+        "BENCH_TREND", os.path.join("reports", "bench_trend.json"))
+    if not path or path == "0":
+        return None
+    if line.get("value") is None:
+        return None            # no headline landed: nothing to trend
+    sweep = line.get("load_sweep") or {}
+    record = {
+        "time": round(time.time(), 1) if now is None else now,
+        "metric": line.get("metric"),
+        "value": line.get("value"),
+        "vs_baseline": line.get("vs_baseline"),
+        "batch_latency_ms": line.get("batch_latency_ms"),
+        "featurize_rows_per_sec": line.get("featurize_encode_rows_per_sec"),
+        "ladder": sweep.get("ladder"),
+        "capacity_est_per_s": sweep.get("capacity_est_per_s"),
+        "max_load_meeting_target_p99_per_s": sweep.get(
+            "max_load_meeting_target_p99_per_s"),
+    }
+    trend = []
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        if isinstance(loaded, list):
+            trend = loaded
+    except (OSError, ValueError):
+        pass
+    trend.append(record)
+    trend = trend[-keep:]
+    tmp = f"{path}.tmp"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(trend, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        return None            # trend tracking must never kill the bench
+    return record
+
+
 class BenchHarness:
     """One artifact dict, grown section by section, never lost.
 
@@ -1267,13 +1319,20 @@ def _cli_value(argv, flag):
     return None
 
 
+# The harness of the round in flight — the __main__ wrapper appends the
+# bench-trend record from it in a finally, so a budget/SIGTERM cut still
+# trends whatever the partial artifact captured.
+_ACTIVE_HARNESS = None
+
+
 def main() -> int:
+    global _ACTIVE_HARNESS
     from fraud_detection_tpu.data import generate_corpus
 
     argv = sys.argv[1:]
     budget_raw = _cli_value(argv, "--budget-s") or os.environ.get(
         "BENCH_BUDGET_S")
-    harness = BenchHarness(
+    harness = _ACTIVE_HARNESS = BenchHarness(
         partial_path=(_cli_value(argv, "--partial-file")
                       or os.environ.get("BENCH_PARTIAL",
                                         "bench_partial.json")),
@@ -1429,11 +1488,18 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    rc = 1
     try:
-        sys.exit(main())
-    except (BenchInterrupted, BudgetExceeded):
-        # SIGTERM between sections (the in-section path already flushed),
-        # or an alarm landing in the disarm window: the partial artifact
-        # and the last printed line stand; exit cleanly so the driver
-        # records what was captured.
-        sys.exit(0)
+        try:
+            rc = main()
+        except (BenchInterrupted, BudgetExceeded):
+            # SIGTERM between sections (the in-section path already
+            # flushed), or an alarm landing in the disarm window: the
+            # partial artifact and the last printed line stand; exit
+            # cleanly so the driver records what was captured.
+            rc = 0
+    finally:
+        # Trend record per round, cut or not (ROADMAP bench-trend item).
+        if _ACTIVE_HARNESS is not None:
+            append_bench_trend(_ACTIVE_HARNESS.line)
+    sys.exit(rc)

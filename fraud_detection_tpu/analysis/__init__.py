@@ -1,0 +1,23 @@
+"""flightcheck — first-party static analysis for the framework's own
+invariants (docs/static_analysis.md).
+
+Three rule families, all pure-AST (nothing under analysis is imported or
+executed):
+
+* concurrency lint (FC101/FC102/FC103): lock-order cycles, unguarded
+  writes to thread-shared attributes, and drift between the thread map,
+  the entry-point registry, and utils/racecheck.py's instrumentation list;
+* JAX recompile/sync lint (FC201-FC204): jit-in-function recompiles,
+  Python branches on traced values, hot-loop device syncs, and literal
+  batch dims that bypass the prewarmed padding ladder;
+* health-schema lint (FC301): health()/snapshot() key sets cross-checked
+  against the contract-test ``*_SCHEMA`` dicts, so schema drift fails lint
+  before it fails a soak.
+
+CLI: ``python -m fraud_detection_tpu.analysis`` (exit 0 = clean tree).
+Suppressions: ``# flightcheck: ignore[RULE] — reason`` on (or right above)
+the flagged line.
+"""
+
+from fraud_detection_tpu.analysis.core import (Finding, RULES,  # noqa: F401
+                                               run_analysis)

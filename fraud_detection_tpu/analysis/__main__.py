@@ -1,0 +1,80 @@
+"""``python -m fraud_detection_tpu.analysis`` — the flightcheck CLI.
+
+Walks the package, runs every rule, prints findings as
+``path:line: RULE[name]: message`` (stable order: path, line, rule), and
+exits nonzero when any survive pragma suppression — the CI ``flightcheck``
+job is exactly this command. See docs/static_analysis.md for the rule
+catalog and the pragma syntax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from fraud_detection_tpu.analysis.core import RULES, run_analysis
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fraud_detection_tpu.analysis",
+        description="flightcheck: first-party static analysis "
+                    "(concurrency lint, JAX recompile lint, health-schema "
+                    "lint)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--root", default=None,
+                        help="package root to analyze (default: the "
+                             "installed fraud_detection_tpu package)")
+    parser.add_argument("--tests", default=None,
+                        help="tests/ directory holding the *_SCHEMA "
+                             "contract dicts (default: sibling of the "
+                             "package root)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (name, summary) in sorted(RULES.items()):
+            print(f"{rule}  {name:<24} {summary}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule ids: {sorted(unknown)} "
+                  f"(known: {sorted(RULES)})", file=sys.stderr)
+            return 2
+
+    tests_dir = args.tests
+    if tests_dir is not None and not os.path.isdir(tests_dir):
+        print(f"--tests {tests_dir!r} is not a directory", file=sys.stderr)
+        return 2
+
+    findings, suppressed, n_files = run_analysis(
+        package_root=args.root, tests_dir=tests_dir, rules=rules)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message} for f in findings],
+            "suppressed": suppressed,
+            "files": n_files,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"flightcheck: {len(findings)} finding(s), "
+              f"{suppressed} suppressed by pragma, {n_files} files analyzed")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

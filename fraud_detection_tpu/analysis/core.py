@@ -1,0 +1,171 @@
+"""flightcheck core: finding model, pragma handling, source loading, runner.
+
+The analyzers are pure-AST (stdlib ``ast`` only — no runtime imports of the
+modules under analysis), so the CLI runs anywhere the source tree exists,
+including a CI job with no JAX installed beyond what the package import
+itself needs.
+
+Suppression: a finding is dropped when the flagged line — or the line
+directly above it — carries a ``# flightcheck: ignore[RULE]`` pragma naming
+the finding's rule (comma-separate for several:
+``# flightcheck: ignore[FC102,FC203] — why``). Pragmas are deliberate
+false-positive records; the trailing free text should say why, and the
+suppressed count is reported so silent pragma creep is visible.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+_PRAGMA_RE = re.compile(r"#\s*flightcheck:\s*ignore\[([A-Z0-9_,\s]+)\]")
+
+#: Rule catalog: id -> (name, one-line summary). docs/static_analysis.md
+#: carries the long-form descriptions; tests pin that the two stay in sync.
+RULES: Dict[str, tuple] = {
+    "FC101": ("lock-order",
+              "inconsistent lock acquisition order (potential deadlock "
+              "cycle in the class lock graph)"),
+    "FC102": ("unguarded-shared-write",
+              "write to a thread-shared attribute outside any lock region"),
+    "FC103": ("thread-registry-drift",
+              "thread spawn site, entry-point registry, and racecheck "
+              "instrumentation list disagree"),
+    "FC201": ("jit-in-function",
+              "jax.jit called inside a function body — a fresh compiled "
+              "callable (and XLA compile) per invocation"),
+    "FC202": ("traced-branch",
+              "Python if/while on a traced value inside a jitted function"),
+    "FC203": ("host-sync",
+              ".item()/float()/int() device sync inside a hot-loop "
+              "function"),
+    "FC204": ("ladder-bypass",
+              "literal batch dim at a jit/predict call site that is not a "
+              "prewarmed padding-ladder rung"),
+    "FC301": ("health-schema-drift",
+              "health()/snapshot() key set disagrees with the contract "
+              "test schema"),
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}"
+                f"[{RULES[self.rule][0]}]: {self.message}")
+
+
+@dataclass
+class SourceFile:
+    """One parsed module plus its pragma map."""
+
+    path: str               # absolute
+    relpath: str            # package-relative posix path (engine keys use it)
+    text: str
+    tree: ast.Module
+    ignores: Dict[int, Set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str, relpath: str) -> Optional["SourceFile"]:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            tree = ast.parse(text, filename=path)
+        except (OSError, SyntaxError):
+            return None
+        ignores: Dict[int, Set[str]] = {}
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                ignores[lineno] = rules
+        return cls(path=path, relpath=relpath, text=text, tree=tree,
+                   ignores=ignores)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for at in (line, line - 1):
+            if rule in self.ignores.get(at, ()):
+                return True
+        return False
+
+
+def load_package(root: str, *,
+                 exclude: Sequence[str] = ("analysis",)) -> List[SourceFile]:
+    """Every ``.py`` under the package ``root``, parsed; ``exclude`` prunes
+    top-level subpackages (the analyzer doesn't lint itself — its fixtures
+    would be findings)."""
+    files: List[SourceFile] = []
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        top = rel_dir.split(os.sep)[0]
+        if top in exclude or "__pycache__" in dirpath:
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            if top in exclude:
+                continue
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            sf = SourceFile.load(path, rel)
+            if sf is not None:
+                files.append(sf)
+    return files
+
+
+def filter_suppressed(files_by_rel: Dict[str, SourceFile],
+                      findings: Iterable[Finding]) -> tuple:
+    """Split raw findings into (kept, n_suppressed) honoring pragmas."""
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        sf = files_by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def run_analysis(package_root: Optional[str] = None,
+                 tests_dir: Optional[str] = None,
+                 rules: Optional[Set[str]] = None) -> tuple:
+    """Run every analyzer over the package tree.
+
+    Returns ``(findings, n_suppressed, n_files)`` with pragma suppression
+    applied. ``rules`` restricts to a subset of rule ids (a finding whose
+    rule is excluded is neither reported nor counted)."""
+    from fraud_detection_tpu.analysis import concurrency, health, jaxlint
+    from fraud_detection_tpu.analysis import threads as threadmap
+
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    if tests_dir is None:
+        cand = os.path.join(os.path.dirname(package_root), "tests")
+        tests_dir = cand if os.path.isdir(cand) else None
+
+    files = load_package(package_root)
+    by_rel = {f.relpath: f for f in files}
+
+    raw: List[Finding] = []
+    raw += concurrency.analyze(files)
+    raw += jaxlint.analyze(files)
+    raw += threadmap.analyze(files, package_root=package_root)
+    raw += health.analyze(files, tests_dir=tests_dir)
+
+    if rules is not None:
+        raw = [f for f in raw if f.rule in rules]
+    findings, suppressed = filter_suppressed(by_rel, raw)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, suppressed, len(files)

@@ -1,0 +1,191 @@
+"""The framework's concurrency map — the single source of truth flightcheck
+lints against.
+
+Four interacting concurrent subsystems grew across PRs 1-4 (the sched/
+driver, the registry hot-swap RCU + shadow queue, the stream annotation
+lane, the featurize thread-pool shards over one C++ handle), and their
+threading contracts lived only in docstrings. This module states them as
+data:
+
+  * :data:`THREAD_SITES` — every ``threading.Thread`` / ``ThreadPoolExecutor``
+    construction site in the package. FC103 fails when code spawns a thread
+    this map doesn't know (or the map lists a thread that no longer exists):
+    an unregistered thread is an unaudited concurrency surface.
+  * :data:`THREAD_ENTRY_POINTS` — the functions those threads run, each
+    with the racecheck region that guards it (or ``None`` with a reason).
+    FC103 cross-checks the region names against
+    ``utils.racecheck.INSTRUMENTED_REGIONS`` so the static map and the
+    runtime detector can never drift apart.
+  * :data:`CONCURRENT_CLASSES` — per-class thread-role assignments feeding
+    the FC102 unguarded-shared-write rule: which methods run on which
+    thread, so a write without a lock is only flagged when two roles can
+    actually collide on the attribute.
+  * :data:`HOT_PATHS` — the per-batch serving functions where FC203/FC204
+    police device syncs and ladder-bypassing batch shapes.
+
+Adding a thread? Register it here (site + entry point + racecheck region),
+instrument the region in ``utils/racecheck.py``'s ``INSTRUMENTED_REGIONS``,
+and give the class a role map — the CLI fails the tree until all three
+agree (docs/static_analysis.md "Adding a thread").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import FrozenSet, Mapping, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Thread construction sites: (package-relative posix path, target callable
+# name as written at the construction site).
+# ---------------------------------------------------------------------------
+
+THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
+    # serve CLI: periodic health-file dumper ("health-writer").
+    ("app/serve.py", "loop"),
+    # serve CLI: one consumer-group worker per --workers.
+    ("app/serve.py", "run_worker"),
+    # Streamlit demo tab's background engine thread (target=engine.run).
+    ("app/ui.py", "run"),
+    # Model-lifecycle registry watcher ("lifecycle-watcher").
+    ("registry/promote.py", "loop"),
+    # Shadow candidate scorer ("shadow-scorer").
+    ("registry/shadow.py", "self._worker"),
+    # Async LLM annotation lane ("annotation-lane").
+    ("stream/annotations.py", "self._run"),
+    # Host featurization shard pool (ThreadPoolExecutor, prefix "featurize").
+    ("featurize/parallel.py", "ThreadPoolExecutor"),
+    # Sanitizer workload driver: hammer threads racing the shard ABI on
+    # purpose — TSan is the detector there, not racecheck.
+    ("native/san_driver.py", "hammer"),
+})
+
+
+@dataclass(frozen=True)
+class EntryPoint:
+    """One background-thread entry function and its runtime race coverage."""
+
+    thread: str                  # thread name / pool prefix
+    module: str                  # package-relative posix path
+    qualname: str                # Class.method or function name
+    racecheck: Optional[str]     # ExclusiveRegion/PairedCallChecker name
+    why_uncovered: str = ""      # required when racecheck is None
+
+
+THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
+    # The engine loop is the PRIMARY driver thread: one per worker.
+    EntryPoint("engine-driver", "stream/engine.py",
+               "StreamingClassifier.run", "StreamingClassifier.drive"),
+    # The scheduler rides the same driver thread; its region catches a
+    # second driver sneaking in through the scheduler surface.
+    EntryPoint("engine-driver", "sched/scheduler.py",
+               "AdaptiveScheduler.collect", "AdaptiveScheduler.drive"),
+    EntryPoint("health-writer", "app/serve.py", "loop", None,
+               "read-only: dumps health() snapshots, mutates nothing"),
+    EntryPoint("serve-worker", "app/serve.py", "run_worker", None,
+               "each worker drives ITS OWN engine; the engine's drive "
+               "region is the guard"),
+    EntryPoint("ui-stream", "app/ui.py", "StreamingClassifier.run",
+               "StreamingClassifier.drive"),
+    # The in-process broker's consumer is single-driver like the engine.
+    EntryPoint("engine-driver", "stream/broker.py",
+               "InProcessConsumer.poll_batch", "InProcessConsumer"),
+    EntryPoint("lifecycle-watcher", "registry/promote.py",
+               "LifecycleController.tick", "LifecycleController.watch"),
+    EntryPoint("shadow-scorer", "registry/shadow.py",
+               "ShadowScorer._worker", "ShadowScorer.worker"),
+    EntryPoint("annotation-lane", "stream/annotations.py",
+               "AsyncAnnotationLane._run", None,
+               "single worker by construction (one thread started in "
+               "__init__, never respawned); queue + counters under _cv"),
+    EntryPoint("featurize", "featurize/parallel.py",
+               "encode_sharded_native", "NativeFeaturizer"),
+    EntryPoint("san-hammer", "native/san_driver.py", "hammer", None,
+               "deliberately racing workload — the sanitizer runtime "
+               "(ASan/TSan) is the detector"),
+)
+
+
+# ---------------------------------------------------------------------------
+# Thread roles per concurrent class (the FC102 scope).
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ClassSpec:
+    """Thread-role map for one class with a multi-thread surface.
+
+    ``any_thread``: methods callable from arbitrary threads while the
+    primary thread runs (health pollers, non-blocking submitters).
+    ``workers``: role name -> methods that EXECUTE on that role's thread
+    (reachability through self-calls is computed by the analyzer). Every
+    unlisted method runs on the primary ("main") thread.
+    """
+
+    any_thread: FrozenSet[str] = frozenset()
+    workers: Mapping[str, FrozenSet[str]] = field(default_factory=dict)
+
+
+def _spec(any_thread=(), **workers) -> ClassSpec:
+    return ClassSpec(any_thread=frozenset(any_thread),
+                     workers={k: frozenset(v) for k, v in workers.items()})
+
+
+CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
+    # Engine: single-driver loop; stop()/health() are the documented
+    # cross-thread surface (serve.py Ctrl-C + --health-file poller).
+    "stream/engine.py::StreamingClassifier": _spec(
+        any_thread=("stop", "health", "annotation_stats")),
+    # Annotation lane: one worker drains the queue; stats() polls cross-
+    # thread; submit() comes from the engine driver.
+    "stream/annotations.py::AsyncAnnotationLane": _spec(
+        any_thread=("stats",),
+        annotation_lane=("_run",)),
+    # Shadow scorer: worker rescopes batches; the engine driver calls
+    # wants()/submit(); the lifecycle watcher sets/clears candidates;
+    # health pollers snapshot.
+    "registry/shadow.py::ShadowScorer": _spec(
+        any_thread=("snapshot", "wants", "submit", "candidate_version",
+                    "active"),
+        shadow_scorer=("_worker",),
+        lifecycle_watcher=("set_candidate", "clear_candidate")),
+    # Hot swap: readers are lock-free RCU from any thread; the watcher
+    # thread swaps/stages; the engine driver configures the ladder.
+    "registry/hotswap.py::HotSwapPipeline": _spec(
+        any_thread=("predict_async", "predict_json_async", "predict",
+                    "predict_one", "batch_size", "active_version",
+                    "active_pipeline", "staged_version", "staged_pipeline",
+                    "lifecycle_snapshot", "pad_buckets", "ladder_costs"),
+        lifecycle_watcher=("swap", "stage", "promote_staged",
+                           "discard_staged", "prewarm")),
+    # Lifecycle controller: tick() runs on the watcher thread; rollback()
+    # is the operator's (main-thread) overrule.
+    "registry/promote.py::LifecycleController": _spec(
+        lifecycle_watcher=("tick",)),
+    # Scheduler: collect/admit/observe/prewarm are driver-only (the
+    # ExclusiveRegion contract); snapshot() serves health pollers.
+    "sched/scheduler.py::AdaptiveScheduler": _spec(
+        any_thread=("snapshot",)),
+    # Native featurizer: shard_* entry points run on the featurize pool
+    # over one shared read-only handle; encode paths hold _call_lock.
+    "featurize/native.py::NativeFeaturizer": _spec(
+        featurize=("shard_begin", "shard_fill_into", "shard_destroy")),
+}
+
+
+# ---------------------------------------------------------------------------
+# Hot-loop functions (FC203 host-sync / FC204 ladder-bypass scope): the
+# per-batch serving path, where one stray device sync or unwarmed shape
+# costs throughput on EVERY batch.
+# ---------------------------------------------------------------------------
+
+HOT_PATHS: FrozenSet[str] = frozenset({
+    "stream/engine.py::StreamingClassifier._dispatch",
+    "stream/engine.py::StreamingClassifier._dispatch_raw_json",
+    "stream/engine.py::StreamingClassifier._finish",
+    "stream/engine.py::StreamingClassifier._deliver",
+    "stream/engine.py::StreamingClassifier._assemble_frames_native",
+    "stream/engine.py::StreamingClassifier._submit_annotations",
+    "stream/engine.py::StreamingClassifier._submit_shadow",
+    "sched/scheduler.py::AdaptiveScheduler.collect",
+    "sched/scheduler.py::AdaptiveScheduler.admit",
+    "sched/scheduler.py::AdaptiveScheduler.observe_batch",
+})

@@ -1,0 +1,270 @@
+"""FC201-FC204 — JAX recompile / device-sync lint.
+
+The serving hot path stays fast only while every batch hits an
+already-compiled XLA program and never blocks on a device scalar. Four
+statically-checkable ways to break that:
+
+* **FC201** ``jax.jit(...)`` evaluated inside a function body builds a
+  FRESH jitted callable per invocation — its compile cache dies with it, so
+  every call recompiles. jit belongs at module/class scope (or behind an
+  explicit cache).
+* **FC202** a Python ``if``/``while`` on a traced parameter inside a jitted
+  function raises ``TracerBoolConversionError`` at best and silently forces
+  a recompile-per-value via static promotion at worst. Branches on
+  ``static_argnames``/``static_argnums`` parameters and structural
+  ``is None`` checks are fine and exempt.
+* **FC203** ``.item()`` / ``float(x[i])`` / ``int(x[i])`` in a hot-loop
+  function is a per-row device sync — the engine's paths convert whole
+  batches with ``.tolist()`` once instead (stream/engine.py). Scope:
+  :data:`~fraud_detection_tpu.analysis.entrypoints.HOT_PATHS`.
+* **FC204** a literal batch dimension at a predict/jit call site in a hot
+  function that is not a padding-ladder rung shape: the ladder prewarms
+  power-of-two rungs (sched/batcher.py — ``default_ladder`` /
+  ``ladder_candidates`` emit power-of-two geometries for the power-of-two
+  batch sizes serve/bench run), so a stray literal like 37 pads to an
+  unwarmed shape and compiles on the hot path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from fraud_detection_tpu.analysis.core import Finding
+from fraud_detection_tpu.analysis.entrypoints import HOT_PATHS
+
+_PREDICT_FNS = {"predict", "predict_async", "predict_json_async",
+                "predict_one"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """`jax.jit` or bare `jit` reference."""
+    if isinstance(node, ast.Attribute):
+        return (node.attr == "jit" and isinstance(node.value, ast.Name)
+                and node.value.id == "jax")
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """None when ``fn`` is not jitted; else the set of STATIC parameter
+    names (from static_argnames/static_argnums across jax.jit and
+    functools.partial(jax.jit, ...) decorator forms)."""
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return set()
+        if not isinstance(dec, ast.Call):
+            continue
+        callee = dec.func
+        is_partial = (isinstance(callee, ast.Name) and callee.id == "partial"
+                      ) or (isinstance(callee, ast.Attribute)
+                            and callee.attr == "partial")
+        wraps_jit = any(_is_jax_jit(a) for a in dec.args)
+        if not (_is_jax_jit(callee) or (is_partial and wraps_jit)):
+            continue
+        static: Set[str] = set()
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                static |= _str_elements(kw.value)
+            elif kw.arg == "static_argnums":
+                for idx in _int_elements(kw.value):
+                    if 0 <= idx < len(params):
+                        static.add(params[idx])
+        return static
+    return None
+
+
+def _str_elements(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+def _int_elements(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def analyze(files: Sequence, *,
+            hot_paths: Optional[Set[str]] = None) -> List[Finding]:
+    hot_paths = HOT_PATHS if hot_paths is None else hot_paths
+    findings: List[Finding] = []
+    for sf in files:
+        findings += _jit_in_function(sf)
+        findings += _traced_branches(sf)
+        findings += _hot_path_rules(sf, hot_paths)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# FC201
+# ---------------------------------------------------------------------------
+
+def _jit_in_function(sf) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, in_function: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = in_function
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorators evaluate at def time in the ENCLOSING scope
+                for dec in child.decorator_list:
+                    walk(dec, in_function)
+                for stmt in child.body:
+                    walk(stmt, True)
+                continue
+            if (in_function and isinstance(child, ast.Call)
+                    and _is_jax_jit(child.func)):
+                findings.append(Finding(
+                    "FC201", sf.relpath, child.lineno,
+                    "jax.jit(...) evaluated inside a function body builds "
+                    "a fresh compiled callable (and pays the XLA compile) "
+                    "on every invocation — hoist it to module scope or "
+                    "cache the jitted callable"))
+            walk(child, inner)
+
+    walk(sf.tree, False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# FC202
+# ---------------------------------------------------------------------------
+
+def _traced_branches(sf) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        static = _jit_decoration(node)
+        if static is None:
+            continue
+        params = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                  + node.args.kwonlyargs)}
+        traced = params - static - {"self"}
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, (ast.If, ast.While)):
+                continue
+            hit = _traced_name_in_test(stmt.test, traced)
+            if hit is not None:
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                findings.append(Finding(
+                    "FC202", sf.relpath, stmt.lineno,
+                    f"Python `{kind}` on traced parameter {hit!r} inside "
+                    f"jitted function {node.name!r} — use jnp.where/"
+                    f"lax.cond/lax.while_loop, or mark the argument "
+                    f"static"))
+    return findings
+
+
+#: Attribute accesses that are STATIC at trace time — branching on them is
+#: shape-level Python, not a traced-value branch.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _traced_name_in_test(test: ast.AST, traced: Set[str]) -> Optional[str]:
+    """First traced name whose VALUE the test depends on; None when the
+    branch is structural — ``x is None`` checks, and ``x.shape``/``x.ndim``/
+    ``len(x)``-style accesses that are static under tracing."""
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return None
+    static_occurrences = set()
+    for sub in ast.walk(test):
+        if (isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS
+                and isinstance(sub.value, ast.Name)):
+            static_occurrences.add(id(sub.value))
+        elif (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len" and sub.args
+                and isinstance(sub.args[0], ast.Name)):
+            static_occurrences.add(id(sub.args[0]))
+    for sub in ast.walk(test):
+        if (isinstance(sub, ast.Name) and sub.id in traced
+                and id(sub) not in static_occurrences):
+            return sub.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# FC203 / FC204
+# ---------------------------------------------------------------------------
+
+def _hot_path_rules(sf, hot_paths: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in sf.tree.body:
+        if isinstance(cls, ast.ClassDef):
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    key = f"{sf.relpath}::{cls.name}.{fn.name}"
+                    if key in hot_paths:
+                        findings += _scan_hot_function(sf, key, fn)
+        elif isinstance(cls, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = f"{sf.relpath}::{cls.name}"
+            if key in hot_paths:
+                findings += _scan_hot_function(sf, key, cls)
+    return findings
+
+
+def _scan_hot_function(sf, key: str, fn: ast.AST) -> List[Finding]:
+    findings: List[Finding] = []
+    where = key.split("::", 1)[1]
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        # .item(): always a device sync when it matters, never batch-cheap.
+        if isinstance(callee, ast.Attribute) and callee.attr == "item":
+            findings.append(Finding(
+                "FC203", sf.relpath, node.lineno,
+                f"{where}: .item() in a hot-loop function is a per-row "
+                f"device sync — convert the whole batch once with "
+                f".tolist() / np.asarray outside the row loop"))
+        # float(x[i]) / int(x[i]): per-element scalar conversion in a row
+        # loop (the numpy/JAX scalar path costs ~0.1-1us per element and
+        # blocks on the device for JAX arrays).
+        if (isinstance(callee, ast.Name) and callee.id in ("float", "int")
+                and node.args
+                and isinstance(node.args[0], ast.Subscript)):
+            findings.append(Finding(
+                "FC203", sf.relpath, node.lineno,
+                f"{where}: {callee.id}() on a subscripted array element in "
+                f"a hot-loop function — per-row scalar conversion; use a "
+                f"vectorized .tolist() before the loop"))
+        # FC204: literal batch dims at predict/jit call sites.
+        if (isinstance(callee, ast.Attribute)
+                and callee.attr in _PREDICT_FNS and node.args):
+            dim = _literal_leading_dim(node.args[0])
+            if dim is not None and not _ladder_aligned(dim):
+                findings.append(Finding(
+                    "FC204", sf.relpath, node.lineno,
+                    f"{where}: {callee.attr}() with literal batch dim "
+                    f"{dim} — not a padding-ladder rung shape (rungs are "
+                    f"power-of-two; sched/batcher.py), so this pads to an "
+                    f"unwarmed shape and compiles on the hot path"))
+    return findings
+
+
+def _literal_leading_dim(node: ast.AST) -> Optional[int]:
+    """Statically-known batch length of an argument expression:
+    ``[...] * N``, ``N * [...]``, or a literal list/tuple."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        for side, other in ((node.left, node.right),
+                            (node.right, node.left)):
+            if (isinstance(side, ast.Constant)
+                    and isinstance(side.value, int)
+                    and isinstance(other, (ast.List, ast.Tuple))):
+                return side.value * len(other.elts)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return len(node.elts)
+    return None
+
+
+def _ladder_aligned(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0

@@ -27,24 +27,78 @@ _lib: "ctypes.CDLL | None" = None
 _lib_failed = False
 
 
-def _build() -> Optional[str]:
-    if os.path.isfile(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return _LIB
+_BASE_FLAGS = ["-std=c++17", "-shared", "-fPIC", "-pthread"]
+
+# Sanitizer build variants (docs/static_analysis.md "Sanitizer builds"):
+# the multi-thread ftok_shard_* ABI runs N pool threads over one shared
+# handle, and "simple by design" only stays true under a REAL race/memory
+# detector. -O1 keeps stacks honest; recovery is off so the first finding
+# fails the run. The instrumented .so must be loaded into a process that
+# PRELOADS the matching runtime (LD_PRELOAD=libasan.so/libtsan.so —
+# native/san_driver.py and the CI `sanitizers` job do this).
+_SAN_VARIANTS = {
+    "asan": ["-O1", "-g", "-fno-omit-frame-pointer",
+             "-fsanitize=address,undefined", "-fno-sanitize-recover=all"],
+    "tsan": ["-O1", "-g", "-fno-omit-frame-pointer", "-fsanitize=thread"],
+}
+_SAN_RUNTIMES = {"asan": "libasan.so", "tsan": "libtsan.so"}
+
+
+def _compile(out: str, opt_flags) -> Optional[str]:
+    if os.path.isfile(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+        return out
+    tmp = None
     try:
         # build to a temp name then atomic-rename: concurrent processes race safely
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_LIB))
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out))
         os.close(fd)
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return _LIB
+            ["g++", *opt_flags, *_BASE_FLAGS, _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=240)
+        os.replace(tmp, out)
+        return out
     except (OSError, subprocess.SubprocessError):
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return None
+
+
+def _build() -> Optional[str]:
+    return _compile(_LIB, ["-O3"])
+
+
+def variant_lib_path(variant: str) -> str:
+    return os.path.join(os.path.dirname(_SRC), f"libfastfeat_{variant}.so")
+
+
+def build_variant(variant: Optional[str]) -> Optional[str]:
+    """Build (or reuse) a sanitizer-instrumented library variant; None when
+    the toolchain can't. ``variant`` in {"asan", "tsan"}; None/"plain"
+    falls through to the production -O3 build."""
+    if not variant or variant == "plain":
+        return _build()
+    if variant not in _SAN_VARIANTS:
+        raise ValueError(f"unknown sanitizer variant {variant!r} "
+                         f"(known: {sorted(_SAN_VARIANTS)})")
+    return _compile(variant_lib_path(variant), _SAN_VARIANTS[variant])
+
+
+def sanitizer_runtime(variant: str) -> Optional[str]:
+    """Absolute path of the sanitizer runtime to LD_PRELOAD for ``variant``
+    (gcc's bundled libasan/libtsan), or None when the toolchain lacks it."""
+    name = _SAN_RUNTIMES.get(variant)
+    if name is None:
+        return None
+    try:
+        out = subprocess.run(["gcc", f"-print-file-name={name}"],
+                             capture_output=True, text=True,
+                             timeout=30).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out if os.path.isabs(out) and os.path.isfile(out) else None
 
 
 def load_library() -> Optional[ctypes.CDLL]:
@@ -58,7 +112,11 @@ def load_library() -> Optional[ctypes.CDLL]:
         if os.environ.get("FRAUD_TPU_NO_NATIVE"):
             _lib_failed = True
             return None
-        path = _build()
+        # FRAUD_TPU_NATIVE_VARIANT=asan|tsan loads the sanitizer-
+        # instrumented build instead — the caller must have LD_PRELOADed
+        # the matching runtime BEFORE the process started (san_driver.py);
+        # without it the instrumented .so aborts at dlopen.
+        path = build_variant(os.environ.get("FRAUD_TPU_NATIVE_VARIANT"))
         if path is None:
             _lib_failed = True
             return None

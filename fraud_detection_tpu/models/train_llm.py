@@ -199,6 +199,7 @@ def fit_language_model(
     if mesh is not None and llm_mod.MODEL_AXIS in mesh.axis_names:
         sh = param_shardings(cfg, mesh)
         params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    # flightcheck: ignore[FC201] — once per training run (device placement of the fresh opt state)
     opt_state = jax.jit(opt.init)(params)
 
     fingerprint = None

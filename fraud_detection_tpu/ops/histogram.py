@@ -58,6 +58,7 @@ def _host_callbacks_supported() -> bool:
             # Host fetch, not block_until_ready: the axon tunnel acks
             # dispatches asynchronously, so only materializing the value
             # guarantees the runtime's rejection surfaces inside this try.
+            # flightcheck: ignore[FC201] — one-shot capability probe, result cached for the process
             float(jax.device_get(jax.jit(probe)(jnp.zeros(()))))
         return True
     except Exception:  # noqa: BLE001 — any refusal means "no"

@@ -121,9 +121,15 @@ class HotSwapPipeline:
         stage candidates then only COMPILE the selected rungs — the cost
         curve is a property of the rung shapes, not the weights, so
         candidates never re-bench."""
-        self._pad_buckets = tuple(sorted(set(int(b) for b in buckets)))
-        if costs is not None:
-            self._ladder_costs = dict(costs)
+        # Writer-side lock (flightcheck FC102): configure_ladder runs on
+        # the scheduler's driver thread while swap/stage read _pad_buckets
+        # on the lifecycle watcher — the lock keeps the buckets+costs pair
+        # a single consistent publish. The prewarm calls below stay OUTSIDE
+        # it: they compile for seconds and readers must not block.
+        with self._lock:
+            self._pad_buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if costs is not None:
+                self._ladder_costs = dict(costs)
         for target in (self.active_pipeline, self.staged_pipeline):
             if target is not None:
                 if prewarm:
@@ -144,7 +150,8 @@ class HotSwapPipeline:
         costs = measure_rung_costs(self.active_pipeline, tuple(candidates),
                                    texts=list(texts or self._prewarm_texts),
                                    repeats=repeats)
-        self._ladder_costs = dict(costs)
+        with self._lock:   # writer-side publish, same contract as configure
+            self._ladder_costs = dict(costs)
         return costs
 
     @property

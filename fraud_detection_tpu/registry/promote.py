@@ -26,6 +26,7 @@ from fraud_detection_tpu.registry.registry import (ModelRegistry,
                                                    RegistryError,
                                                    RegistryIntegrityError)
 from fraud_detection_tpu.utils import get_logger
+from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
 
 log = get_logger("registry.promote")
 
@@ -157,6 +158,13 @@ class LifecycleController:
             active = latest.version if latest is not None else 0
         self._seen = active
         self.events: List[dict] = []    # every audited transition, in order
+        # Race tripwire (utils/racecheck.py): tick() is documented "safe to
+        # call from any SINGLE thread", and rollback() is the operator
+        # overruling the watcher — the two deciding concurrently could
+        # promote a candidate the rollback just discarded. The region makes
+        # that collision a loud RaceError (the watcher loop logs it and
+        # retries next interval) instead of a silent double transition.
+        self._region = ExclusiveRegion("LifecycleController.watch")
 
     def _audit(self, event: str, **fields) -> dict:
         record = self.registry.audit(event, **fields)
@@ -166,6 +174,10 @@ class LifecycleController:
     def tick(self) -> List[dict]:
         """One poll step: adopt new versions, evaluate a staged candidate.
         Returns the audit events this tick generated."""
+        with self._region:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> List[dict]:
         before = len(self.events)
         for mv in self.registry.poll_new(self._seen):
             self._seen = mv.version
@@ -210,15 +222,19 @@ class LifecycleController:
     def rollback(self, version: int) -> dict:
         """Swap any prior published version back in (verified, pre-warmed).
         A staged candidate, if any, is discarded — rolling back IS the
-        operator overruling the pipeline."""
-        mv, pipe = self.registry.load(version, batch_size=self.batch_size,
-                                      mesh=self.mesh)
-        discarded = self.hotswap.discard_staged()
-        if self.shadow is not None:
-            self.shadow.clear_candidate()
-        old = self.hotswap.swap(pipe, mv.version)
-        return self._audit("rollback", version=mv.version, previous=old,
-                           discarded_staged=discarded)
+        operator overruling the pipeline. Shares tick()'s exclusive region:
+        a rollback racing a concurrent tick raises RaceError rather than
+        letting the two transition the same staged candidate twice (stop
+        the watcher, or accept the retry, before rolling back)."""
+        with self._region:
+            mv, pipe = self.registry.load(version, batch_size=self.batch_size,
+                                          mesh=self.mesh)
+            discarded = self.hotswap.discard_staged()
+            if self.shadow is not None:
+                self.shadow.clear_candidate()
+            old = self.hotswap.swap(pipe, mv.version)
+            return self._audit("rollback", version=mv.version, previous=old,
+                               discarded_staged=discarded)
 
     def run_in_thread(self, interval: float = 2.0,
                       stop: Optional[threading.Event] = None):

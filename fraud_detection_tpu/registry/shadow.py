@@ -35,6 +35,7 @@ import numpy as np
 
 from fraud_detection_tpu.ops.histogram import histogram_reference
 from fraud_detection_tpu.utils import get_logger
+from fraud_detection_tpu.utils.racecheck import ExclusiveRegion
 
 log = get_logger("registry.shadow")
 
@@ -101,6 +102,12 @@ class ShadowScorer:
         self._candidate = None          # (version, pipeline) — RCU-read
         self._stop = threading.Event()
         self._reset_stats_locked()
+        # Race tripwire (utils/racecheck.py): scoring is single-worker by
+        # construction — ONE thread started here, never respawned. The
+        # region turns a second concurrent scorer (a future refactor
+        # spawning a pool, or an external caller driving _score_item) into
+        # an immediate RaceError instead of silently double-counted stats.
+        self._region = ExclusiveRegion("ShadowScorer.worker")
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="shadow-scorer")
         self._thread.start()
@@ -188,7 +195,8 @@ class ShadowScorer:
             except queue.Empty:
                 continue
             try:
-                self._score_item(item)
+                with self._region:
+                    self._score_item(item)
             except Exception as e:  # noqa: BLE001 — shadow must never kill serving
                 with self._lock:
                     self._errors += 1

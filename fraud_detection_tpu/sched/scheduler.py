@@ -201,30 +201,37 @@ class AdaptiveScheduler:
         the ACTIVE pipeline and cache the costs, so future swap candidates
         only compile the selected rungs — they never re-bench
         (registry/hotswap.py)."""
-        cfg = self.config
-        explicit = cfg.buckets is not None
-        candidates = (self.buckets if explicit or not cfg.cost_aware
-                      else ladder_candidates(self.batch_size))
-        measure = getattr(pipeline, "measure_ladder", None)
-        if measure is not None:         # HotSwapPipeline: measure + cache
-            costs = measure(candidates, texts=texts)
-        else:
-            costs = measure_rung_costs(pipeline, candidates, texts=texts)
-        self.ladder_costs = dict(costs)
-        if not explicit and cfg.cost_aware:
-            self.buckets = cost_aware_ladder(costs, self.batch_size,
-                                             cfg.cost_ratio)
-            # The smallest rung is the governor's budget floor — keep them
-            # aligned when measurement reshapes the ladder.
-            self.governor.min_budget = self.buckets[0]
-        configure = getattr(pipeline, "configure_ladder", None)
-        if configure is not None:
-            configure(self.buckets, prewarm=True, costs=costs)
+        # Prewarm mutates driver-owned control state (buckets, ladder_costs,
+        # the governor's budget floor) that snapshot() reads from health-
+        # poller threads — it is part of the single-driver contract and
+        # enters the region like collect/admit/observe_batch do (flightcheck
+        # FC102 caught the original unguarded writes; same-thread re-entry
+        # is free, a concurrent driver is a RaceError).
+        with self._region:
+            cfg = self.config
+            explicit = cfg.buckets is not None
+            candidates = (self.buckets if explicit or not cfg.cost_aware
+                          else ladder_candidates(self.batch_size))
+            measure = getattr(pipeline, "measure_ladder", None)
+            if measure is not None:     # HotSwapPipeline: measure + cache
+                costs = measure(candidates, texts=texts)
+            else:
+                costs = measure_rung_costs(pipeline, candidates, texts=texts)
+            self.ladder_costs = dict(costs)
+            if not explicit and cfg.cost_aware:
+                self.buckets = cost_aware_ladder(costs, self.batch_size,
+                                                 cfg.cost_ratio)
+                # The smallest rung is the governor's budget floor — keep
+                # them aligned when measurement reshapes the ladder.
+                self.governor.min_budget = self.buckets[0]
+            configure = getattr(pipeline, "configure_ladder", None)
+            if configure is not None:
+                configure(self.buckets, prewarm=True, costs=costs)
+                return len(self.buckets)
+            # Every selected rung was compiled during measurement; this
+            # applies the final ladder and re-warms it (no new compiles).
+            prewarm_ladder(pipeline, self.buckets, texts)
             return len(self.buckets)
-        # Every selected rung was compiled during measurement; this applies
-        # the final ladder and re-warms it (no new compiles).
-        prewarm_ladder(pipeline, self.buckets, texts)
-        return len(self.buckets)
 
     # ------------------------------------------------------------------
     # observability (any thread)

@@ -126,6 +126,7 @@ class AsyncAnnotationLane:
             try:
                 self._annotate(batch)
             except Exception:  # noqa: BLE001 — lane must survive anything
+                # flightcheck: ignore[FC102] — worker-thread-only counter, read-racy by design (see __init__)
                 self.backend_errors += 1
                 log.exception("annotation batch failed (%d rows dropped); "
                               "classification unaffected", len(batch))
@@ -158,6 +159,7 @@ class AsyncAnnotationLane:
             self.produced += len(out)
             undelivered = self._producer.flush()
             if undelivered:
+                # flightcheck: ignore[FC102] — worker-thread-only counter, read-racy by design
                 self.backend_errors += 1
                 log.warning("producer left %d annotation records "
                             "undelivered (counted as not annotated)",
@@ -165,6 +167,7 @@ class AsyncAnnotationLane:
             # Running delivered tally: a later successful flush of records a
             # previous one left queued credits them then, exactly once. The
             # max() keeps the counter monotonic while the queue is deep.
+            # flightcheck: ignore[FC102] — worker-thread-only tally, read-racy by design
             self.annotated = max(self.annotated,
                                  self.produced - int(undelivered))
 

@@ -368,8 +368,11 @@ class StreamingClassifier:
         multi-worker Ctrl-C path) stop an engine it built but whose run()
         hasn't started yet — without the latch, run()'s entry write would
         overwrite the request and the engine would consume anyway."""
-        self._stopped = True
-        self._running = False
+        # Deliberately lock-free: stop() must be callable from signal-adjacent
+        # contexts and never block behind a batch; both flags are monotonic
+        # latches whose races run() explicitly re-checks (see run()).
+        self._stopped = True    # flightcheck: ignore[FC102] — documented lock-free latch
+        self._running = False   # flightcheck: ignore[FC102] — documented lock-free latch
 
     def _decode(self, msg: Message) -> Optional[str]:
         try:
@@ -618,23 +621,29 @@ class StreamingClassifier:
         if flagged.size == 0:
             return
         confs = _confidence_array(preds)
+        # Host conversion is BATCHED — one tolist per array over the flagged
+        # subset — never per-row int(labels[i])/float(confs[i]) numpy-scalar
+        # indexing (each costs ~0.5us and this loop rides every flagged
+        # batch; flightcheck FC203 polices the pattern).
+        flag_idx = flagged.tolist()
+        flag_labels = labels[flagged].tolist()
+        flag_confs = confs[flagged].tolist()
         items = []
         if inflight.raw:
             # Predictions are positional over ALL rows; malformed rows hold
             # padding garbage — keep valid ones only.
             valid = frozenset(inflight.valid_idx)
-            for i in flagged.tolist():
+            for i, label, conf in zip(flag_idx, flag_labels, flag_confs):
                 if i not in valid:
                     continue
                 text = self._annotation_text(inflight, i)
                 if text is not None:
-                    items.append((inflight.msgs[i].key, text,
-                                  int(labels[i]), float(confs[i])))
+                    items.append((inflight.msgs[i].key, text, label, conf))
         else:
-            for j in flagged.tolist():
+            for j, label, conf in zip(flag_idx, flag_labels, flag_confs):
                 i = inflight.valid_idx[j]
                 items.append((inflight.msgs[i].key, inflight.texts[i],
-                              int(labels[j]), float(confs[j])))
+                              label, conf))
         if items:
             self._annotation_lane.submit(items)
 

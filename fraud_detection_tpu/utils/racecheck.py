@@ -41,6 +41,24 @@ from typing import List, Optional
 _log_lock = threading.Lock()
 _violations: List["RaceViolation"] = []
 
+#: Every racecheck region the framework instruments, by name. This is the
+#: runtime detector's COVERAGE LIST, and it is load-bearing: the flightcheck
+#: static analyzer (analysis/threads.py, rule FC103) cross-checks it against
+#: the ``ExclusiveRegion("...")`` / ``PairedCallChecker(name=...)``
+#: constructions actually present in the source AND against the thread
+#: entry-point registry (analysis/entrypoints.py THREAD_ENTRY_POINTS), so
+#: instrumenting a new contract — or deleting one — without updating all
+#: three fails lint. Keep it a LITERAL set: the analyzer reads it from the
+#: AST without importing this module.
+INSTRUMENTED_REGIONS = frozenset({
+    "StreamingClassifier.drive",     # engine single-driver loop
+    "AdaptiveScheduler.drive",       # scheduler collect/admit/observe
+    "InProcessConsumer",             # broker consumer poll/commit
+    "NativeFeaturizer",              # native begin/fill pairing (checker)
+    "ShadowScorer.worker",           # shadow-scoring worker (one thread)
+    "LifecycleController.watch",     # hot-swap watch thread tick/rollback
+})
+
 
 @dataclass
 class RaceViolation:

@@ -1,0 +1,19 @@
+"""flightcheck fixture: FC301 health-schema drift (never imported)."""
+
+
+class Probe:
+    def health(self):
+        return {
+            "running": True,
+            "renamed_key": 1,        # schema pins "dropped" instead
+        }
+
+    def snapshot_ok(self):
+        snap = {"count": 0}
+        snap["extra"] = 1
+        return snap
+
+    def torn(self, empty):
+        if empty:
+            return {"count": 0}
+        return {"count": 1, "p50": 2.0}   # inconsistent across returns

@@ -1,0 +1,48 @@
+"""flightcheck fixture: FC201/FC202/FC203/FC204 (never imported — parsed
+only, so the jax import below never executes)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def traced_branch(x, k):
+    if k > 2:                      # static arg: fine
+        x = x + 1
+    if x.shape[0] > 4:             # shape check: static under tracing, fine
+        x = x * 2
+    if x > 0:                      # VIOLATION FC202: traced value branch
+        return x
+    while x < k:                   # VIOLATION FC202
+        x = x + 1
+    return x
+
+
+@jax.jit
+def none_gate(x, mask=None):
+    if mask is None:               # structural: fine
+        return x
+    return x * mask
+
+
+def rebuilds_jit(fn, x):
+    return jax.jit(fn)(x)          # VIOLATION FC201: jit per call
+
+
+class HotClass:
+    def hot_loop(self, pipe, rows):
+        out = []
+        for i in range(len(rows)):
+            out.append(float(rows[i]))     # VIOLATION FC203
+        total = rows.sum().item()          # VIOLATION FC203
+        pipe.predict_async(["pad"] * 37)   # VIOLATION FC204: 37 not a rung
+        pipe.predict_async(["pad"] * 64)   # power-of-two rung: fine
+        pipe.predict_async(rows)           # dynamic: fine
+        return out, total
+
+    def cold_loop(self, pipe, rows):
+        # identical body, NOT in hot_paths: nothing flagged here
+        _ = rows.sum().item()
+        pipe.predict_async(["pad"] * 37)

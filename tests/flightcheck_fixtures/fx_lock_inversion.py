@@ -1,0 +1,29 @@
+"""flightcheck fixture: FC101 lock-order inversion (NEVER imported — the
+analyzer parses it; a real deadlock shape, deliberately)."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:          # edge a -> b
+                self.x += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:          # edge b -> a: cycle with forward()
+                self.x -= 1
+
+    def _inner_locked_helper(self):
+        with self._b:              # called under _a: interprocedural edge
+            self.x += 2
+
+    def via_call(self):
+        with self._a:
+            self._inner_locked_helper()

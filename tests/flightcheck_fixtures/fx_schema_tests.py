@@ -1,0 +1,11 @@
+"""flightcheck fixture: the schema side of the FC301 drift pair."""
+
+PROBE_HEALTH_SCHEMA = {
+    "running": (bool,),
+    "dropped": (int,),
+}
+
+SNAP_OK_SCHEMA = {
+    "count": (int,),
+    "extra": (int,),
+}

@@ -1,0 +1,13 @@
+"""flightcheck fixture: FC103 unregistered thread spawn (never imported)."""
+
+import threading
+
+
+def rogue():
+    pass
+
+
+def spawn():
+    t = threading.Thread(target=rogue, daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,44 @@
+"""flightcheck fixture: FC102 unguarded shared write (never imported).
+
+``Box`` has a worker thread (role map supplied by the test) and a lock; the
+worker bumps ``count`` under the lock, but ``reset()`` — reachable from the
+primary thread — writes it with no lock held: the classic lost-update
+shape. ``quiet_reset`` is the same write suppressed by pragma, and
+``guarded_reset`` is the correct form.
+"""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.private_scratch = 0    # single-role: never flagged
+
+    def _worker(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        self.count = 0              # VIOLATION: shared, no lock
+
+    def quiet_reset(self):
+        self.count = 0              # flightcheck: ignore[FC102] — fixture pragma
+
+    def guarded_reset(self):
+        with self._lock:
+            self.count = 0
+
+    def scratch(self):
+        self.private_scratch = 1    # main-role only: not shared
+
+    def _drain_locked(self):
+        self.count = 0              # _locked suffix: caller holds the lock
+
+    def _relay(self):
+        with self._lock:
+            self._indirect()
+
+    def _indirect(self):
+        self.count += 5             # guarded via caller context: clean

@@ -205,3 +205,62 @@ def test_bench_subprocess_survives_sigterm(tmp_path):
     assert line["value"] > 0
     json_lines = [l for l in out.splitlines() if l.startswith("{")]
     assert json.loads(json_lines[-1])["value"] > 0
+    # Even a TERM-cut round leaves a bench-trend record (the __main__
+    # finally path): cwd is tmp, so the default reports/ path lands there.
+    trend_file = tmp_path / "reports" / "bench_trend.json"
+    assert trend_file.exists(), "no bench_trend.json appended on SIGTERM"
+    with open(trend_file) as f:
+        trend = json.load(f)
+    assert trend[-1]["value"] == line["value"]
+
+
+# ---------------------------------------------------------------------------
+# bench-trend appender (ROADMAP "Bench trend tracking")
+# ---------------------------------------------------------------------------
+
+def _fake_line(value=1000.0):
+    return {
+        "metric": "kafka_stream_classification_throughput",
+        "value": value, "vs_baseline": 2.5,
+        "batch_latency_ms": {"p50": 1.0, "p99": 3.0},
+        "featurize_encode_rows_per_sec": 50_000.0,
+        "load_sweep": {"ladder": {"candidates": [16, 32], "buckets": [32],
+                                  "cost_ms": {"32": 0.5}},
+                       "capacity_est_per_s": 9000.0,
+                       "max_load_meeting_target_p99_per_s": 8000.0},
+    }
+
+
+def test_append_bench_trend_appends_compact_records(tmp_path):
+    path = str(tmp_path / "trend.json")
+    rec = bench.append_bench_trend(_fake_line(1000.0), path, now=111.0)
+    assert rec["value"] == 1000.0
+    assert rec["ladder"]["buckets"] == [32]
+    assert rec["featurize_rows_per_sec"] == 50_000.0
+    assert rec["capacity_est_per_s"] == 9000.0
+    bench.append_bench_trend(_fake_line(2000.0), path, now=222.0)
+    with open(path) as f:
+        trend = json.load(f)
+    assert [r["value"] for r in trend] == [1000.0, 2000.0]
+    assert trend[0]["time"] == 111.0
+    # records stay tiny: a round's diff is a few lines, not an artifact
+    assert len(json.dumps(trend[0])) < 600
+
+
+def test_append_bench_trend_bounds_resets_and_disables(tmp_path):
+    path = str(tmp_path / "trend.json")
+    for i in range(7):
+        bench.append_bench_trend(_fake_line(float(i)), path, keep=3,
+                                 now=float(i))
+    with open(path) as f:
+        trend = json.load(f)
+    assert [r["value"] for r in trend] == [4.0, 5.0, 6.0]   # bounded
+    # corrupt file resets instead of raising
+    with open(path, "w") as f:
+        f.write("{not json")
+    bench.append_bench_trend(_fake_line(9.0), path, now=9.0)
+    with open(path) as f:
+        assert [r["value"] for r in json.load(f)] == [9.0]
+    # no headline -> no record; BENCH_TREND=0 disables
+    assert bench.append_bench_trend({"metric": "m"}, path) is None
+    assert bench.append_bench_trend(_fake_line(), "0") is None

@@ -578,6 +578,19 @@ def test_health_reports_flush_failure_streak(pipeline):
     assert h["processed"] == 0
 
 
+# Exact key set of AsyncAnnotationLane.stats() — the health() "annotations"
+# block. A module-level dict literal (not inline in the assert) so the
+# flightcheck health-schema lint (analysis/health.py, FC301) can cross-check
+# the producer against it statically.
+ANNOTATION_STATS_SCHEMA = {
+    "submitted": (int,),
+    "annotated": (int,),
+    "dropped": (int,),
+    "backend_errors": (int,),
+    "queue_depth": (int,),
+}
+
+
 def test_health_annotation_lane_counters(pipeline):
     broker = InProcessBroker(num_partitions=1)
     _feed(broker, 20)
@@ -590,6 +603,7 @@ def test_health_annotation_lane_counters(pipeline):
     engine.close_annotations(timeout=10.0)
     h = engine.health()
     assert h["annotations"] is not None
-    assert set(h["annotations"]) == {"submitted", "annotated", "dropped",
-                                     "backend_errors", "queue_depth"}
+    assert set(h["annotations"]) == set(ANNOTATION_STATS_SCHEMA)
+    for key, types in ANNOTATION_STATS_SCHEMA.items():
+        assert isinstance(h["annotations"][key], types), key
     assert h["annotations"]["queue_depth"] == 0

@@ -1,0 +1,105 @@
+"""Sanitizer-hardened native builds (ISSUE 5 / SURVEY.md §5 "Race detection
+/ sanitizers: absent").
+
+The multi-thread ``ftok_shard_*`` ABI had never run under a real race or
+memory detector. These tests build ASan+UBSan and TSan variants of
+``libfastfeat.so`` and run the shard-parity + parallel-featurize workload
+(native/san_driver.py) inside an instrumented subprocess with the matching
+runtime LD_PRELOADed. A sanitizer finding aborts the subprocess
+(halt_on_error / -fno-sanitize-recover), so a clean exit code IS the
+assertion.
+
+The sanitized runs are marked ``sanitize`` + ``slow``: the CI ``sanitizers``
+job runs ``-m sanitize`` with the build artifacts cached; tier-1 keeps only
+the fast uninstrumented driver smoke (which proves the workload itself —
+parity checks and all — stays green).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fraud_detection_tpu.featurize import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "fraud_detection_tpu", "native", "san_driver.py")
+
+_SAN_ENV = {
+    "asan": {"ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+             "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1"},
+    "tsan": {"TSAN_OPTIONS": "halt_on_error=1"},
+}
+_REPORT_MARKERS = ("ERROR: AddressSanitizer", "runtime error:",
+                   "WARNING: ThreadSanitizer", "ERROR: LeakSanitizer")
+
+
+def _run_driver(variant: str, *, threads: int = 6, rounds: int = 3,
+                rows: int = 384) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("FRAUD_TPU_NO_NATIVE", None)
+    env["FRAUD_TPU_NATIVE_VARIANT"] = variant if variant != "plain" else ""
+    if variant != "plain":
+        lib = native.build_variant(variant)
+        if lib is None:
+            pytest.skip(f"toolchain cannot build the {variant} variant")
+        runtime = native.sanitizer_runtime(variant)
+        if runtime is None:
+            pytest.skip(f"no {variant} runtime to preload")
+        env["LD_PRELOAD"] = runtime
+        env.update(_SAN_ENV[variant])
+    return subprocess.run(
+        [sys.executable, DRIVER, "--variant", variant,
+         "--threads", str(threads), "--rounds", str(rounds),
+         "--rows", str(rows)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+
+
+def _assert_clean(proc: subprocess.CompletedProcess, variant: str) -> None:
+    out = proc.stdout + "\n" + proc.stderr
+    assert proc.returncode == 0, (
+        f"{variant} driver failed (rc={proc.returncode}):\n{out[-4000:]}")
+    for marker in _REPORT_MARKERS:
+        assert marker not in out, (
+            f"{variant}: sanitizer report in output:\n{out[-4000:]}")
+    assert "all checks passed" in proc.stdout
+
+
+def test_driver_smoke_uninstrumented():
+    """The sanitizer workload itself must stay green on the production
+    build — parity + hammer + JSON/frames, no jax in the subprocess."""
+    if native.available() is False:
+        pytest.skip("native library unavailable (no toolchain)")
+    proc = _run_driver("plain", threads=4, rounds=2, rows=256)
+    _assert_clean(proc, "plain")
+
+
+@pytest.mark.sanitize
+@pytest.mark.slow
+def test_shard_abi_clean_under_asan_ubsan():
+    _run = _run_driver("asan")
+    _assert_clean(_run, "asan")
+
+
+@pytest.mark.sanitize
+@pytest.mark.slow
+def test_shard_abi_clean_under_tsan():
+    _run = _run_driver("tsan")
+    _assert_clean(_run, "tsan")
+
+
+@pytest.mark.sanitize
+@pytest.mark.slow
+def test_variant_builds_are_distinct_artifacts():
+    """Variant builds land next to the production .so without replacing it
+    (the engine keeps loading the -O3 build unless the env var asks)."""
+    plain = native.build_variant(None)
+    asan = native.build_variant("asan")
+    if plain is None or asan is None:
+        pytest.skip("toolchain unavailable")
+    assert os.path.basename(plain) == "libfastfeat.so"
+    assert os.path.basename(asan) == "libfastfeat_asan.so"
+    assert os.path.isfile(plain) and os.path.isfile(asan)
+    with pytest.raises(ValueError):
+        native.build_variant("msan")
