@@ -1,12 +1,20 @@
 """flightcheck — first-party static analysis for the framework's own
 invariants (docs/static_analysis.md).
 
-Three rule families, all pure-AST (nothing under analysis is imported or
+Four rule families, all pure-AST (nothing under analysis is imported or
 executed):
 
-* concurrency lint (FC101/FC102/FC103): lock-order cycles, unguarded
-  writes to thread-shared attributes, and drift between the thread map,
-  the entry-point registry, and utils/racecheck.py's instrumentation list;
+* concurrency lint (FC101/FC102/FC103): lock-order cycles — per class AND
+  whole-program across objects (callgraph.py builds a project call graph,
+  binds receiver types, and propagates held-lock sets through
+  cross-object calls) — unguarded writes to thread-shared attributes, and
+  drift between the thread map, the entry-point registry, and
+  utils/racecheck.py's instrumentation list;
+* delivery-protocol rules (FC401-FC404, protocol.py): the
+  produce->flush->check->commit ordering the at-least-once guarantee
+  hangs on — commit unreachable without a verified flush, records riding
+  their batch's flush, drains gated on the failure flag — plus bare
+  ``acquire()`` exception-safety package-wide;
 * JAX recompile/sync lint (FC201-FC204): jit-in-function recompiles,
   Python branches on traced values, hot-loop device syncs, and literal
   batch dims that bypass the prewarmed padding ladder;
@@ -14,9 +22,11 @@ executed):
   against the contract-test ``*_SCHEMA`` dicts, so schema drift fails lint
   before it fails a soak.
 
-CLI: ``python -m fraud_detection_tpu.analysis`` (exit 0 = clean tree).
-Suppressions: ``# flightcheck: ignore[RULE] — reason`` on (or right above)
-the flagged line.
+CLI: ``flightcheck`` / ``python -m fraud_detection_tpu.analysis`` (exit 0
+= clean tree); ``--sarif`` emits SARIF 2.1.0 for CI code scanning,
+``--fix`` scaffolds suppression pragmas with a required-justification
+stub. Suppressions: ``# flightcheck: ignore[RULE] — reason`` on (or right
+above) the flagged line.
 """
 
 from fraud_detection_tpu.analysis.core import (Finding, RULES,  # noqa: F401

@@ -48,6 +48,19 @@ RULES: Dict[str, tuple] = {
     "FC301": ("health-schema-drift",
               "health()/snapshot() key set disagrees with the contract "
               "test schema"),
+    "FC401": ("commit-order",
+              "offset commit reachable without a verified producer flush "
+              "(no flush on the path, flush result dropped, or failure "
+              "branch falls through to the commit)"),
+    "FC402": ("record-after-flush",
+              "record produced after the batch's flush — it rides no "
+              "delivery accounting and a commit can orphan it"),
+    "FC403": ("unguarded-drain",
+              "in-flight batches drained without checking the flush-"
+              "failure flag (cleanup path or public entry)"),
+    "FC404": ("lock-leak",
+              "bare lock.acquire() without a with/try-finally release — "
+              "an exception between acquire and release leaks the lock"),
 }
 
 
@@ -137,6 +150,19 @@ def filter_suppressed(files_by_rel: Dict[str, SourceFile],
     return kept, suppressed
 
 
+def resolve_roots(package_root: Optional[str] = None,
+                  tests_dir: Optional[str] = None) -> tuple:
+    """Default-resolve (package_root, tests_dir) the way the CLI does —
+    the installed package, with tests/ as its sibling when present."""
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    if tests_dir is None:
+        cand = os.path.join(os.path.dirname(package_root), "tests")
+        tests_dir = cand if os.path.isdir(cand) else None
+    return package_root, tests_dir
+
+
 def run_analysis(package_root: Optional[str] = None,
                  tests_dir: Optional[str] = None,
                  rules: Optional[Set[str]] = None) -> tuple:
@@ -145,21 +171,19 @@ def run_analysis(package_root: Optional[str] = None,
     Returns ``(findings, n_suppressed, n_files)`` with pragma suppression
     applied. ``rules`` restricts to a subset of rule ids (a finding whose
     rule is excluded is neither reported nor counted)."""
-    from fraud_detection_tpu.analysis import concurrency, health, jaxlint
+    from fraud_detection_tpu.analysis import (callgraph, concurrency, health,
+                                              jaxlint, protocol)
     from fraud_detection_tpu.analysis import threads as threadmap
 
-    if package_root is None:
-        package_root = os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))
-    if tests_dir is None:
-        cand = os.path.join(os.path.dirname(package_root), "tests")
-        tests_dir = cand if os.path.isdir(cand) else None
+    package_root, tests_dir = resolve_roots(package_root, tests_dir)
 
     files = load_package(package_root)
     by_rel = {f.relpath: f for f in files}
 
     raw: List[Finding] = []
     raw += concurrency.analyze(files)
+    raw += callgraph.analyze(files)
+    raw += protocol.analyze(files)
     raw += jaxlint.analyze(files)
     raw += threadmap.analyze(files, package_root=package_root)
     raw += health.analyze(files, tests_dir=tests_dir)

@@ -172,6 +172,87 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
 
 
 # ---------------------------------------------------------------------------
+# Cross-object seams (the whole-program FC101 scope, analysis/callgraph.py).
+#
+# The call-graph pass infers receiver types from direct instantiation and
+# parameter annotations; everything duck-typed — the engine's injected
+# clients, the scheduler's consumer parameter — is pinned HERE so the
+# analyzer follows the calls the engine actually makes. Keys are either
+# "relpath::Class.attr" (attribute binding) or "relpath::Class.method.param"
+# (parameter binding); values are candidate class names, expanded through
+# IMPLEMENTATIONS when they name a Protocol.
+# ---------------------------------------------------------------------------
+
+OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
+    # Engine clients: the Protocol types; expanded to in-process impls.
+    "stream/engine.py::StreamingClassifier.consumer": ("Consumer",),
+    "stream/engine.py::StreamingClassifier.producer": ("Producer",),
+    "stream/engine.py::StreamingClassifier._sched": ("AdaptiveScheduler",),
+    "stream/engine.py::StreamingClassifier._shadow": ("ShadowScorer",),
+    "stream/engine.py::StreamingClassifier.pipeline": ("HotSwapPipeline",),
+    # Scheduler-owned consume handoff: collect/backlog_of (and the
+    # batcher's accumulation loop they delegate to) drive the engine's
+    # consumer while holding the scheduler's region. `*` binds the named
+    # parameter in EVERY method of the class.
+    "sched/scheduler.py::AdaptiveScheduler.*.consumer": ("Consumer",),
+    "sched/batcher.py::DynamicBatcher.*.consumer": ("Consumer",),
+    # Lifecycle controller drives hot swap + shadow under its watch region.
+    "registry/promote.py::LifecycleController.hotswap": ("HotSwapPipeline",),
+    "registry/promote.py::LifecycleController.shadow": ("ShadowScorer",),
+    # Chaos wrappers forward to the real clients.
+    "stream/faults.py::ChaosConsumer.inner": ("Consumer",),
+    "stream/faults.py::ChaosProducer.inner": ("Producer",),
+}
+
+#: Protocol/ABC name -> concrete in-tree implementations the call-graph
+#: pass follows (an unbound protocol method has a ``...`` body and would
+#: contribute nothing).
+IMPLEMENTATIONS: Mapping[str, Tuple[str, ...]] = {
+    "Consumer": ("InProcessConsumer", "ChaosConsumer"),
+    "Producer": ("InProcessProducer", "ChaosProducer"),
+    "ServingPipeline": ("HotSwapPipeline",),
+}
+
+
+# ---------------------------------------------------------------------------
+# Commit protocols (the FC401-FC403 scope, analysis/protocol.py): classes
+# that own a produce -> flush -> check -> commit delivery sequence. The
+# names here ARE the protocol: the producer attribute(s) whose flush()
+# accounts delivery, the commit calls that durably advance progress, the
+# drain method that finishes queued batches, and the failure flag that
+# must gate every post-failure drain.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CommitProtocolSpec:
+    """One class's delivery-protocol shape for the FC4xx rules."""
+
+    cls_key: str                      # "relpath::ClassName"
+    producer_attrs: FrozenSet[str] = frozenset({"producer"})
+    flush_name: str = "flush"
+    commit_names: FrozenSet[str] = frozenset({"commit_offsets", "commit"})
+    produce_names: FrozenSet[str] = frozenset({"produce", "produce_batch"})
+    drain_names: FrozenSet[str] = frozenset()
+    failure_flag: Optional[str] = None
+
+
+COMMIT_PROTOCOLS: Tuple[CommitProtocolSpec, ...] = (
+    # The headline protocol: the streaming engine's at-least-once commit
+    # sequence (docs/robustness.md "delivery invariants").
+    CommitProtocolSpec(
+        "stream/engine.py::StreamingClassifier",
+        drain_names=frozenset({"_finish"}),
+        failure_flag="_flush_failed"),
+    # The annotation lane produces+flushes (no offsets to commit, no
+    # in-flight queue): FC402 still pins record-rides-flush ordering.
+    CommitProtocolSpec(
+        "stream/annotations.py::AsyncAnnotationLane",
+        producer_attrs=frozenset({"_producer"}),
+        commit_names=frozenset()),
+)
+
+
+# ---------------------------------------------------------------------------
 # Hot-loop functions (FC203 host-sync / FC204 ladder-bypass scope): the
 # per-batch serving path, where one stray device sync or unwarmed shape
 # costs throughput on EVERY batch.

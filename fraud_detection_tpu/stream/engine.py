@@ -917,8 +917,21 @@ class StreamingClassifier:
         return len(msgs) + inflight.dead_screened
 
     def process_batch(self, msgs: List[Message]) -> int:
-        """Score one micro-batch synchronously and emit results."""
+        """Score one micro-batch synchronously and emit results.
+
+        Refuses after a failed flush (flightcheck FC403 true positive):
+        unlike run(), which resets ``_flush_failed`` as a fresh-incarnation
+        boundary, a caller looping process_batch would otherwise commit the
+        NEXT batch's (later) offsets right past the failed batch's lost
+        outputs. Rebuild the engine — or enter run(), whose reset declares
+        a new incarnation — before scoring more batches."""
         with self._drive_region:
+            if self._flush_failed:
+                raise RuntimeError(
+                    "a previous batch's producer flush failed with its "
+                    "offsets uncommitted — committing a later batch would "
+                    "orphan its outputs; rebuild the engine (or use run(), "
+                    "which declares a fresh incarnation) to resume")
             return self._finish(self._dispatch(msgs))
 
     def run(self, max_messages: Optional[int] = None,

@@ -1,0 +1,116 @@
+"""Injected violations: produce->flush->commit protocol shapes (FC401-403,
+analysis/protocol.py). Parsed by tests, never imported; the test feeds a
+CommitProtocolSpec scoping the rules to ``BadEngine`` / ``GoodEngine``.
+
+Each method is one protocol mistake (or the compliant shape):
+
+* ``commit_before_flush``       — FC401: commit with no flush on the path
+* ``commit_unchecked_flush``    — FC401: flush captured, never checked
+* ``commit_dropped_flush``      — FC401: flush() result thrown away
+* ``commit_on_failure_path``    — FC401: the failure branch itself commits
+* ``late_record``               — FC402: DLQ record produced after flush
+* ``_drain_unguarded_finally``  — FC403(a): finally-drain without the flag
+* ``process_no_flag``           — FC403(b): public drain entry, flag never
+                                  consulted
+* ``GoodEngine.deliver``        — the engine's real shape: must stay clean
+"""
+
+
+class BadEngine:
+    def __init__(self, consumer, producer):
+        self.consumer = consumer
+        self.producer = producer
+        self._flush_failed = False
+        self._inflight = []
+
+    def commit_before_flush(self, wires, offsets):
+        for wire, key in wires:
+            self.producer.produce("out", wire, key=key)
+        self.consumer.commit_offsets(offsets)      # VIOLATION FC401
+        return self.producer.flush()
+
+    def commit_unchecked_flush(self, wires, offsets):
+        for wire, key in wires:
+            self.producer.produce("out", wire, key=key)
+        undelivered = self.producer.flush()
+        self.consumer.commit_offsets(offsets)      # VIOLATION FC401
+        return undelivered
+
+    def commit_dropped_flush(self, wires, offsets):
+        for wire, key in wires:
+            self.producer.produce("out", wire, key=key)
+        self.producer.flush()
+        self.consumer.commit_offsets(offsets)      # VIOLATION FC401
+
+    def commit_on_failure_path(self, offsets):
+        undelivered = self.producer.flush()
+        if undelivered:
+            self.consumer.commit_offsets(offsets)  # VIOLATION FC401
+            return 0
+        self.consumer.commit_offsets(offsets)      # ok: verified branch
+
+    def late_record(self, wires, dead, offsets):
+        self.producer.produce_batch("out", wires)
+        undelivered = self.producer.flush()
+        if undelivered:
+            return 0
+        self.producer.produce_batch("dlq", dead)   # VIOLATION FC402
+        self.consumer.commit_offsets(offsets)
+
+    def _drain_unguarded_finally(self):
+        try:
+            while self._inflight:
+                self._finish(self._inflight.pop(0))
+        finally:
+            while self._inflight:
+                self._finish(self._inflight.pop(0))  # VIOLATION FC403(a)
+
+    def process_no_flag(self, msgs):
+        return self._finish(msgs)                  # VIOLATION FC403(b)
+
+    def _finish(self, batch):
+        return len(batch)
+
+
+class GoodEngine:
+    """The real engine's shape — every rule must pass it untouched."""
+
+    def __init__(self, consumer, producer):
+        self.consumer = consumer
+        self.producer = producer
+        self._flush_failed = False
+        self._inflight = []
+
+    def deliver(self, wires, dead, offsets):
+        produce_batch = getattr(self.producer, "produce_batch", None)
+        if produce_batch is not None:
+            produce_batch("out", wires)
+            produce_batch("dlq", dead)
+        else:
+            for wire, key in wires:
+                self.producer.produce("out", wire, key=key)
+        undelivered = self.producer.flush()
+        if undelivered:
+            self._flush_failed = True
+            return 0
+        try:
+            self.consumer.commit_offsets(offsets)
+        except RuntimeError:
+            pass
+        return len(wires)
+
+    def process_batch(self, msgs):
+        if self._flush_failed:
+            raise RuntimeError("previous flush failed")
+        return self._finish(msgs)
+
+    def run_loop(self):
+        try:
+            while self._inflight:
+                self._finish(self._inflight.pop(0))
+        finally:
+            while self._inflight and not self._flush_failed:
+                self._finish(self._inflight.pop(0))
+
+    def _finish(self, batch):
+        return len(batch)

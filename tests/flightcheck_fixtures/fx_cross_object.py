@@ -1,0 +1,56 @@
+"""Injected violation: cross-object lock-order inversion (FC101, whole-
+program pass — analysis/callgraph.py). Parsed by tests, never imported.
+
+Shape: ``Engine`` holds its own lock while calling into ``Broker``, which
+takes ITS lock (edge Engine._lock -> Broker._lock); ``Broker.kick`` holds
+its lock while calling back into ``Engine.poke``, which takes the engine
+lock (edge Broker._lock -> Engine._lock). Two objects, opposite orders —
+the cross-object deadlock the per-class pass cannot see. The bindings the
+analyzer needs are both inferable: ``Engine.broker`` by direct
+instantiation, ``Broker.engine`` by parameter annotation.
+
+``Quiet`` exercises the clean shape: nested cross-object acquisition in
+ONE consistent order must not be flagged.
+"""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.broker = Broker(self)
+
+    def drive(self):
+        with self._lock:             # Engine._lock -> Broker._lock
+            self.broker.deliver()
+
+    def poke(self):
+        with self._lock:
+            return 1
+
+
+class Broker:
+    def __init__(self, engine: "Engine"):
+        self._lock = threading.Lock()
+        self.engine = engine
+
+    def deliver(self):
+        with self._lock:
+            return 2
+
+    def kick(self):
+        with self._lock:             # Broker._lock -> Engine._lock: VIOLATION
+            self.engine.poke()
+
+
+class Quiet:
+    """Consistent one-way ordering across objects: never flagged."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.broker = Broker(Engine())
+
+    def drive(self):
+        with self._lock:
+            self.broker.deliver()

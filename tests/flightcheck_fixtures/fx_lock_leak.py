@@ -1,0 +1,36 @@
+"""Injected violation: bare lock.acquire() without a guaranteed release
+(FC404, analysis/protocol.py). Parsed by tests, never imported.
+
+``leaky`` and ``leaky_conditional`` must be flagged; ``manual_ok``
+(acquire immediately followed by try/finally release) and ``with_ok``
+are the accepted shapes and must stay clean.
+"""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def leaky(self):
+        self._lock.acquire()         # VIOLATION FC404
+        self.count += 1              # an exception here leaks the lock
+        self._lock.release()
+
+    def leaky_conditional(self):
+        if self._lock.acquire(timeout=0.1):   # VIOLATION FC404
+            self.count += 1
+            self._lock.release()
+
+    def manual_ok(self):
+        self._lock.acquire()
+        try:
+            self.count += 1
+        finally:
+            self._lock.release()
+
+    def with_ok(self):
+        with self._lock:
+            self.count += 1

@@ -1,15 +1,24 @@
 """flightcheck static-analysis suite (fraud_detection_tpu/analysis/).
 
-Three layers:
+Four layers:
 
 1. each rule catches its injected-violation fixture
-   (tests/flightcheck_fixtures/ — modules that are PARSED, never imported);
-2. the clean-tree pin: the real package yields ZERO findings (with the
+   (tests/flightcheck_fixtures/ — modules that are PARSED, never imported),
+   including the PR 6 whole-program rules: cross-object FC101
+   (fx_cross_object.py), the FC401-403 commit-protocol shapes
+   (fx_commit_protocol.py — commit-before-flush, commit-after-failed-
+   flush, record-after-flush, unguarded drains), and FC404 lock leaks
+   (fx_lock_leak.py);
+2. the ``--fix`` pragma engine (scaffold + merge + idempotency pins) and
+   SARIF 2.1.0 output (emitter validity + validator rejection cases);
+3. the clean-tree pin: the real package yields ZERO findings (with the
    deliberate pragma suppressions recorded, not silent) — this is the CI
-   ``flightcheck`` gate as a test;
-3. regression pins for the true positives the first full run flagged and
-   this PR fixed (scheduler prewarm region, hotswap writer locks, the
-   vectorized annotation conversions).
+   ``flightcheck`` gate as a test — plus the pinned analyzer-runtime
+   budget;
+4. regression pins for the true positives full runs flagged and fixed
+   (PR 5: scheduler prewarm region, hotswap writer locks, vectorized
+   annotation conversions; PR 6's process_batch flush-flag guard lives in
+   tests/test_stream.py::test_process_batch_refuses_after_failed_flush).
 """
 
 import json
@@ -23,12 +32,17 @@ import numpy as np
 import pytest
 
 from fraud_detection_tpu.analysis import RULES, run_analysis
-from fraud_detection_tpu.analysis import concurrency, health, jaxlint
+from fraud_detection_tpu.analysis import (callgraph, concurrency, health,
+                                          jaxlint, protocol, sarif)
 from fraud_detection_tpu.analysis import threads as threadmap
-from fraud_detection_tpu.analysis.core import SourceFile, filter_suppressed
-from fraud_detection_tpu.analysis.entrypoints import (CONCURRENT_CLASSES,
+from fraud_detection_tpu.analysis.core import (SourceFile, filter_suppressed,
+                                               load_package)
+from fraud_detection_tpu.analysis.entrypoints import (COMMIT_PROTOCOLS,
+                                                      CONCURRENT_CLASSES,
                                                       ClassSpec,
+                                                      CommitProtocolSpec,
                                                       THREAD_ENTRY_POINTS)
+from fraud_detection_tpu.analysis.fixer import apply_fixes
 from fraud_detection_tpu.utils import racecheck
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -143,6 +157,244 @@ def test_fc103_unregistered_thread_detected():
 
 
 # ---------------------------------------------------------------------------
+# 1b. whole-program + protocol rules (PR 6) catch their fixtures
+# ---------------------------------------------------------------------------
+
+_FX_PROTOCOLS = (
+    CommitProtocolSpec("fx_commit_protocol.py::BadEngine",
+                       drain_names=frozenset({"_finish"}),
+                       failure_flag="_flush_failed"),
+    CommitProtocolSpec("fx_commit_protocol.py::GoodEngine",
+                       drain_names=frozenset({"_finish"}),
+                       failure_flag="_flush_failed"),
+)
+
+
+def test_fc101_cross_object_inversion_detected():
+    """The whole-program pass follows self.attr calls across objects:
+    Engine holds its lock into Broker, Broker holds its lock back into
+    Engine — both inversion edges flagged, the consistently-ordered Quiet
+    class clean."""
+    sf = load_fixture("fx_cross_object.py")
+    findings = callgraph.analyze([sf], bindings={}, implementations={})
+    assert rules_of(findings) == ["FC101"]
+    assert len(findings) == 2, findings
+    assert all("cross-object" in f.message for f in findings)
+    assert any("Engine._lock" in f.message and "Broker._lock" in f.message
+               for f in findings)
+    assert not any("Quiet" in f.message for f in findings)
+
+
+def test_fc101_cross_object_needs_binding():
+    """No receiver binding, no edge: with inference defeated (no annotation,
+    no direct instantiation) the analyzer must stay silent rather than
+    guess — the under-approximation documented in the module docstring."""
+    import textwrap
+    src = textwrap.dedent("""
+        import threading
+        class A:
+            def __init__(self, other):
+                self._lock = threading.Lock()
+                self.other = other
+            def go(self):
+                with self._lock:
+                    self.other.back()
+        class B:
+            def __init__(self, other):
+                self._lock = threading.Lock()
+                self.other = other
+            def back(self):
+                with self._lock:
+                    self.other.go()
+    """)
+    import ast as _ast
+    sf = SourceFile(path="fx.py", relpath="fx.py", text=src,
+                    tree=_ast.parse(src))
+    assert callgraph.analyze([sf], bindings={}, implementations={}) == []
+    # ...and the explicit registry closes exactly that gap.
+    bound = callgraph.analyze(
+        [sf], implementations={},
+        bindings={"fx.py::A.other": ("B",), "fx.py::B.other": ("A",)})
+    assert bound and all(f.rule == "FC101" for f in bound)
+
+
+def test_fc401_commit_protocol_shapes():
+    sf = load_fixture("fx_commit_protocol.py")
+    findings = [f for f in protocol.analyze([sf], protocols=_FX_PROTOCOLS)
+                if f.rule == "FC401"]
+    text = sf.text.splitlines()
+    assert len(findings) == 4, findings
+    for f in findings:
+        assert "VIOLATION FC401" in text[f.line - 1], f
+    msgs = "\n".join(f.message for f in findings)
+    assert "NO producer flush" in msgs          # commit_before_flush
+    assert "result discarded" in msgs           # commit_dropped_flush
+    assert "never checked" in msgs              # unchecked + failure-path
+    # the acceptance shape: commit-after-FAILED-flush is demonstrably caught
+    assert any("commit_on_failure_path" in f.message for f in findings)
+    # GoodEngine (the real engine's shape) stays clean
+    assert not any("GoodEngine" in f.message for f in findings)
+
+
+def test_fc402_record_after_flush():
+    sf = load_fixture("fx_commit_protocol.py")
+    findings = [f for f in protocol.analyze([sf], protocols=_FX_PROTOCOLS)
+                if f.rule == "FC402"]
+    assert len(findings) == 1
+    assert "late_record" in findings[0].message
+    assert "VIOLATION FC402" in sf.text.splitlines()[findings[0].line - 1]
+
+
+def test_fc403_unguarded_drains():
+    sf = load_fixture("fx_commit_protocol.py")
+    findings = [f for f in protocol.analyze([sf], protocols=_FX_PROTOCOLS)
+                if f.rule == "FC403"]
+    assert len(findings) == 2, findings
+    msgs = "\n".join(f.message for f in findings)
+    assert "_drain_unguarded_finally" in msgs   # finally-drain, no flag
+    assert "process_no_flag" in msgs            # public entry, no flag
+    assert not any("GoodEngine" in f.message for f in findings)
+
+
+def test_fc404_lock_leak():
+    sf = load_fixture("fx_lock_leak.py")
+    findings = protocol.analyze([sf], protocols=())
+    assert rules_of(findings) == ["FC404"]
+    text = sf.text.splitlines()
+    assert len(findings) == 2, findings
+    for f in findings:
+        assert "VIOLATION FC404" in text[f.line - 1], f
+    # manual acquire/try/finally and `with` are both accepted shapes
+    assert all(f.line < text.index("    def manual_ok(self):") + 1
+               for f in findings)
+
+
+def test_engine_protocol_registered():
+    """The real engine must be in the FC4xx scope — deleting its protocol
+    spec would silently turn the commit-protocol rules off."""
+    keys = {p.cls_key for p in COMMIT_PROTOCOLS}
+    assert "stream/engine.py::StreamingClassifier" in keys
+    spec = next(p for p in COMMIT_PROTOCOLS
+                if p.cls_key == "stream/engine.py::StreamingClassifier")
+    assert spec.failure_flag == "_flush_failed"
+    assert "_finish" in spec.drain_names
+
+
+def test_class_names_unique_package_wide():
+    """callgraph keys bindings and lock qualifications on bare class names;
+    a duplicate top-level class name would silently degrade the analysis
+    (last definition wins), so pin uniqueness here."""
+    import ast as _ast
+    import collections
+    counts = collections.Counter()
+    for sf in load_package(PKG):
+        for node in sf.tree.body:
+            if isinstance(node, _ast.ClassDef):
+                counts[node.name] += 1
+    dups = sorted(name for name, n in counts.items() if n > 1)
+    assert not dups, f"duplicate top-level class names: {dups}"
+
+
+# ---------------------------------------------------------------------------
+# 1c. --fix pragma engine + SARIF output
+# ---------------------------------------------------------------------------
+
+def _fix_roundtrip_root(tmp_path):
+    import shutil
+    shutil.copy(os.path.join(FIXTURES, "fx_lock_leak.py"),
+                tmp_path / "fx_lock_leak.py")
+    return str(tmp_path)
+
+
+def _analyze_fixture_root(root):
+    sf = SourceFile.load(os.path.join(root, "fx_lock_leak.py"),
+                         "fx_lock_leak.py")
+    raw = protocol.analyze([sf], protocols=())
+    return filter_suppressed({sf.relpath: sf}, raw)
+
+
+def test_fix_scaffolds_and_is_idempotent(tmp_path):
+    root = _fix_roundtrip_root(tmp_path)
+    kept, suppressed = _analyze_fixture_root(root)
+    assert len(kept) == 2 and suppressed == 0
+    edits = apply_fixes(kept, root)
+    assert [e.action for e in edits] == ["insert", "insert"]
+    scaffolded = open(os.path.join(root, "fx_lock_leak.py")).read()
+    assert scaffolded.count("TODO(justify)") == 2
+    # pragmas now suppress both findings...
+    kept2, suppressed2 = _analyze_fixture_root(root)
+    assert kept2 == [] and suppressed2 == 2
+    # ...and a second --fix changes NOTHING (the idempotency pin)
+    assert apply_fixes(kept2, root) == []
+    assert open(os.path.join(root, "fx_lock_leak.py")).read() == scaffolded
+
+
+def test_fix_dry_run_writes_nothing(tmp_path):
+    root = _fix_roundtrip_root(tmp_path)
+    before = open(os.path.join(root, "fx_lock_leak.py")).read()
+    kept, _ = _analyze_fixture_root(root)
+    edits = apply_fixes(kept, root, dry_run=True)
+    assert len(edits) == 2
+    assert open(os.path.join(root, "fx_lock_leak.py")).read() == before
+
+
+def test_fix_merges_into_existing_pragma(tmp_path):
+    """A line already pragma'd for another rule gains the new id in the
+    SAME bracket — no stacked pragma lines."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def leak(self):\n"
+           "        # flightcheck: ignore[FC102] — existing reason\n"
+           "        self._lock.acquire()\n")
+    path = tmp_path / "fx_merge.py"
+    path.write_text(src)
+    sf = SourceFile.load(str(path), "fx_merge.py")
+    kept, _ = filter_suppressed(
+        {sf.relpath: sf}, protocol.analyze([sf], protocols=()))
+    assert len(kept) == 1
+    edits = apply_fixes(kept, str(tmp_path))
+    assert [e.action for e in edits] == ["merge"]
+    out = path.read_text()
+    assert "ignore[FC102,FC404]" in out
+    assert out.count("flightcheck:") == 1
+
+
+def test_sarif_document_valid_and_complete():
+    sf = load_fixture("fx_lock_leak.py")
+    findings = protocol.analyze([sf], protocols=())
+    doc = sarif.build(findings, suppressed=3, n_files=1)
+    assert sarif.validate(doc) == []
+    assert doc["version"] == "2.1.0"
+    assert "2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "flightcheck"
+    # full rule catalog shipped, every result resolvable by ruleIndex
+    ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert ids == sorted(RULES)
+    for res in run["results"]:
+        assert ids[res["ruleIndex"]] == res["ruleId"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].startswith(
+            "fraud_detection_tpu/")
+        assert loc["region"]["startLine"] >= 1
+    assert run["properties"]["suppressedByPragma"] == 3
+
+
+def test_sarif_validator_rejects_broken_documents():
+    doc = sarif.build([], suppressed=0, n_files=0)
+    assert sarif.validate({"version": "2.0.0", "runs": []})
+    bad = json.loads(json.dumps(doc))
+    bad["runs"][0]["tool"]["driver"].pop("name")
+    assert any("driver.name" in p for p in sarif.validate(bad))
+    bad2 = json.loads(json.dumps(doc))
+    bad2["runs"][0]["results"] = [{"ruleId": "FC999",
+                                   "message": {"text": "x"}}]
+    assert any("FC999" in p for p in sarif.validate(bad2))
+
+
+# ---------------------------------------------------------------------------
 # 2. clean tree + registry/runtime sync
 # ---------------------------------------------------------------------------
 
@@ -211,6 +463,52 @@ def test_cli_main_inprocess(tmp_path, capsys):
     for rule in RULES:
         assert rule in out
     assert main(["--rules", "FC999"]) == 2
+    assert main(["--dry-run"]) == 2      # --dry-run requires --fix
+
+
+def test_cli_sarif_and_fix_dry_run(tmp_path, capsys):
+    """--sarif writes a validating 2.1.0 document for the clean tree and
+    --fix --dry-run is a no-op with exit 0 (the CI smoke)."""
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    out_path = tmp_path / "flightcheck.sarif"
+    assert main(["--sarif", str(out_path), "--fix", "--dry-run"]) == 0
+    doc = json.loads(out_path.read_text())
+    assert sarif.validate(doc) == []
+    assert doc["runs"][0]["results"] == []
+    assert doc["runs"][0]["properties"]["suppressedByPragma"] >= 5
+
+
+def test_cli_fix_scaffolds_fixture_tree(tmp_path, capsys):
+    """e2e --fix against a dirty root: exit 1 (findings are triaged, not
+    absolved), pragmas written, second run exits 0 with them suppressed."""
+    import shutil
+
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    shutil.copy(os.path.join(FIXTURES, "fx_lock_leak.py"),
+                tmp_path / "fx_lock_leak.py")
+    argv = ["--root", str(tmp_path), "--rules", "FC404", "--fix"]
+    assert main(argv) == 1
+    out = capsys.readouterr().out
+    assert "2 edit(s) applied" in out
+    assert main(argv) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s), 2 suppressed" in out
+    assert "0 edit(s) applied" in out
+
+
+def test_analyzer_runtime_budget():
+    """Pinned analyzer-runtime budget: the whole-program pass must stay a
+    sub-minute CI gate, not a soak. 30s is ~10x the measured cost on a
+    cold CI runner — a blowup here means an accidental O(n^2) walk, not
+    noise."""
+    start = time.perf_counter()
+    findings, _, n_files = run_analysis()
+    elapsed = time.perf_counter() - start
+    assert findings == []
+    assert n_files > 50
+    assert elapsed < 30.0, f"flightcheck took {elapsed:.1f}s (budget 30s)"
 
 
 # ---------------------------------------------------------------------------

@@ -180,6 +180,49 @@ def test_engine_stops_when_producer_cannot_deliver(pipeline):
     assert all(off == 0 for off in consumer.committed_offsets().values())
 
 
+def test_process_batch_refuses_after_failed_flush(pipeline):
+    """flightcheck FC403 regression (PR 6 true positive): process_batch
+    must not score-and-commit a LATER batch after a failed flush left a
+    batch's offsets uncommitted — its commit would orphan the lost
+    outputs. run() stays the incarnation boundary that resets the flag."""
+    from fraud_detection_tpu.data import generate_corpus
+
+    corpus = generate_corpus(n=16, seed=7)
+    broker = InProcessBroker()
+    _feed(broker, [(d.text, d.label) for d in corpus])
+
+    class FlakyProducer:
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail_next = True
+
+        def produce(self, *a, **k):
+            self.inner.produce(*a, **k)
+
+        def flush(self, timeout=10.0):
+            if self.fail_next:
+                self.fail_next = False
+                return 2
+            return 0
+
+    consumer = broker.consumer(["customer-dialogues-raw"], "pbflag")
+    engine = StreamingClassifier(
+        pipeline, consumer, FlakyProducer(broker.producer()), "out",
+        batch_size=8, max_wait=0.01)
+    msgs = consumer.poll_batch(8, 0.2)
+    assert msgs
+    assert engine.process_batch(msgs) == 0          # flush fails: nothing done
+    assert engine.stats.commits_skipped == 1
+    # the flag latches: the next process_batch would commit past the lost
+    # batch (the producer is healthy again) — it must refuse instead.
+    with pytest.raises(RuntimeError, match="flush failed"):
+        engine.process_batch(msgs)
+    assert all(off == 0 for off in consumer.committed_offsets().values())
+    # run() declares a fresh incarnation (resets the flag) and re-drives.
+    stats = engine.run(max_messages=8, idle_timeout=0.3)
+    assert stats.commits_skipped == 1  # cumulative; no NEW skip this run
+
+
 def test_group_offsets_survive_consumer_restart(pipeline):
     """A NEW consumer in the same group resumes from the group's committed
     offsets (broker-durable, like Kafka's __consumer_offsets)."""
