@@ -54,6 +54,10 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     ("stream/annotations.py", "self._run"),
     # Host featurization shard pool (ThreadPoolExecutor, prefix "featurize").
     ("featurize/parallel.py", "ThreadPoolExecutor"),
+    # Double-buffered async dispatch lane ("dispatch-lane"): featurize +
+    # upload + device launch for batch N+1 while the engine driver
+    # delivers batch N (sched/batcher.py DispatchLane).
+    ("sched/batcher.py", "self._run"),
     # Sanitizer workload driver: hammer threads racing the shard ABI on
     # purpose — TSan is the detector there, not racecheck.
     ("native/san_driver.py", "hammer"),
@@ -97,6 +101,12 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
                "AsyncAnnotationLane._run", None,
                "single worker by construction (one thread started in "
                "__init__, never respawned); queue + counters under _cv"),
+    EntryPoint("dispatch-lane", "sched/batcher.py",
+               "DispatchLane._run", None,
+               "single worker by construction (one thread started in "
+               "__init__, never respawned); queues + counters under _cv, "
+               "and the launch_fn it runs (engine._launch) touches only "
+               "documented monotonic latches outside the _InFlight it owns"),
     EntryPoint("featurize", "featurize/parallel.py",
                "encode_sharded_native", "NativeFeaturizer"),
     EntryPoint("san-hammer", "native/san_driver.py", "hammer", None,
@@ -131,9 +141,18 @@ def _spec(any_thread=(), **workers) -> ClassSpec:
 
 CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     # Engine: single-driver loop; stop()/health() are the documented
-    # cross-thread surface (serve.py Ctrl-C + --health-file poller).
+    # cross-thread surface (serve.py Ctrl-C + --health-file poller). Under
+    # async_dispatch the featurize+launch leg (_launch and below) executes
+    # on the dispatch-lane worker while the driver polls/delivers.
     "stream/engine.py::StreamingClassifier": _spec(
-        any_thread=("stop", "health", "annotation_stats")),
+        any_thread=("stop", "health", "annotation_stats"),
+        dispatch_lane=("_launch",)),
+    # Dispatch lane: one worker runs _run; submit/next/stop are driver-only
+    # (the engine's drive region guards the driver); stats() polls cross-
+    # thread. Everything shared lives under _cv.
+    "sched/batcher.py::DispatchLane": _spec(
+        any_thread=("stats",),
+        dispatch_lane=("_run",)),
     # Annotation lane: one worker drains the queue; stats() polls cross-
     # thread; submit() comes from the engine driver.
     "stream/annotations.py::AsyncAnnotationLane": _spec(
@@ -188,6 +207,7 @@ OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
     "stream/engine.py::StreamingClassifier.consumer": ("Consumer",),
     "stream/engine.py::StreamingClassifier.producer": ("Producer",),
     "stream/engine.py::StreamingClassifier._sched": ("AdaptiveScheduler",),
+    "stream/engine.py::StreamingClassifier._lane": ("DispatchLane",),
     "stream/engine.py::StreamingClassifier._shadow": ("ShadowScorer",),
     "stream/engine.py::StreamingClassifier.pipeline": ("HotSwapPipeline",),
     # Scheduler-owned consume handoff: collect/backlog_of (and the
@@ -260,6 +280,8 @@ COMMIT_PROTOCOLS: Tuple[CommitProtocolSpec, ...] = (
 
 HOT_PATHS: FrozenSet[str] = frozenset({
     "stream/engine.py::StreamingClassifier._dispatch",
+    "stream/engine.py::StreamingClassifier._prepare",
+    "stream/engine.py::StreamingClassifier._launch",
     "stream/engine.py::StreamingClassifier._dispatch_raw_json",
     "stream/engine.py::StreamingClassifier._finish",
     "stream/engine.py::StreamingClassifier._deliver",

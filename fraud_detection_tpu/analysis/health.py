@@ -46,6 +46,8 @@ class Contract:
 CONTRACTS: Tuple[Contract, ...] = (
     Contract("stream/engine.py", "StreamingClassifier.health",
              "test_lifecycle.py", "ENGINE_HEALTH_SCHEMA"),
+    Contract("stream/engine.py", "StreamingClassifier._device_block",
+             "test_lifecycle.py", "DEVICE_BLOCK_SCHEMA"),
     Contract("registry/hotswap.py", "HotSwapPipeline.lifecycle_snapshot",
              "test_lifecycle.py", "MODEL_BLOCK_SCHEMA",
              injected=frozenset({"shadow"})),

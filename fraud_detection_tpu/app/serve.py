@@ -68,19 +68,27 @@ def start_health_writer(path, interval, current_engines, fault_plan=None):
     return finish
 
 
-def build_pipeline(spec: str, batch_size: int):
+def build_pipeline(spec: str, batch_size: int, int8: bool = False):
     from fraud_detection_tpu.models.pipeline import ServingPipeline
 
     if spec.startswith("spark:"):
         from fraud_detection_tpu.checkpoint.spark_artifact import load_spark_pipeline
 
-        return ServingPipeline.from_spark_artifact(
+        pipe = ServingPipeline.from_spark_artifact(
             load_spark_pipeline(spec[len("spark:"):]), batch_size=batch_size)
-    if spec == "synthetic":
+    elif spec == "synthetic":
         from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
 
-        return synthetic_demo_pipeline(batch_size)
-    return ServingPipeline.from_checkpoint(spec, batch_size=batch_size)
+        return synthetic_demo_pipeline(batch_size, int8=int8)
+    else:
+        pipe = ServingPipeline.from_checkpoint(spec, batch_size=batch_size)
+    if int8:
+        # Rebuild with the int8 scoring variant (docs/serving.md): the
+        # quantized weights derive from the loaded model, so this is a
+        # constructor flag, not a second artifact.
+        pipe = ServingPipeline(pipe.featurizer, pipe.model,
+                               batch_size=batch_size, int8=True)
+    return pipe
 
 
 def main(argv=None) -> int:
@@ -124,6 +132,16 @@ def main(argv=None) -> int:
                     help="micro-batch assembly deadline (seconds)")
     ap.add_argument("--pipeline-depth", type=int, default=2,
                     help="device batches kept in flight (hides round-trip latency)")
+    ap.add_argument("--async-dispatch", action="store_true",
+                    help="double-buffered dispatch lane: featurize+upload+"
+                         "launch batch N+1 on a dedicated thread while this "
+                         "worker delivers batch N (sched/batcher.py "
+                         "DispatchLane; counters in health()['device'])")
+    ap.add_argument("--int8", action="store_true",
+                    help="int8 scoring variant (LogisticRegression models "
+                         "only): quantized weights, exact int32 "
+                         "accumulation, fp32-parity pinned by tests "
+                         "(docs/serving.md)")
     ap.add_argument("--batch-deadline-ms", type=float, default=None,
                     help="adaptive scheduler: ship a partial micro-batch "
                          "this many ms after its first row instead of "
@@ -252,6 +270,12 @@ def main(argv=None) -> int:
             promote_policy = PromotionPolicy.parse(args.promote_policy)
         except ValueError as e:
             raise SystemExit(f"bad --promote-policy: {e}")
+    if args.int8 and args.registry:
+        # Registry candidates (watch/hot-swap) are rebuilt by the watcher,
+        # which would silently serve them fp32 — refuse rather than mix
+        # scoring variants across swaps.
+        raise SystemExit("--int8 is not supported with --registry yet "
+                         "(hot-swap candidates would load fp32)")
     if args.pipeline_depth < 1:
         # Fail fast: inside --supervise this would read as a transient
         # incarnation failure and burn restarts on a pure config error.
@@ -404,7 +428,7 @@ def main(argv=None) -> int:
             shadow = ShadowScorer(max_queue=args.shadow_queue,
                                   sample=args.shadow_sample)
     else:
-        pipe = build_pipeline(args.model, args.batch_size)
+        pipe = build_pipeline(args.model, args.batch_size, int8=args.int8)
 
     sched_ladder_costs = None
     if sched_config is not None:
@@ -538,7 +562,8 @@ def main(argv=None) -> int:
                                 dlq_attempts=dlq_attempts,
                                 breaker=breaker,
                                 shadow=shadow,
-                                scheduler=scheduler)
+                                scheduler=scheduler,
+                                async_dispatch=args.async_dispatch)
         engines_built.append(e)
         return e
 

@@ -118,6 +118,7 @@ class HashingTfIdfFeaturizer:
         self._hashing = HashingTF(self.num_features, binary=self.binary_tf)
         self._native = None        # lazy NativeFeaturizer (featurize/native.py)
         self._native_tried = False
+        self._idf_dev = None       # device IDF cache (idf_array)
         if self.idf is not None:
             self.idf = np.asarray(self.idf, np.float32)
             if self.idf.shape != (self.num_features,):
@@ -282,6 +283,7 @@ class HashingTfIdfFeaturizer:
         if min_doc_freq > 0:
             idf = np.where(doc_freq >= min_doc_freq, idf, 0.0)
         self.idf = idf.astype(np.float32)
+        self._idf_dev = None       # refit invalidates the device cache
         self.doc_freq = doc_freq
         self.num_docs = len(texts)
         return self
@@ -289,9 +291,16 @@ class HashingTfIdfFeaturizer:
     # ---------------- device side ----------------
 
     def idf_array(self) -> jnp.ndarray:
-        if self.idf is None:
-            return jnp.ones((self.num_features,), jnp.float32)
-        return jnp.asarray(self.idf)
+        """Device IDF vector, uploaded ONCE and cached. ``featurize_dense``
+        runs per chunk on the tree text path, and an uncached ``jnp.asarray``
+        here re-crossed host->device every batch — model-side constants must
+        stay device-resident (docs/serving.md "device-resident hot path")."""
+        dev = self._idf_dev
+        if dev is None:
+            dev = (jnp.ones((self.num_features,), jnp.float32)
+                   if self.idf is None else jnp.asarray(self.idf))
+            self._idf_dev = dev
+        return dev
 
     def featurize_dense(self, texts: Sequence[str], batch_size: Optional[int] = None) -> jax.Array:
         """Texts -> dense (B, F) TF-IDF device matrix (pads B to batch_size)."""

@@ -63,6 +63,98 @@ def margin_dense(model: LogisticRegression, x: jax.Array) -> jax.Array:
     return x @ model.weights + model.intercept
 
 
+# ---------------------------------------------------------------------------
+# Packed-buffer serving entries (models/pipeline.py device-resident hot path):
+# the host stacks an EncodedBatch's int16 ids and uint16 counts into ONE
+# (B, 2, L) int16 staging array, so a micro-batch costs exactly one
+# host->device transfer; the program unpacks on-device (a reshape + bitcast,
+# free next to the gather). Each entry has a donating twin — when the
+# platform consumes donated buffers (models/pipeline.py donation_effective),
+# the per-batch input buffer is handed to XLA at dispatch instead of waiting
+# for Python refcounting to release it.
+# ---------------------------------------------------------------------------
+
+
+def unpack_rows(packed: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(B, 2, L) int16 -> (ids int32 (B, L), counts float32 (B, L)).
+
+    counts travel as uint16 bits inside the int16 container; the bitcast
+    restores them exactly (values up to 65535, matching EncodedBatch)."""
+    ids = packed[:, 0, :].astype(jnp.int32)
+    counts = jax.lax.bitcast_convert_type(packed[:, 1, :], jnp.uint16)
+    return ids, counts.astype(jnp.float32)
+
+
+def _prob_packed_impl(model: LogisticRegression, packed: jax.Array):
+    ids, counts = unpack_rows(packed)
+    gathered = model.weights[ids]                       # (B, L)
+    m = jnp.sum(gathered * counts, axis=-1) + model.intercept
+    return jax.nn.sigmoid(m)
+
+
+_prob_packed = jax.jit(_prob_packed_impl)
+_prob_packed_donated = jax.jit(_prob_packed_impl, donate_argnums=(1,))
+
+
+def prob_packed(model: LogisticRegression, packed: jax.Array,
+                donate: bool = False) -> jax.Array:
+    """Packed-buffer variant of ``prob_encoded_arrays`` (idf must be folded
+    into the weights). ``donate=True`` dispatches through the donating
+    program — the caller must not touch ``packed`` afterwards."""
+    fn = _prob_packed_donated if donate else _prob_packed
+    return fn(model, packed)
+
+
+# ---------------------------------------------------------------------------
+# int8 scoring variant: symmetric per-BLOCK weight quantization. The gather
+# reads int8 codes (a quarter of the fp32 weight bytes out of HBM) plus one
+# f32 scale per 128-weight block; per-block scales matter because TF-IDF LR
+# weights carry a few huge outliers — a single per-tensor scale quantized
+# everything else to mush (max |Δp| ~0.38 on the shipped artifact; blocks
+# bring it under ~1e-2). Quantization error comes from the one weight
+# rounding, nothing else; fp32 parity is pinned in tests/test_device_path.py.
+# ---------------------------------------------------------------------------
+
+_Q8_BLOCK = 128
+
+
+def quantize_weights(model: LogisticRegression,
+                     block: int = _Q8_BLOCK) -> tuple[jax.Array, jax.Array]:
+    """(int8 codes (ceil(F/block)*block,), f32 per-block scales (nb,)) with
+    w[i] ~= scales[i // block] * w_q[i]. Codes stay padded to a whole number
+    of blocks so consumers recover ``block`` from the two shapes."""
+    w = model.weights
+    f = w.shape[0]
+    nb = -(-f // block)
+    wp = jnp.pad(w, (0, nb * block - f)).reshape(nb, block)
+    absmax = jnp.maximum(jnp.max(jnp.abs(wp), axis=1), 1e-12)
+    scales = (absmax / 127.0).astype(jnp.float32)
+    w_q = jnp.clip(jnp.round(wp / scales[:, None]),
+                   -127, 127).astype(jnp.int8).reshape(-1)
+    return w_q, scales
+
+
+def _prob_packed_q8_impl(w_q: jax.Array, scales: jax.Array,
+                         intercept: jax.Array, packed: jax.Array):
+    block = w_q.shape[0] // scales.shape[0]     # static under jit
+    ids = packed[:, 0, :].astype(jnp.int32)
+    counts = jax.lax.bitcast_convert_type(packed[:, 1, :], jnp.uint16)
+    per_term = (w_q[ids].astype(jnp.float32) * scales[ids // block]
+                * counts.astype(jnp.float32))
+    return jax.nn.sigmoid(jnp.sum(per_term, axis=-1) + intercept)
+
+
+_prob_packed_q8 = jax.jit(_prob_packed_q8_impl)
+_prob_packed_q8_donated = jax.jit(_prob_packed_q8_impl, donate_argnums=(3,))
+
+
+def prob_packed_q8(w_q: jax.Array, scales: jax.Array, intercept: jax.Array,
+                   packed: jax.Array, donate: bool = False) -> jax.Array:
+    """int8 packed-buffer scoring (see ``quantize_weights``)."""
+    fn = _prob_packed_q8_donated if donate else _prob_packed_q8
+    return fn(w_q, scales, intercept, packed)
+
+
 def margin_encoded(model: LogisticRegression, ids: jax.Array, counts: jax.Array) -> jax.Array:
     """Fused sparse scoring over padded (B, L) bucket ids / counts.
 

@@ -39,6 +39,84 @@ class PredictionBatch:
         return iter(zip(self.labels.tolist(), self.probabilities.tolist()))
 
 
+_DONATION_EFFECTIVE: Optional[bool] = None
+
+
+def donation_effective() -> bool:
+    """Does this backend CONSUME donated input buffers? Probed once per
+    process with a tiny program shaped like the serving case (int16 staging
+    buffer in, f32 out — sizes never alias). Platforms that implement
+    donation free the input at dispatch (the HBM win the serving path
+    wants); CPU jax currently keeps the buffer and warns, so the pipeline
+    routes through the non-donating twins there and ``donation_hits``
+    honestly stays 0."""
+    global _DONATION_EFFECTIVE
+    if _DONATION_EFFECTIVE is None:
+        import warnings
+
+        # flightcheck: ignore[FC201] — one-shot probe; cached in _DONATION_EFFECTIVE
+        probe = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32), axis=-1),
+                        donate_argnums=(0,))
+        x = jnp.zeros((2, 2, 4), jnp.int16)
+        jax.block_until_ready(x)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            jax.block_until_ready(probe(x))
+        _DONATION_EFFECTIVE = bool(x.is_deleted())
+    return _DONATION_EFFECTIVE
+
+
+def _pack_encoded(enc) -> Optional[np.ndarray]:
+    """Stack an EncodedBatch into ONE (B, 2, L) int16 staging array so the
+    micro-batch crosses host->device as a single transfer (ids in plane 0,
+    uint16 counts bit-cast into plane 1; linear.unpack_rows restores them
+    exactly). None when the featurizer widened ids past int16 (num_features
+    > 32767) — that configuration keeps the two-array upload."""
+    ids = np.asarray(enc.ids)
+    counts = np.asarray(enc.counts)
+    if ids.dtype != np.int16 or counts.dtype != np.uint16:
+        return None
+    return np.stack([ids, counts.view(np.int16)], axis=1)
+
+
+class DeviceStats:
+    """Per-pipeline device-path counters (the ``device`` block of engine
+    health): host->device crossings, donation hits, and what is pinned
+    HBM-resident. Single-writer — the dispatching thread — with racy reads
+    from health pollers by design (a monitoring sample, like StreamStats)."""
+
+    __slots__ = ("uploads", "upload_bytes", "chunks", "donated",
+                 "pinned_bytes", "pins", "int8")
+
+    def __init__(self, int8: bool = False):
+        self.uploads = 0        # host->device transfer events
+        self.upload_bytes = 0
+        self.chunks = 0         # micro-batch chunks dispatched
+        self.donated = 0        # chunks dispatched through a donating program
+        self.pinned_bytes = 0   # model-side bytes made device-resident
+        self.pins = 0           # pin_device() calls (1/version; re-pin on swap)
+        self.int8 = int8
+
+    def record_chunk(self, nbytes: int, transfers: int = 1) -> None:
+        self.chunks += 1
+        self.uploads += transfers
+        self.upload_bytes += nbytes
+
+    def snapshot(self) -> dict:
+        chunks = self.chunks
+        return {
+            "uploads": self.uploads,
+            "upload_bytes": self.upload_bytes,
+            "chunks": chunks,
+            "uploads_per_chunk": (round(self.uploads / chunks, 3)
+                                  if chunks else None),
+            "donation_hits": self.donated,
+            "pinned_bytes": self.pinned_bytes,
+            "model_pins": self.pins,
+            "int8": self.int8,
+        }
+
+
 class PendingPrediction:
     """Unresolved device results from ``ServingPipeline.predict_async``.
 
@@ -78,7 +156,8 @@ class ServingPipeline:
 
     def __init__(self, featurizer: HashingTfIdfFeaturizer,
                  model: "LogisticRegression | TreeEnsemble",
-                 fold_idf: bool = True, batch_size: int = 256, mesh=None):
+                 fold_idf: bool = True, batch_size: int = 256, mesh=None,
+                 int8: bool = False):
         self.featurizer = featurizer
         self.batch_size = batch_size
         self.mesh = mesh  # data-parallel serving: rows sharded on "data"
@@ -99,6 +178,24 @@ class ServingPipeline:
             # matrix (one scatter + traversal, still one device program).
             self._fused_model = None
         self._tree_idf = None  # device IDF cache for the tree fast path
+        # int8 scoring variant (docs/serving.md): symmetric per-block
+        # quantization of the fused weights (models/linear.py
+        # quantize_weights). Rides the packed upload path; fp32 parity
+        # pinned in tests/test_device_path.py.
+        self.int8 = bool(int8)
+        self._q8 = None
+        if self.int8:
+            if self._fused_model is None:
+                raise ValueError(
+                    "int8 scoring requires a LogisticRegression pipeline — "
+                    "tree ensembles serve fp32 (their traversal compares "
+                    "thresholds, not dot products)")
+            self._q8 = linear_mod.quantize_weights(self._fused_model)
+        self.device_stats = DeviceStats(int8=self.int8)
+        # Donate per-batch staging buffers into the scoring program when the
+        # platform consumes them (probed once; False on CPU).
+        self._donate = donation_effective()
+        self._pinned_version: Optional[object] = None
 
     def _pad_rows(self, n: int) -> int:
         """Row-padding target for an n-row chunk: the smallest ladder rung
@@ -242,40 +339,107 @@ class ServingPipeline:
             self.model.kind in ("gbt", "xgboost")  # boosted margins are binary
             or self.model.leaf.shape[-1] == 2)
 
+    def pin_device(self) -> dict:
+        """Make every model-side constant device-resident NOW, off the hot
+        path: fused LR weights (int8 codes + scale when enabled), tree
+        ensemble arrays, and the TF-IDF idf vector. Called once per model
+        version — at engine start, at bench warm, and by HotSwapPipeline's
+        prewarm so every swap/stage candidate RE-pins before it goes active
+        — never per batch. Idempotent per pipeline; returns the pin stats."""
+        ds = self.device_stats
+        if self._pinned_version is not None:
+            return {"pinned_bytes": ds.pinned_bytes, "model_pins": ds.pins}
+        arrs = [a for a in jax.tree_util.tree_leaves(
+                    self._fused_model if self._fused_model is not None
+                    else self.model)
+                if isinstance(a, jax.Array)]
+        if self._fused_model is None and self._tree_idf is None:
+            self._tree_idf = self.featurizer.idf_array()
+        if self._tree_idf is not None:
+            arrs.append(self._tree_idf)
+        if self._q8 is not None:
+            arrs.extend(self._q8)
+        jax.block_until_ready(arrs)
+        ds.pinned_bytes = int(sum(a.size * a.dtype.itemsize for a in arrs))
+        ds.pins += 1
+        self._pinned_version = object()
+        return {"pinned_bytes": ds.pinned_bytes, "model_pins": ds.pins}
+
     def _device_rows(self, ids, counts):
-        """Place one encoded chunk for scoring: plain device arrays single-
-        chip, or row-sharded over the serving mesh's "data" axis. The SAME
-        jitted scoring programs serve both — jit follows input shardings and
-        GSPMD adds the final gather, so mesh-backed streaming (engine ->
-        data-parallel scoring) is a placement decision, not a second code
-        path. shard_rows pads rows to a data-axis multiple; PendingPrediction
-        already slices every chunk back to its real count."""
+        """Fallback placement for one encoded chunk when the packed staging
+        layout doesn't apply (ids widened to int32): two device arrays,
+        plain single-chip or row-sharded over the serving mesh's "data"
+        axis. The SAME jitted scoring programs serve both — jit follows
+        input shardings and GSPMD adds the final gather, so mesh-backed
+        streaming (engine -> data-parallel scoring) is a placement decision,
+        not a second code path. shard_rows pads rows to a data-axis
+        multiple; PendingPrediction already slices every chunk back to its
+        real count."""
+        ids = np.asarray(ids)
+        counts = np.asarray(counts)
+        self.device_stats.record_chunk(ids.nbytes + counts.nbytes,
+                                       transfers=2)
         if self.mesh is None:
             return jnp.asarray(ids), jnp.asarray(counts)
         from fraud_detection_tpu.parallel.mesh import shard_rows
 
-        return (shard_rows(np.asarray(ids), self.mesh),
-                shard_rows(np.asarray(counts), self.mesh))
+        return shard_rows(ids, self.mesh), shard_rows(counts, self.mesh)
+
+    def _device_packed(self, packed: np.ndarray):
+        """Place one packed (B, 2, L) staging buffer: ONE host->device
+        transfer per micro-batch chunk (the accounting the bench's
+        ``device`` block commits)."""
+        self.device_stats.record_chunk(packed.nbytes, transfers=1)
+        if self.mesh is None:
+            return jnp.asarray(packed)
+        from fraud_detection_tpu.parallel.mesh import shard_rows
+
+        return shard_rows(packed, self.mesh)
 
     def _dispatch_fused(self, enc) -> object:
         """Launch fused sparse LR scoring for one encoded chunk and start the
-        async device->host fetch; shared by both predict paths."""
-        ids, counts = self._device_rows(enc.ids, enc.counts)
-        p = linear_mod.prob_encoded_arrays(self._fused_model, ids, counts)
+        async device->host fetch; shared by both predict paths. The chunk
+        rides the packed single-buffer upload, donated into the scoring
+        program where the platform consumes donations; int8 pipelines score
+        through the quantized program on the same staging buffer."""
+        packed = _pack_encoded(enc)
+        if packed is None:
+            ids, counts = self._device_rows(enc.ids, enc.counts)
+            p = linear_mod.prob_encoded_arrays(self._fused_model, ids, counts)
+        else:
+            dev = self._device_packed(packed)
+            if self._q8 is not None:
+                p = linear_mod.prob_packed_q8(
+                    self._q8[0], self._q8[1], self._fused_model.intercept,
+                    dev, donate=self._donate)
+            else:
+                p = linear_mod.prob_packed(self._fused_model, dev,
+                                           donate=self._donate)
+            if self._donate:
+                self.device_stats.donated += 1
         copy_async = getattr(p, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()  # start the device->host fetch behind the dispatch
         return p
 
     def _dispatch_tree(self, enc, binary: bool) -> object:
-        """Launch fused scatter-to-dense + ensemble traversal for one encoded
-        chunk and start the async device->host fetch."""
+        """Launch the scatter-free ensemble traversal for one encoded chunk
+        and start the async device->host fetch."""
         if self._tree_idf is None:
-            # One upload, reused every chunk (idf_array() re-transfers
-            # host->device per call — poison on the latency-critical path).
+            # One upload, reused every chunk (pin_device does this off the
+            # hot path; this is the fallback for unpinned pipelines).
             self._tree_idf = self.featurizer.idf_array()
-        ids, counts = self._device_rows(enc.ids, enc.counts)
-        p = _tree_prob_encoded(self.model, ids, counts, self._tree_idf, binary)
+        packed = _pack_encoded(enc)
+        if packed is None:
+            ids, counts = self._device_rows(enc.ids, enc.counts)
+            p = _tree_prob_encoded(self.model, ids, counts, self._tree_idf,
+                                   binary)
+        else:
+            dev = self._device_packed(packed)
+            p = _tree_prob_packed(self.model, dev, self._tree_idf, binary,
+                                  donate=self._donate)
+            if self._donate:
+                self.device_stats.donated += 1
         copy_async = getattr(p, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()  # start the device->host fetch behind the dispatch
@@ -302,21 +466,17 @@ class ServingPipeline:
         for start in range(0, len(texts), self.batch_size):
             chunk = list(texts[start : start + self.batch_size])
             n = len(chunk)
+            enc = self.featurizer.encode(chunk, batch_size=self._pad_rows(n))
             if self._fused_model is not None:
-                enc = self.featurizer.encode(chunk,
-                                             batch_size=self._pad_rows(n))
                 parts.append((self._dispatch_fused(enc), n))
                 threshold = self._fused_model.threshold
                 continue
-            dense = self.featurizer.featurize_dense(
-                chunk, batch_size=self._pad_rows(n))
-            proba = trees_mod.predict_proba(self.model, jnp.asarray(dense))
-            p = proba[:, 1] if tree_binary else proba
+            # Trees ride the same scatter-free encoded traversal (and packed
+            # upload) as the raw-JSON path — the old densify-then-traverse
+            # formulation paid a (B, F) XLA scatter plus a second upload
+            # per chunk for bit-identical probabilities.
+            parts.append((self._dispatch_tree(enc, tree_binary), n))
             argmax = not tree_binary
-            copy_async = getattr(p, "copy_to_host_async", None)
-            if copy_async is not None:
-                copy_async()  # start the device->host fetch behind the dispatch
-            parts.append((p, n))
         return PendingPrediction(parts, threshold=threshold, argmax=argmax)
 
     def predict(self, texts: Sequence[str]) -> PredictionBatch:
@@ -341,11 +501,32 @@ def _tree_prob_encoded(ensemble: TreeEnsemble, ids, counts, idf, binary: bool):
     return proba[:, 1] if binary else proba
 
 
+def _tree_prob_packed_impl(ensemble: TreeEnsemble, packed, idf, binary: bool):
+    ids, counts = linear_mod.unpack_rows(packed)
+    proba = trees_mod.predict_proba_encoded(ensemble, ids, counts, idf)
+    return proba[:, 1] if binary else proba
+
+
+_tree_prob_packed_plain = jax.jit(_tree_prob_packed_impl,
+                                  static_argnames=("binary",))
+_tree_prob_packed_donating = jax.jit(_tree_prob_packed_impl,
+                                     static_argnames=("binary",),
+                                     donate_argnums=(1,))
+
+
+def _tree_prob_packed(ensemble: TreeEnsemble, packed, idf, binary: bool,
+                      donate: bool = False):
+    """Packed-staging-buffer twin of ``_tree_prob_encoded`` (one upload per
+    chunk; buffer donated where the platform consumes donations)."""
+    fn = _tree_prob_packed_donating if donate else _tree_prob_packed_plain
+    return fn(ensemble, packed, idf, binary)
+
+
 def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 7,
                             num_features: int = 10000,
                             model: str = "lr",
                             corpus_kwargs: dict | None = None,
-                            mesh=None) -> ServingPipeline:
+                            mesh=None, int8: bool = False) -> ServingPipeline:
     """Train a quick model on the synthetic corpus — the shared demo/bench
     fallback pipeline (one recipe, used by bench.py and app/serve.py).
     ``model``: "lr" (default) | "dt" | "rf" | "xgb". ``corpus_kwargs`` is
@@ -371,4 +552,5 @@ def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 
         clf = fit_gradient_boosting(X, y, n_rounds=20)
     else:
         raise ValueError(f"unknown demo model {model!r}")
-    return ServingPipeline(feat, clf, batch_size=batch_size, mesh=mesh)
+    return ServingPipeline(feat, clf, batch_size=batch_size, mesh=mesh,
+                           int8=int8)

@@ -50,8 +50,7 @@ def _loss_fn(params, X, y, mask, l2):
     return loss + 0.5 * l2 * jnp.sum(w * w)
 
 
-@partial(jax.jit, static_argnames=("max_iter",), donate_argnums=())
-def _fit_lbfgs(X, y, mask, l2, tol, max_iter: int):
+def _fit_lbfgs_impl(X, y, mask, l2, tol, max_iter: int):
     F = X.shape[1]
     params = (jnp.zeros((F,), X.dtype), jnp.zeros((), X.dtype))
     opt = optax.lbfgs()
@@ -83,6 +82,19 @@ def _fit_lbfgs(X, y, mask, l2, tol, max_iter: int):
     return params, final_loss, iters
 
 
+# The training matrix is the big buffer (N x F f32 — 800MB at the bench
+# shape) and it is dead the moment the fit returns: the donating variant
+# hands X/y/mask to XLA at dispatch so their HBM is reclaimable during the
+# fit instead of after Python refcounting. Used only where the platform
+# consumes donations (models/pipeline.py donation_effective — CPU keeps
+# donated buffers and warns, so the plain twin serves there). The old
+# ``donate_argnums=()`` here donated nothing; tests/test_train_linear.py
+# pins the donating twin's lowering so it can't silently regress to that.
+_fit_lbfgs = partial(jax.jit, static_argnames=("max_iter",))(_fit_lbfgs_impl)
+_fit_lbfgs_donating = partial(jax.jit, static_argnames=("max_iter",),
+                              donate_argnums=(0, 1, 2))(_fit_lbfgs_impl)
+
+
 def fit_logistic_regression(
     X,
     y,
@@ -111,7 +123,12 @@ def fit_logistic_regression(
         md = mesh_lib.shard_rows(mask, mesh)
     else:
         Xd, yd, md = jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)
-    (w, b), final_loss, iters = _fit_lbfgs(
+    # Xd/yd/md are fresh uploads owned by this frame — donating them is
+    # always safe; the caller's numpy arrays are untouched either way.
+    from fraud_detection_tpu.models.pipeline import donation_effective
+
+    fit = _fit_lbfgs_donating if donation_effective() else _fit_lbfgs
+    (w, b), final_loss, iters = fit(
         Xd, yd, md, jnp.float32(reg_param), jnp.float32(tol), max_iter)
     model = LogisticRegression(weights=w, intercept=b, threshold=threshold)
     if return_info:

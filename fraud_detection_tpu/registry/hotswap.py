@@ -169,7 +169,15 @@ class HotSwapPipeline:
         pipeline will serve, so compiles happen HERE, not on the first
         post-swap production batch. Blocks until device results land. With a
         ladder configured, every rung's shape is warmed (a partial batch
-        then pads to a rung, so the rung set IS the compiled-shape menu)."""
+        then pads to a rung, so the rung set IS the compiled-shape menu).
+
+        Also RE-PINS the candidate's model arrays HBM-resident
+        (ServingPipeline.pin_device): pinning happens once per model
+        version, here at stage/swap time — never per batch — so a hot swap
+        pays its uploads off the hot path like its compiles."""
+        pin = getattr(pipeline, "pin_device", None)
+        if callable(pin):
+            pin()
         if self._pad_buckets is not None:
             from fraud_detection_tpu.sched.batcher import prewarm_ladder
 

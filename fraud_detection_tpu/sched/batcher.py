@@ -23,8 +23,10 @@ path only ever snaps to one of them.
 from __future__ import annotations
 
 import json
+import threading
 import time
-from typing import List, Optional, Sequence
+from collections import deque
+from typing import Callable, List, Optional, Sequence
 
 _MIN_BUCKET = 16
 
@@ -164,6 +166,135 @@ def prewarm_ladder(pipeline, buckets: Sequence[int],
             fast[0].resolve()
         warmed += 1
     return warmed
+
+
+class DispatchLane:
+    """Double-buffered async dispatch: ONE background thread runs the
+    engine's featurize + upload + device-launch leg (``launch_fn``) for
+    batch N+1 while the driver thread resolves / delivers batch N.
+
+    The consume->score handoff today serializes the finish leg (device
+    wait, frame assembly, produce, flush, commit) against the NEXT batch's
+    host featurize on one thread; the lane moves featurize+launch off the
+    driver so the device never waits on host featurize and the host never
+    blocks on resolution except at delivery time (docs/serving.md
+    "device-resident hot path"). ``depth`` bounds featurized-but-undelivered
+    batches — 2 is classic double buffering: one staging buffer uploading/
+    scoring while the alternate one fills.
+
+    Contracts:
+
+    * **Strict FIFO.** A single worker drains submissions in order and
+      ``next()`` returns results in the same order, so the engine's offset
+      commits stay ordered exactly as in synchronous mode.
+    * **Failure transparency.** An exception inside ``launch_fn`` is
+      re-raised from ``next()`` at the failed batch's FIFO position; the
+      driver's abort path then discards newer batches uncommitted
+      (at-least-once replay), exactly like a synchronous dispatch raise.
+    * **Threading.** ``submit``/``next``/``stop``/``pending`` are
+      driver-only (the engine's drive region guards the driver);
+      ``stats()`` is safe from any thread. Queue and counters live under
+      one condition variable.
+    """
+
+    def __init__(self, launch_fn: Callable, depth: int = 2, *,
+                 name: str = "dispatch-lane"):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._launch_fn = launch_fn
+        self.depth = depth
+        self._cv = threading.Condition()
+        self._in: deque = deque()      # submitted, not yet launched
+        self._out: deque = deque()     # (inflight, exc) in submission order
+        self._stopped = False
+        self.submitted = 0
+        self.launched = 0
+        self.delivered = 0             # popped by next()
+        self.waits = 0                 # next() calls that had to block
+        self.max_inflight = 0          # peak submitted-minus-delivered
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # driver surface
+    # ------------------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Batches submitted but not yet returned by ``next()``."""
+        with self._cv:
+            return self.submitted - self.delivered
+
+    def submit(self, item) -> None:
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("dispatch lane is stopped")
+            self._in.append(item)
+            self.submitted += 1
+            self.max_inflight = max(self.max_inflight,
+                                    self.submitted - self.delivered)
+            self._cv.notify_all()
+
+    def next(self, timeout: Optional[float] = None):
+        """Oldest launched batch (FIFO), blocking until the worker finishes
+        it. Raises the worker's exception at that batch's position."""
+        with self._cv:
+            if not self._out:
+                self.waits += 1
+                if not self._cv.wait_for(lambda: bool(self._out),
+                                         timeout=timeout):
+                    raise TimeoutError(
+                        f"dispatch lane produced nothing in {timeout}s "
+                        f"(pending={self.submitted - self.delivered})")
+            inflight, exc = self._out.popleft()
+            self.delivered += 1
+            if exc is not None:
+                raise exc
+            return inflight
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the worker and DISCARD anything not yet returned — the
+        engine only calls this after draining what it intends to deliver;
+        discarded batches were never committed, so a restart replays them
+        (at-least-once, same as an abort in synchronous mode)."""
+        with self._cv:
+            self._stopped = True
+            self._in.clear()
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "depth": self.depth,
+                "submitted": self.submitted,
+                "launched": self.launched,
+                "max_inflight": self.max_inflight,
+                "driver_waits": self.waits,
+            }
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._in and not self._stopped:
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                item = self._in.popleft()
+            inflight, exc = None, None
+            try:
+                inflight = self._launch_fn(item)
+            except BaseException as e:  # noqa: BLE001 — re-raised in next()
+                exc = e
+            with self._cv:
+                self._out.append((inflight, exc))
+                self.launched += 1
+                self._cv.notify_all()
 
 
 class DynamicBatcher:

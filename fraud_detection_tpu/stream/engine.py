@@ -234,6 +234,7 @@ class StreamingClassifier:
         breaker: Optional[object] = None,
         shadow: Optional[object] = None,
         scheduler: Optional[object] = None,
+        async_dispatch: bool = False,
         clock: Callable[[], float] = time.monotonic,
     ):
         if pipeline_depth < 1:
@@ -330,6 +331,19 @@ class StreamingClassifier:
                 "scheduler sheds (shed_policy != 'none') but no dlq_topic is "
                 "set — shed rows must land as explicit DLQ records")
         self._sched = scheduler
+        # Double-buffered async dispatch (sched/batcher.py DispatchLane,
+        # docs/serving.md): the featurize+upload+launch leg runs on a
+        # dedicated lane thread while this (driver) thread delivers the
+        # previous batch — the device never waits on host featurize.
+        # Delivery (_finish: produce/flush/commit) and admission stay on
+        # the driver, so the commit protocol and single-driver contracts
+        # are unchanged; the lane preserves strict FIFO. Off by default:
+        # the lane is the serving configuration (bench + serve CLI
+        # --async-dispatch), not a semantics change for library callers.
+        self.async_dispatch = bool(async_dispatch)
+        self._lane = None                       # live lane while run()s
+        self._lane_stats: Optional[dict] = None  # last run's lane counters
+        self._max_inflight = 0
         # Optional registry/shadow.ShadowScorer: each scored batch's inputs
         # + primary results are offered to the candidate's async scorer
         # (non-blocking bounded queue — registry/shadow.py). The hot loop
@@ -385,13 +399,15 @@ class StreamingClassifier:
     def _dispatch(self, msgs: List[Message]) -> "_InFlight":
         """Decode + featurize + launch device scoring; does NOT block on the
         device. Returns the in-flight batch handle for ``_finish``.
+        Synchronous composition of the two dispatch halves — the async lane
+        runs ``_prepare`` on the driver and ``_launch`` on the lane thread."""
+        return self._launch(self._prepare(msgs))
 
-        The featurize leg is multi-core on both paths: the raw-JSON encode
-        shards inside one C++ call (native/fast_featurize.cpp run_sharded)
-        and the text fallback shards across the Python thread pool
-        (featurize/parallel.py via ``pipeline.predict_async``) — so at
-        ``pipeline_depth >= 2`` the host leg that overlaps the device wait
-        is itself parallel, not one GIL-bound thread."""
+    def _prepare(self, msgs: List[Message]) -> "_Prep":
+        """Driver-side admission for a freshly polled batch: offset cover,
+        scheduler shedding, poison screening. Always runs on the driver
+        thread — admission shares region-guarded scheduler state and the
+        poison tracker with the rest of the drive loop."""
         t0 = time.perf_counter()
         # Offsets cover the ORIGINAL batch — rows screened out below are
         # handled (their DLQ record ships with this batch) and must commit.
@@ -425,7 +441,23 @@ class StreamingClassifier:
             if dead is None:
                 dead, dead_reasons = [], {}
             msgs = self._screen_poison(msgs, dead, dead_reasons)
+        return _Prep(msgs, offsets, dead, dead_reasons, shed_n,
+                     time.perf_counter() - t0)
 
+    def _launch(self, prep: "_Prep") -> "_InFlight":
+        """Featurize + device dispatch for a prepared batch; does NOT block
+        on the device. Runs on the driver (sync mode) or the dispatch lane's
+        worker thread (``async_dispatch``) — it touches no driver-owned
+        state beyond the documented monotonic fast-path latches.
+
+        The featurize leg is multi-core on both paths: the raw-JSON encode
+        shards inside one C++ call (native/fast_featurize.cpp run_sharded)
+        and the text fallback shards across the Python thread pool
+        (featurize/parallel.py via ``pipeline.predict_async``) — so the host
+        leg that overlaps the device wait is itself parallel, not one
+        GIL-bound thread."""
+        t0 = time.perf_counter()
+        msgs, offsets = prep.msgs, prep.offsets
         inflight = None
         if msgs and self._json_fast is not False:
             inflight = self._dispatch_raw_json(msgs, offsets, t0)
@@ -436,15 +468,16 @@ class StreamingClassifier:
                        if valid_idx else None)
             inflight = _InFlight(msgs, texts, valid_idx, pending, offsets,
                                  time.perf_counter() - t0)
-        if dead:
-            inflight.dead = dead
-            inflight.dead_reasons = dead_reasons
+        inflight.dispatch_time += prep.prep_time
+        if prep.dead:
+            inflight.dead = prep.dead
+            inflight.dead_reasons = prep.dead_reasons
             # Screened/shed rows are OUTSIDE inflight.msgs — message
             # accounting (processed, budget) must add them back; rows
             # diverted later in _finish stay inside msgs and must not be
             # added twice.
-            inflight.dead_screened = len(dead)
-            inflight.shed_n = shed_n
+            inflight.dead_screened = len(prep.dead)
+            inflight.shed_n = prep.shed_n
         # Wall-clock receipt stamp: the enqueue->produce fallback origin for
         # transports whose messages carry no producer timestamp.
         inflight.recv_wall = time.time()
@@ -748,6 +781,7 @@ class StreamingClassifier:
             "shed": self.stats.shed,
             "row_latency_ms": {"p50": self.stats.row_latency_ms(0.50),
                                "p99": self.stats.row_latency_ms(0.99)},
+            "device": self._device_block(),
             "sched": (self._sched.snapshot()
                       if self._sched is not None else None),
             "dlq": (None if self.dlq_topic is None else {
@@ -760,6 +794,33 @@ class StreamingClassifier:
                         if breaker is not None and hasattr(breaker, "snapshot")
                         else None),
             "model": model,
+        }
+
+    def _device_block(self) -> dict:
+        """The ``device`` block of ``health()``: how device-resident the hot
+        path is right now — dispatch-lane depth and overlap, host->device
+        crossings per micro-batch, donation hits, and what is pinned in
+        HBM. Pipeline counters come from the ACTIVE pipeline's DeviceStats
+        (None fields when the pipeline doesn't expose them — fakes/tests);
+        lane counters come from the live lane, or the last run's snapshot
+        once it has stopped."""
+        lane = self._lane
+        ls = lane.stats() if lane is not None else (self._lane_stats or {})
+        ds = getattr(self.pipeline, "device_stats", None)
+        snap = ds.snapshot() if ds is not None else {}
+        return {
+            "async_dispatch": self.async_dispatch,
+            "dispatch_depth": self.pipeline_depth,
+            "max_inflight": ls.get("max_inflight", self._max_inflight),
+            "lane_batches": ls.get("launched"),
+            "driver_waits": ls.get("driver_waits"),
+            "uploads": snap.get("uploads"),
+            "upload_bytes": snap.get("upload_bytes"),
+            "uploads_per_batch": snap.get("uploads_per_chunk"),
+            "donation_hits": snap.get("donation_hits"),
+            "pinned_bytes": snap.get("pinned_bytes"),
+            "model_pins": snap.get("model_pins"),
+            "int8": snap.get("int8"),
         }
 
     def close_annotations(self, timeout: float = 30.0) -> bool:
@@ -961,8 +1022,17 @@ class StreamingClassifier:
                 self._running = False
                 return self.stats
             self._flush_failed = False
+            # Pin the model HBM-resident off the hot path (once per model
+            # version — pin_device is idempotent; hot-swap candidates
+            # re-pin at stage/swap prewarm).
+            pin = getattr(self.pipeline, "pin_device", None)
+            if callable(pin):
+                pin()
             started = time.perf_counter()
             idle_since: Optional[float] = None
+            if self.async_dispatch:
+                return self._run_loop_async(started, idle_since,
+                                            max_messages, idle_timeout)
             in_flight: "deque[_InFlight]" = deque()
             return self._run_loop(started, idle_since, in_flight,
                                   max_messages, idle_timeout)
@@ -1002,6 +1072,7 @@ class StreamingClassifier:
                     continue
                 idle_since = None
                 in_flight.append(self._dispatch(msgs))
+                self._max_inflight = max(self._max_inflight, len(in_flight))
                 if len(in_flight) > self.pipeline_depth:
                     self._finish(in_flight.popleft())
                 self._inflight_depth = len(in_flight)
@@ -1026,6 +1097,100 @@ class StreamingClassifier:
             self._running = False
             self.stats.elapsed = time.perf_counter() - started
         return self.stats
+
+    def _run_loop_async(self, started, idle_since, max_messages,
+                        idle_timeout) -> StreamStats:
+        """The drive loop with the double-buffered dispatch lane: identical
+        batch schedule and delivery invariants to ``_run_loop``, except the
+        featurize+launch leg of each batch runs on the lane thread. The
+        driver polls, admits, submits, and delivers; ``lane.next()`` returns
+        batches strictly FIFO, so offsets commit in order exactly as in
+        synchronous mode, and a lane-side failure re-raises here at the
+        failed batch's position (newer batches are then discarded
+        uncommitted — at-least-once replay, as documented)."""
+        from fraud_detection_tpu.sched.batcher import DispatchLane
+
+        lane = DispatchLane(self._launch, depth=self.pipeline_depth)
+        self._lane = lane
+        pending: "deque[_Prep]" = deque()   # submitted, not yet delivered
+        try:
+            while self._running:
+                budget = self.batch_size
+                if max_messages is not None:
+                    consumed = self.stats.processed + sum(
+                        p.n_rows for p in pending)
+                    budget = min(budget, max_messages - consumed)
+                if budget <= 0:
+                    if pending:
+                        self._finish(lane.next())
+                        pending.popleft()
+                        self._inflight_depth = len(pending)
+                        continue
+                    break
+                if self._sched is not None:
+                    msgs = self._sched.collect(self.consumer, budget,
+                                               self.max_wait)
+                else:
+                    msgs = self.consumer.poll_batch(budget, self.max_wait)
+                if not msgs:
+                    if pending:
+                        # Drain the tail rather than idling behind it.
+                        self._finish(lane.next())
+                        pending.popleft()
+                        self._inflight_depth = len(pending)
+                        continue
+                    now = time.perf_counter()
+                    idle_since = idle_since or now
+                    if idle_timeout is not None and now - idle_since >= idle_timeout:
+                        break
+                    continue
+                idle_since = None
+                prep = self._prepare(msgs)
+                lane.submit(prep)
+                pending.append(prep)
+                if len(pending) > self.pipeline_depth:
+                    self._finish(lane.next())
+                    pending.popleft()
+                self._inflight_depth = len(pending)
+        except BaseException:
+            # Same abort contract as the sync loop: never finish newer
+            # batches past an interrupted/failed one — leave them
+            # uncommitted for the restart to replay.
+            pending.clear()
+            raise
+        finally:
+            try:
+                while pending and not self._flush_failed:
+                    self._finish(lane.next())
+                    pending.popleft()
+            finally:
+                lane.stop()
+                self._lane_stats = lane.stats()
+                self._max_inflight = max(self._max_inflight,
+                                         lane.max_inflight)
+                self._lane = None
+                self._inflight_depth = 0
+                self._running = False
+                self.stats.elapsed = time.perf_counter() - started
+        return self.stats
+
+
+@dataclass
+class _Prep:
+    """A polled micro-batch after driver-side admission (shed + poison
+    screen), ready for the featurize+launch leg (``_launch``) — the unit
+    the async dispatch lane carries between threads."""
+    msgs: List[Message]
+    offsets: dict
+    dead: Optional[List[tuple]]
+    dead_reasons: Optional[dict]
+    shed_n: int
+    prep_time: float            # driver seconds spent preparing
+
+    @property
+    def n_rows(self) -> int:
+        """Rows this batch accounts for (kept + screened/shed)."""
+        return len(self.msgs) + (len(self.dead) if self.dead else 0)
 
 
 @dataclass

@@ -1,0 +1,439 @@
+"""Device-resident hot path (PR 7): packed single-buffer uploads, buffer
+donation, HBM pinning, the int8 scoring variant, and the double-buffered
+async dispatch lane.
+
+Invariants pinned here (all CPU-runnable):
+
+* the packed (B, 2, L) staging layout round-trips ids AND uint16 counts
+  exactly (including the top-bit range a signed bitcast could corrupt) and
+  scores identically to the two-array path;
+* int8 predictions agree with fp32 (labels identical, probabilities within
+  tolerance) on the deterministic demo model — the parity pin behind the
+  ``--int8`` serving knob;
+* donation is real where claimed: the donating scoring/training twins carry
+  the buffer-donor attribute in their lowering (the old
+  ``donate_argnums=()`` no-op cannot come back silently), and results match
+  the non-donating twins;
+* ``pin_device`` pins once per pipeline and hot-swap candidates RE-pin at
+  stage/swap (never per batch);
+* the dispatch lane preserves strict FIFO, re-raises worker failures at the
+  failed batch's position, and the async engine delivers byte-identical
+  output to the sync engine — zero loss under seeded chaos faults included;
+* ``health()["device"]`` carries the crossing counters the bench artifact
+  commits (<=1 upload per micro-batch, dispatch depth, donation hits).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.models import linear as linear_mod
+from fraud_detection_tpu.models.pipeline import (ServingPipeline,
+                                                 _pack_encoded,
+                                                 donation_effective,
+                                                 synthetic_demo_pipeline)
+from fraud_detection_tpu.sched.batcher import DispatchLane
+from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+from fraud_detection_tpu.stream.engine import run_supervised
+from fraud_detection_tpu.stream.faults import (ChaosConsumer, ChaosProducer,
+                                               FaultPlan)
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    return synthetic_demo_pipeline(batch_size=64, n=400, seed=3,
+                                   num_features=2048,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
+
+
+TEXTS = ["urgent your account is suspended pay the verification fee now",
+         "thanks for calling the clinic your appointment is confirmed",
+         "final notice wire the processing fee or face arrest today",
+         "the weather is lovely and the meeting moved to thursday"]
+
+
+def _feed(broker, n, topic="in"):
+    prod = broker.producer()
+    for i in range(n):
+        prod.produce(topic,
+                     json.dumps({"text": TEXTS[i % len(TEXTS)],
+                                 "id": i}).encode(),
+                     key=str(i).encode())
+
+
+# ---------------------------------------------------------------------------
+# packed staging buffer
+# ---------------------------------------------------------------------------
+
+def test_packed_roundtrip_exact_including_uint16_top_bit():
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.featurize.tfidf import EncodedBatch
+
+    ids = np.array([[1, 7, 2047, 0], [5, 0, 0, 0]], np.int16)
+    # 40000 > 32767: corrupted by any signed interpretation of the bitcast.
+    counts = np.array([[1, 3, 40000, 0], [65535, 0, 0, 0]], np.uint16)
+    packed = _pack_encoded(EncodedBatch(ids, counts))
+    assert packed.dtype == np.int16 and packed.shape == (2, 2, 4)
+    got_ids, got_counts = linear_mod.unpack_rows(jnp.asarray(packed))
+    assert (np.asarray(got_ids) == ids).all()
+    assert (np.asarray(got_counts) == counts.astype(np.float32)).all()
+
+
+def test_packed_scoring_matches_two_array_path(pipeline):
+    import jax.numpy as jnp
+
+    enc = pipeline.featurizer.encode(TEXTS, batch_size=8)
+    packed = _pack_encoded(enc)
+    assert packed is not None
+    ref = np.asarray(linear_mod.prob_encoded_arrays(
+        pipeline.fused_model, jnp.asarray(enc.ids), jnp.asarray(enc.counts)))
+    got = np.asarray(linear_mod.prob_packed(pipeline.fused_model,
+                                            jnp.asarray(packed)))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_wide_vocab_falls_back_to_two_array_upload():
+    """num_features > int16 range widens ids to int32 — the packed layout
+    doesn't apply and _pack_encoded must say so instead of corrupting."""
+    from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer
+
+    feat = HashingTfIdfFeaturizer(num_features=40000)
+    enc = feat.encode(TEXTS, batch_size=4)
+    assert np.asarray(enc.ids).dtype == np.int32
+    assert _pack_encoded(enc) is None
+
+
+def test_upload_accounting_one_per_chunk(pipeline):
+    ds = pipeline.device_stats
+    chunks0, uploads0 = ds.chunks, ds.uploads
+    pipeline.predict(TEXTS * 40)       # 160 rows / batch 64 -> 3 chunks
+    assert ds.chunks - chunks0 == 3
+    assert ds.uploads - uploads0 == 3  # exactly one upload per chunk
+    assert ds.snapshot()["uploads_per_chunk"] is not None
+
+
+# ---------------------------------------------------------------------------
+# int8 parity pin
+# ---------------------------------------------------------------------------
+
+def test_int8_parity_with_fp32(pipeline):
+    q8 = ServingPipeline(pipeline.featurizer, pipeline.model,
+                         batch_size=64, int8=True)
+    texts = [TEXTS[i % len(TEXTS)] + f" case {i}" for i in range(256)]
+    ref = pipeline.predict(texts)
+    got = q8.predict(texts)
+    assert (ref.labels == got.labels).all()
+    assert np.abs(ref.probabilities - got.probabilities).max() < 0.02
+    assert q8.device_stats.int8 is True
+    # The raw-JSON path serves the same quantized program.
+    out = q8.predict_json_async(
+        [json.dumps({"text": t}).encode() for t in texts])
+    if out is not None:
+        assert (out[0].resolve().labels == ref.labels).all()
+
+
+def test_int8_requires_logistic_model():
+    tree = synthetic_demo_pipeline(32, n=200, model="dt")
+    with pytest.raises(ValueError, match="int8"):
+        ServingPipeline(tree.featurizer, tree.model, batch_size=32, int8=True)
+
+
+def test_quantize_weights_per_block_shapes(pipeline):
+    w_q, scales = linear_mod.quantize_weights(pipeline.fused_model, block=128)
+    f = pipeline.fused_model.weights.shape[0]
+    nb = -(-f // 128)
+    assert w_q.shape == (nb * 128,) and str(w_q.dtype) == "int8"
+    assert scales.shape == (nb,)
+    # Reconstruction error is bounded by half a quantization step per block.
+    w = np.asarray(pipeline.fused_model.weights)
+    recon = (np.asarray(w_q).reshape(nb, 128)
+             * np.asarray(scales)[:, None]).reshape(-1)[:f]
+    assert np.abs(recon - w).max() <= np.asarray(scales).max() * 0.5 + 1e-7
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+def _donation_literals(module, expected: dict) -> None:
+    """Source-level pin (flightcheck style, platform-independent — CPU
+    lowering silently DROPS unusable donor attrs, so the lowering text
+    can't pin this): every expected ``donate_argnums=...`` literal must be
+    present in the module source exactly. A regression to the old no-op
+    ``donate_argnums=()`` fails here."""
+    import ast
+    import inspect
+
+    tree = ast.parse(inspect.getsource(module))
+    found = {}
+    for node in ast.walk(tree):
+        name = (getattr(node.func, "attr", None)
+                or getattr(node.func, "id", "")) if isinstance(
+                    node, ast.Call) else ""
+        if name not in ("jit", "partial"):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "donate_argnums":
+                found[node.lineno] = ast.literal_eval(kw.value)
+    for nums, count in expected.items():
+        assert list(found.values()).count(nums) == count, (
+            f"expected {count} jax.jit(donate_argnums={nums}) in "
+            f"{module.__name__}, found {found}")
+    assert () not in found.values(), (
+        f"misleading no-op donate_argnums=() in {module.__name__}: {found}")
+
+
+def test_serving_donating_twins_pin_their_donate_argnums(pipeline):
+    # Three donating twins: packed fp32, packed int8, packed tree.
+    from fraud_detection_tpu.models import pipeline as pipeline_mod
+
+    _donation_literals(linear_mod, {(1,): 1, (3,): 1})
+    _donation_literals(pipeline_mod, {(1,): 1, (0,): 1})  # tree twin + probe
+    if donation_effective():
+        # Where the platform consumes donations, the lowering must say so.
+        import jax.numpy as jnp
+
+        enc = pipeline.featurizer.encode(TEXTS, batch_size=8)
+        packed = jnp.asarray(_pack_encoded(enc))
+        low = linear_mod._prob_packed_donated.lower(
+            pipeline.fused_model, packed).as_text()
+        assert "jax.buffer_donor" in low or "tf.aliasing_output" in low
+
+
+def test_train_linear_donates_carried_data_for_real():
+    """models/train_linear.py:53 used to carry a misleading
+    ``donate_argnums=()``; the donating twin must now donate X/y/mask and
+    both twins must agree numerically."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.models.train_linear import (_fit_lbfgs,
+                                                         _fit_lbfgs_donating)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (rng.uniform(size=64) < 0.5).astype(np.float32)
+    mask = np.ones(64, np.float32)
+    from fraud_detection_tpu.models import train_linear as train_mod
+
+    _donation_literals(train_mod, {(0, 1, 2): 1})
+    args = (jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask),
+            jnp.float32(0.0), jnp.float32(1e-6))
+    (w0, b0), l0, i0 = _fit_lbfgs(*args, max_iter=5)
+    Xd, yd, md = jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # unusable-donation warning on CPU
+        (w1, b1), l1, i1 = _fit_lbfgs_donating(
+            Xd, yd, md, jnp.float32(0.0), jnp.float32(1e-6), max_iter=5)
+    np.testing.assert_allclose(np.asarray(w0), np.asarray(w1), rtol=1e-6)
+    assert int(i0) == int(i1)
+    if donation_effective():
+        # Platforms that consume donations must have consumed these.
+        assert Xd.is_deleted() and yd.is_deleted() and md.is_deleted()
+    del jax
+
+
+def test_donation_hits_counter_tracks_probe(pipeline):
+    """donation_hits counts donating dispatches only — 0 wherever the
+    platform keeps donated buffers (CPU today), chunk-for-chunk otherwise."""
+    before = pipeline.device_stats.donated
+    pipeline.predict(TEXTS)
+    after = pipeline.device_stats.donated
+    if donation_effective():
+        assert after == before + 1
+    else:
+        assert after == before == 0
+
+
+# ---------------------------------------------------------------------------
+# HBM pinning
+# ---------------------------------------------------------------------------
+
+def test_pin_device_once_per_pipeline():
+    pipe = synthetic_demo_pipeline(32, n=200)
+    assert pipe.device_stats.pins == 0
+    out = pipe.pin_device()
+    assert out["model_pins"] == 1 and out["pinned_bytes"] > 0
+    assert pipe.pin_device()["model_pins"] == 1      # idempotent
+    # Tree pipelines pin ensemble arrays + the idf vector.
+    tree = synthetic_demo_pipeline(32, n=200, model="xgb")
+    pinned = tree.pin_device()["pinned_bytes"]
+    assert pinned > 0 and tree._tree_idf is not None
+
+
+def test_hot_swap_repins_candidates():
+    from fraud_detection_tpu.registry.hotswap import HotSwapPipeline
+
+    v1 = synthetic_demo_pipeline(32, n=200)
+    hot = HotSwapPipeline(v1, version=1)
+    hot.prewarm(v1)
+    assert v1.device_stats.pins == 1
+    v2 = synthetic_demo_pipeline(32, n=200, seed=11)
+    hot.swap(v2, version=2)                 # prewarm => re-pin, off hot path
+    assert v2.device_stats.pins == 1
+    assert hot.device_stats.pins == 1       # delegates to the ACTIVE pipeline
+    v3 = synthetic_demo_pipeline(32, n=200, seed=12)
+    hot.stage(v3, version=3)
+    assert v3.device_stats.pins == 1        # staged candidates pin at stage
+
+
+def test_engine_run_pins_off_hot_path(pipeline):
+    broker = InProcessBroker()
+    _feed(broker, 8)
+    engine = StreamingClassifier(pipeline, broker.consumer(["in"], "pin"),
+                                 broker.producer(), "out", batch_size=8,
+                                 max_wait=0.01)
+    engine.run(max_messages=8, idle_timeout=1.0)
+    assert engine.health()["device"]["model_pins"] == 1
+
+
+# ---------------------------------------------------------------------------
+# dispatch lane
+# ---------------------------------------------------------------------------
+
+def test_lane_strict_fifo_and_stats():
+    lane = DispatchLane(lambda x: x * 10, depth=2)
+    try:
+        for i in range(5):
+            lane.submit(i)
+        got = [lane.next(timeout=5.0) for _ in range(5)]
+        assert got == [0, 10, 20, 30, 40]
+        s = lane.stats()
+        assert s["submitted"] == s["launched"] == 5
+        assert s["depth"] == 2 and s["max_inflight"] >= 2
+    finally:
+        lane.stop()
+
+
+def test_lane_reraises_worker_failure_in_order():
+    def boom(x):
+        if x == 1:
+            raise RuntimeError("launch failed")
+        return x
+
+    lane = DispatchLane(boom, depth=2)
+    try:
+        for i in range(3):
+            lane.submit(i)
+        assert lane.next(timeout=5.0) == 0
+        with pytest.raises(RuntimeError, match="launch failed"):
+            lane.next(timeout=5.0)
+        assert lane.next(timeout=5.0) == 2   # position preserved past it
+    finally:
+        lane.stop()
+
+
+def test_lane_stop_discards_unlaunched():
+    import threading
+
+    gate = threading.Event()
+
+    def slow(x):
+        gate.wait(5.0)
+        return x
+
+    lane = DispatchLane(slow, depth=2)
+    lane.submit(1)
+    lane.submit(2)
+    gate.set()
+    lane.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        lane.submit(3)
+
+
+# ---------------------------------------------------------------------------
+# async engine: parity, ordering, chaos, flush-failure invariant
+# ---------------------------------------------------------------------------
+
+def _run_engine(pipeline, broker, n, group, topic_out, **kw):
+    engine = StreamingClassifier(pipeline, broker.consumer(["in"], group),
+                                 broker.producer(), topic_out,
+                                 batch_size=32, max_wait=0.01,
+                                 pipeline_depth=2, **kw)
+    stats = engine.run(max_messages=n, idle_timeout=2.0)
+    return engine, stats
+
+
+def test_async_engine_output_identical_to_sync(pipeline):
+    n = 200
+    broker = InProcessBroker(num_partitions=3)
+    _feed(broker, n)
+    _, s_sync = _run_engine(pipeline, broker, n, "g-sync", "out-sync",
+                            async_dispatch=False)
+    eng, s_async = _run_engine(pipeline, broker, n, "g-async", "out-async",
+                               async_dispatch=True)
+    assert s_sync.processed == s_async.processed == n
+    sync_wire = [(m.key, m.value) for m in broker.messages("out-sync")]
+    async_wire = [(m.key, m.value) for m in broker.messages("out-async")]
+    assert sync_wire == async_wire        # byte-identical frames, same order
+    dev = eng.health()["device"]
+    assert dev["async_dispatch"] is True and dev["dispatch_depth"] == 2
+    assert dev["uploads_per_batch"] is not None
+    assert dev["uploads_per_batch"] <= 1.0
+    assert dev["lane_batches"] >= 1 and dev["max_inflight"] >= 2
+
+
+def test_async_engine_zero_loss_under_chaos(pipeline):
+    """The double-buffer lane must not weaken the delivery contract: seeded
+    lossy flushes / fences / poll errors / duplicates / corruption, engine
+    async, supervised restarts — every input key still lands at least once
+    and no commit advances past a lost output."""
+    n = 150
+    plan = FaultPlan(seed=20260804, poll_error_rate=0.08,
+                     latency_spike_rate=0.05, latency_spike_sec=0.0,
+                     duplicate_rate=0.08, corrupt_rate=0.05,
+                     flush_fail_rate=0.08, flush_crash_rate=0.06,
+                     commit_fence_rate=0.08, max_faults=60,
+                     sleep=lambda s: None)
+    broker = InProcessBroker(num_partitions=3)
+    _feed(broker, n)
+
+    def make_engine():
+        return StreamingClassifier(
+            pipeline, ChaosConsumer(broker.consumer(["in"], "chaos"), plan),
+            ChaosProducer(broker.producer(), plan), "out",
+            batch_size=32, max_wait=0.01, pipeline_depth=2,
+            dlq_topic="out-dlq", async_dispatch=True)
+
+    run_supervised(make_engine, max_restarts=300, backoff=0.0,
+                   idle_timeout=0.2, sleep=lambda s: None)
+    delivered = {m.key for m in broker.messages("out")}
+    delivered |= {m.key for m in broker.messages("out-dlq")}
+    want = {str(i).encode() for i in range(n)}
+    assert not want - delivered, f"lost keys: {sorted(want - delivered)[:5]}"
+    committed = {(t, p): off
+                 for (g, t, p), off in broker._group_offsets.items()
+                 if g == "chaos"}
+    for m in broker.messages("in"):
+        if m.offset < committed.get((m.topic, m.partition), 0):
+            assert m.key in delivered, "commit advanced past lost output"
+
+
+def test_async_engine_flush_failure_stops_without_commit(pipeline):
+    class FailingFlushProducer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def produce(self, *a, **k):
+            return self.inner.produce(*a, **k)
+
+        def flush(self, timeout=10.0):
+            self.inner.flush(timeout)
+            return 7                      # pretend rows never drained
+
+    broker = InProcessBroker(num_partitions=1)
+    _feed(broker, 96)
+    consumer = broker.consumer(["in"], "ff")
+    engine = StreamingClassifier(pipeline, consumer,
+                                 FailingFlushProducer(broker.producer()),
+                                 "out", batch_size=32, max_wait=0.01,
+                                 pipeline_depth=2, async_dispatch=True)
+    stats = engine.run(max_messages=96, idle_timeout=1.0)
+    assert stats.commits_skipped == 1     # first failed flush aborts the run
+    assert stats.processed == 0           # nothing counted as done
+    assert not any(g == "ff" for (g, _, _) in broker._group_offsets)

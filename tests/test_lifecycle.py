@@ -375,11 +375,27 @@ ENGINE_HEALTH_SCHEMA = {
     "dead_lettered": (int,),
     "shed": (int,),
     "row_latency_ms": (dict,),
+    "device": (dict,),
     "sched": (type(None), dict),
     "dlq": (type(None), dict),
     "annotations": (type(None), dict),
     "breaker": (type(None), dict),
     "model": (type(None), dict),
+}
+
+DEVICE_BLOCK_SCHEMA = {
+    "async_dispatch": (bool,),
+    "dispatch_depth": (int,),
+    "max_inflight": (int,),
+    "lane_batches": (type(None), int),       # None: lane never ran
+    "driver_waits": (type(None), int),
+    "uploads": (type(None), int),            # None: pipeline w/o DeviceStats
+    "upload_bytes": (type(None), int),
+    "uploads_per_batch": (type(None), int, float),
+    "donation_hits": (type(None), int),
+    "pinned_bytes": (type(None), int),
+    "model_pins": (type(None), int),
+    "int8": (type(None), bool),
 }
 
 MODEL_BLOCK_SCHEMA = {
@@ -431,6 +447,7 @@ def test_health_json_contract_plain_pipeline():
     engine.run(max_messages=16, idle_timeout=2.0)
     h = engine.health()
     _assert_schema(h, ENGINE_HEALTH_SCHEMA, "engine")
+    _assert_schema(h["device"], DEVICE_BLOCK_SCHEMA, "device")
     assert h["model"] is None              # plain pipeline: no model block
     json.dumps(h)                          # must be JSON-serializable
 
