@@ -120,6 +120,7 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
         return None            # no headline landed: nothing to trend
     sweep = line.get("load_sweep") or {}
     dev = line.get("device") or {}
+    fleet = line.get("fleet") or {}
     record = {
         "time": round(time.time(), 1) if now is None else now,
         "metric": line.get("metric"),
@@ -135,6 +136,18 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
         "capacity_est_per_s": sweep.get("capacity_est_per_s"),
         "max_load_meeting_target_p99_per_s": sweep.get(
             "max_load_meeting_target_p99_per_s"),
+        # Fleet scaling trend (ISSUE 8): worker count, per-worker vs
+        # aggregate rate, and the globally-coordinated shed count.
+        "fleet": ({
+            "workers": fleet.get("workers"),
+            "cores": fleet.get("cores"),
+            "single_worker_msgs_per_s": fleet.get(
+                "single_worker_msgs_per_s"),
+            "aggregate_msgs_per_s": fleet.get("aggregate_msgs_per_s"),
+            "scaling_x": fleet.get("scaling_x"),
+            "global_watermark_sheds": (fleet.get("global_shed")
+                                       or {}).get("sheds"),
+        } if fleet and "workers" in fleet else None),
     }
     trend = []
     try:
@@ -672,6 +685,130 @@ def int8_stream_bench(fp32_pipe, texts, batch_size: int, depth: int,
         "max_abs_dp": round(max_dp, 5),
         "device": getattr(stats, "device_health", None),
     }
+
+
+def _fleet_drain(pipe, texts, batch_size: int, n_msgs: int, n_workers: int,
+                 *, sched_config=None, dlq_topic=None, death_plan=None,
+                 num_partitions: int = 4):
+    """One fleet drain run: fresh broker, n_msgs preloaded, N partition-
+    owning workers under the lease coordinator (fraud_detection_tpu/fleet/).
+    Returns (fleet result dict, output keys incl. DLQ) for rate + exact
+    key-set accounting."""
+    from fraud_detection_tpu.fleet import Fleet
+    from fraud_detection_tpu.stream import InProcessBroker
+
+    broker = InProcessBroker(num_partitions=num_partitions)
+    feeder = broker.producer()
+    for i in range(n_msgs):
+        feeder.produce("customer-dialogues-raw",
+                       json.dumps({"text": texts[i % len(texts)],
+                                   "id": i}).encode(),
+                       key=str(i).encode())
+    fleet = Fleet.in_process(
+        broker, pipe, "customer-dialogues-raw", "dialogues-classified",
+        n_workers, batch_size=batch_size, max_wait=0.01,
+        sched_config=sched_config, dlq_topic=dlq_topic,
+        death_plan=death_plan, lease_ttl=1.0)
+    result = fleet.run(idle_timeout=0.5, join_timeout=300.0)
+    keys = [m.key for m in broker.messages("dialogues-classified")]
+    if dlq_topic is not None:
+        keys += [m.key for m in broker.messages(dlq_topic)]
+    return result, keys
+
+
+def fleet_bench(pipe, texts, batch_size: int, n_msgs: int) -> dict:
+    """The fleet scaling curve (ISSUE 8 tentpole evidence): 1-worker vs
+    N-worker aggregate rate over one preloaded topic, a seeded worker-kill
+    drain with exact key-set accounting, a globally-coordinated shed run,
+    and — when the process sees >1 local device — mesh data-parallel
+    scoring parity + rate. Thread workers cannot parallelize compute on a
+    1-core host, so ``cores`` rides the artifact: the scaling number is
+    only meaningful against it."""
+    from fraud_detection_tpu.sched import SchedulerConfig
+    from fraud_detection_tpu.stream.faults import WorkerDeathPlan
+
+    workers = int(os.environ.get("BENCH_FLEET_WORKERS", "2"))
+    n = min(n_msgs, int(os.environ.get("BENCH_FLEET_MSGS", "10000")))
+    expect = {str(i).encode() for i in range(n)}
+
+    single, keys1 = _fleet_drain(pipe, texts, batch_size, n, 1)
+    assert sorted(keys1) == sorted(expect), "1-worker drain lost/duped keys"
+    multi, keys_n = _fleet_drain(pipe, texts, batch_size, n, workers)
+    assert sorted(keys_n) == sorted(expect), "N-worker drain lost/duped keys"
+
+    # Seeded worker kill: the zero-loss/zero-dup rebalance invariant,
+    # committed as artifact evidence (the full suite lives in
+    # tests/test_fleet.py).
+    plan = WorkerDeathPlan(seed=7, kills=1, min_polls=2, max_polls=6)
+    chaos, keys_c = _fleet_drain(pipe, texts, batch_size, n, workers,
+                                 death_plan=plan)
+    kill = {
+        "deaths": chaos["death_plan"]["killed"],
+        "lost_keys": len(expect - set(keys_c)),
+        "duplicated_keys": len(keys_c) - len(set(keys_c)),
+        "rebalances": chaos["rebalances"],
+        "lease_expirations": chaos["lease_expirations"],
+    }
+
+    # Global-watermark shedding: a deliberately over-committed preload
+    # against a small max_queue; every worker sheds against the FLEET's
+    # aggregated backlog (sched/scheduler.py fleet_backlog), every shed row
+    # is an accounted DLQ record.
+    q = max(256, n // 8)
+    shed_cfg = SchedulerConfig(max_queue=q, shed_policy="reject",
+                               cost_aware=False)
+    shed_res, shed_keys = _fleet_drain(
+        pipe, texts, batch_size, n, workers, sched_config=shed_cfg,
+        dlq_topic="dialogues-dlq")
+    assert sorted(shed_keys) == sorted(expect), "shed run lost/duped keys"
+    global_shed = {
+        "max_queue": q,
+        "sheds": shed_res["shed"],
+        "peak_global_backlog": (shed_res.get("fleet") or {}).get(
+            "peak_global_backlog"),
+        "exact_accounting": True,
+    }
+
+    out = {
+        "workers": workers,
+        "cores": os.cpu_count(),
+        "msgs": n,
+        "single_worker_msgs_per_s": single["msgs_per_sec"],
+        "aggregate_msgs_per_s": multi["msgs_per_sec"],
+        "per_worker_processed": multi["per_worker_processed"],
+        "scaling_x": (round(multi["msgs_per_sec"] / single["msgs_per_sec"], 3)
+                      if single["msgs_per_sec"] else None),
+        "rebalances": multi["rebalances"],
+        "kill": kill,
+        "global_shed": global_shed,
+    }
+
+    import jax
+
+    if jax.local_device_count() > 1:
+        from fraud_detection_tpu.parallel.serving import MeshServingPipeline
+
+        dp = jax.local_device_count()
+        mesh_pipe = MeshServingPipeline.from_pipeline(
+            pipe, per_chip_batch=max(1, batch_size // dp))
+        _warm(mesh_pipe, texts, mesh_pipe.batch_size)
+        sample = [texts[i % len(texts)] for i in range(2048)]
+        ref = pipe.predict(sample)
+        got = mesh_pipe.predict(sample)
+        mesh_single, mesh_keys = _fleet_drain(mesh_pipe, texts,
+                                              mesh_pipe.batch_size, n, 1)
+        assert sorted(mesh_keys) == sorted(expect)
+        out["mesh"] = {
+            "devices": dp,
+            "labels_agree_frac": float(np.mean(ref.labels == got.labels)),
+            "max_abs_dp": float(np.max(np.abs(
+                ref.probabilities - got.probabilities))),
+            "msgs_per_s": mesh_single["msgs_per_sec"],
+            "device": (mesh_pipe.device_stats.snapshot()),
+        }
+    else:
+        out["mesh"] = {"skipped": "single_device"}
+    return out
 
 
 def tree_streaming_bench(texts, batch_size: int, depth: int,
@@ -1500,6 +1637,16 @@ def main() -> int:
             lambda scratch: tree_streaming_bench(
                 texts, batch_size, depth, n_msgs=min(n_msgs, 10_000),
                 lr_pipe=pipe_or_raise()),
+            fraction=0.4)
+
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        # Fleet scaling curve (docs/fleet.md): 1-worker vs N-worker drain
+        # through the partition-lease coordinator, seeded worker-kill
+        # accounting, globally-coordinated shedding, mesh scoring parity.
+        harness.section(
+            "fleet",
+            lambda scratch: fleet_bench(pipe_or_raise(), texts, batch_size,
+                                        n_msgs),
             fraction=0.4)
 
     # Offered-load sweep (bench.py --load-sweep, default-on so the committed

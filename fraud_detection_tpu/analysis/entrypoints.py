@@ -58,6 +58,10 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     # upload + device launch for batch N+1 while the engine driver
     # delivers batch N (sched/batcher.py DispatchLane).
     ("sched/batcher.py", "self._run"),
+    # Fleet serving lane (docs/fleet.md): one thread per partition-owning
+    # worker, plus the monitor thread ticking the lease coordinator.
+    ("fleet/fleet.py", "self._worker_main"),
+    ("fleet/fleet.py", "self._monitor_loop"),
     # Sanitizer workload driver: hammer threads racing the shard ABI on
     # purpose — TSan is the detector there, not racecheck.
     ("native/san_driver.py", "hammer"),
@@ -109,6 +113,23 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
                "documented monotonic latches outside the _InFlight it owns"),
     EntryPoint("featurize", "featurize/parallel.py",
                "encode_sharded_native", "NativeFeaturizer"),
+    # Raw-JSON shard fan-out rides the same pool and the same stateless
+    # shard contract (handle read-only during shard calls).
+    EntryPoint("featurize", "featurize/parallel.py",
+               "encode_json_sharded_native", "NativeFeaturizer"),
+    # Fleet worker thread: drives its OWN engine incarnation chain (the
+    # engine's drive region + the assigned consumer's region guard the
+    # inner loop; FleetWorker.run's region pins one-driver-per-worker).
+    EntryPoint("fleet-worker", "fleet/fleet.py", "Fleet._worker_main",
+               "FleetWorker.run"),
+    # The manual-assignment consumer is single-driver like the group one.
+    EntryPoint("fleet-worker", "stream/broker.py",
+               "InProcessAssignedConsumer.poll_batch",
+               "InProcessAssignedConsumer"),
+    EntryPoint("fleet-monitor", "fleet/fleet.py", "Fleet._monitor_loop", None,
+               "coordinator state lives under FleetCoordinator._lock and "
+               "the bus under FleetBus._lock; the tick never touches "
+               "engine/consumer state"),
     EntryPoint("san-hammer", "native/san_driver.py", "hammer", None,
                "deliberately racing workload — the sanitizer runtime "
                "(ASan/TSan) is the detector"),
@@ -186,7 +207,33 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     # Native featurizer: shard_* entry points run on the featurize pool
     # over one shared read-only handle; encode paths hold _call_lock.
     "featurize/native.py::NativeFeaturizer": _spec(
-        featurize=("shard_begin", "shard_fill_into", "shard_destroy")),
+        featurize=("shard_begin", "shard_json_begin", "shard_fill_into",
+                   "shard_destroy")),
+    # Fleet bus: a blackboard — every surface callable from any thread,
+    # everything shared under FleetBus._lock (file writes are atomic).
+    "fleet/bus.py::FleetBus": _spec(
+        any_thread=("publish", "retract", "snapshots", "publish_fleet",
+                    "fleet_view")),
+    # Fleet coordinator: workers join/sync/ack/leave/fence from their own
+    # threads, the monitor thread ticks; all state under _lock, and the
+    # coordinator never calls out while holding it (acyclic lock graph).
+    "fleet/coordinator.py::FleetCoordinator": _spec(
+        any_thread=("join", "sync", "ack", "leave", "fence_lost",
+                    "assignments", "committed_lag", "last_view"),
+        fleet_monitor=("tick",)),
+    # Fleet worker: run() (and the poll-path hooks the engine drives) is
+    # the worker thread, guarded by the FleetWorker.run region;
+    # stop/result/health are the documented cross-thread surface.
+    "fleet/worker.py::FleetWorker": _spec(
+        any_thread=("stop", "result", "health"),
+        fleet_worker=("run", "_on_poll", "_publish")),
+    # Fleet facade: run() on the caller's thread, monitor/worker threads
+    # spawned by it; stop/fleet_health are cross-thread (Event + reads of
+    # monitor-safe surfaces).
+    "fleet/fleet.py::Fleet": _spec(
+        any_thread=("stop", "fleet_health"),
+        fleet_monitor=("_monitor_loop", "_write_health_file"),
+        fleet_worker=("_worker_main",)),
 }
 
 
@@ -222,13 +269,24 @@ OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
     # Chaos wrappers forward to the real clients.
     "stream/faults.py::ChaosConsumer.inner": ("Consumer",),
     "stream/faults.py::ChaosProducer.inner": ("Producer",),
+    # Fleet seams (docs/fleet.md): the worker drives the coordinator + bus
+    # from the poll path, and its consumer wrapper forwards to the
+    # manual-assignment transport.
+    "fleet/worker.py::FleetWorker.coordinator": ("FleetCoordinator",),
+    "fleet/worker.py::FleetWorker.bus": ("FleetBus",),
+    "fleet/worker.py::_FleetConsumer.inner": ("Consumer",),
+    "fleet/worker.py::_FleetConsumer._worker": ("FleetWorker",),
+    "fleet/fleet.py::Fleet.coordinator": ("FleetCoordinator",),
+    "fleet/fleet.py::Fleet.bus": ("FleetBus",),
+    "fleet/coordinator.py::FleetCoordinator.bus": ("FleetBus",),
 }
 
 #: Protocol/ABC name -> concrete in-tree implementations the call-graph
 #: pass follows (an unbound protocol method has a ``...`` body and would
 #: contribute nothing).
 IMPLEMENTATIONS: Mapping[str, Tuple[str, ...]] = {
-    "Consumer": ("InProcessConsumer", "ChaosConsumer"),
+    "Consumer": ("InProcessConsumer", "InProcessAssignedConsumer",
+                 "ChaosConsumer", "_FleetConsumer"),
     "Producer": ("InProcessProducer", "ChaosProducer"),
     "ServingPipeline": ("HotSwapPipeline",),
 }

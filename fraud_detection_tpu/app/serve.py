@@ -186,6 +186,28 @@ def main(argv=None) -> int:
                          "disjoint partition subset (the reference's "
                          "--partitions 3 scale-out unit; docs/serving.md "
                          "'Horizontal scale-out')")
+    ap.add_argument("--fleet", type=int, metavar="N", default=0,
+                    help="fleet serving lane (docs/fleet.md): N partition-"
+                         "OWNING workers behind a lease coordinator — "
+                         "revoke->drain->commit->reassign rebalance on "
+                         "worker death, health on the fleet bus, shedding "
+                         "coordinated on the GLOBAL backlog watermark "
+                         "(demo mode; against real Kafka use --workers, "
+                         "whose group assignor is broker-side)")
+    ap.add_argument("--partitions", type=int, default=3,
+                    help="in-process demo broker partition count (the "
+                         "reference provisions --partitions 3; a fleet "
+                         "scales to min(partitions, workers))")
+    ap.add_argument("--fleet-health-file", default=None,
+                    help="periodically dump the aggregated fleet view + "
+                         "every worker's health to this path (atomic "
+                         "replace; --fleet)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="mesh data-parallel scoring (parallel/serving.py "
+                         "MeshServingPipeline): shard every micro-batch "
+                         "across all local chips' data axis — one worker "
+                         "drives the whole mesh; single-device falls back "
+                         "byte-identically")
     ap.add_argument("--explain", default="off", metavar="SPEC",
                     help="attach LLM analyses to flagged messages, batched "
                          "per micro-batch: 'off' | 'canned' (offline stub) | "
@@ -290,6 +312,34 @@ def main(argv=None) -> int:
         raise SystemExit("--annotations-topic only applies with "
                          "--explain-async (inline analyses ride the "
                          "output frames)")
+    if args.fleet < 0:
+        raise SystemExit(f"--fleet must be >= 0, got {args.fleet}")
+    if args.partitions < 1:
+        raise SystemExit(f"--partitions must be >= 1, got {args.partitions}")
+    if args.fleet > 0:
+        if not args.demo:
+            raise SystemExit(
+                "--fleet needs --demo N (the lease coordinator drives the "
+                "in-process broker's manual-assignment mode; against real "
+                "Kafka use --workers — its group assignor is broker-side)")
+        if args.workers > 1:
+            raise SystemExit("--fleet and --workers > 1 are mutually "
+                             "exclusive (two assignment authorities)")
+        if args.registry or args.explain != "off" or args.chaos:
+            raise SystemExit("--fleet does not combine with --registry/"
+                             "--explain/--chaos yet (docs/fleet.md)")
+        if args.supervise:
+            raise SystemExit("--fleet supervises itself (lease expiry + "
+                             "rebalance); drop --supervise")
+        if args.max_messages is not None:
+            raise SystemExit("--max-messages cannot be split across a "
+                             "fleet; workers drain until the group's "
+                             "committed lag clears")
+    if args.mesh and args.registry is not None:
+        raise SystemExit("--mesh is not supported with --registry yet "
+                         "(hot-swap candidates would load single-device)")
+    if args.fleet_health_file is not None and args.fleet == 0:
+        raise SystemExit("--fleet-health-file needs --fleet N")
     if args.workers > 1 and args.max_messages is not None:
         # Per-worker message caps can't split a global cap meaningfully —
         # refuse BEFORE the expensive pipeline build, like every other
@@ -430,6 +480,20 @@ def main(argv=None) -> int:
     else:
         pipe = build_pipeline(args.model, args.batch_size, int8=args.int8)
 
+    if args.mesh:
+        # Mesh data-parallel scoring: shard micro-batches over every local
+        # chip's data axis (parallel/serving.py). The engine's --batch-size
+        # stays the GLOBAL micro-batch; each chip scores its 1/dp share.
+        # On one device this constructs the plain pipeline (byte-identical
+        # fallback), so --mesh is safe to leave on everywhere.
+        from fraud_detection_tpu.parallel.serving import (MeshServingPipeline,
+                                                          local_device_count)
+
+        dp = local_device_count()
+        pipe = MeshServingPipeline.from_pipeline(
+            pipe, per_chip_batch=max(1, args.batch_size // max(1, dp)))
+        model_desc = f"{model_desc} (mesh x{pipe.data_parallel or 1})"
+
     sched_ladder_costs = None
     if sched_config is not None:
         # Measure + pre-warm the padding-bucket ladder ONCE, before any
@@ -463,7 +527,7 @@ def main(argv=None) -> int:
     elif args.demo > 0:
         from fraud_detection_tpu.data import generate_corpus
 
-        broker = InProcessBroker(num_partitions=3)
+        broker = InProcessBroker(num_partitions=args.partitions)
         feeder = broker.producer()
         corpus = generate_corpus(n=min(args.demo, 2000), seed=123)
         for i in range(args.demo):
@@ -497,6 +561,29 @@ def main(argv=None) -> int:
     dlq_trackers: dict = {}
     if args.dlq:
         dlq_topic = args.dlq_topic or f"{args.output_topic}-dlq"
+
+    if args.fleet > 0:
+        # Fleet serving lane (docs/fleet.md): N partition-owning workers
+        # under the lease coordinator, health on the fleet bus, shedding on
+        # the global backlog watermark. Drains until the group's committed
+        # lag clears, then exits with the merged fleet stats.
+        from fraud_detection_tpu.fleet import Fleet
+
+        fleet = Fleet.in_process(
+            broker, pipe, args.input_topic, args.output_topic, args.fleet,
+            batch_size=args.batch_size, max_wait=args.max_wait,
+            pipeline_depth=args.pipeline_depth,
+            async_dispatch=args.async_dispatch,
+            sched_config=sched_config, dlq_topic=dlq_topic,
+            health_file=args.fleet_health_file)
+        print(f"serving: model={model_desc} in={args.input_topic} "
+              f"out={args.output_topic} batch={args.batch_size} "
+              f"fleet={args.fleet} partitions={args.partitions}", flush=True)
+        out = fleet.run(idle_timeout=1.0)
+        print(json.dumps(out))
+        n_out = broker.topic_size(args.output_topic)
+        print(f"classified messages on {args.output_topic}: {n_out}")
+        return 1 if out["errors"] else 0
 
     engines_built = []   # LIVE engines only — replaced ones are harvested
     # Aggregated lane counters of engines already replaced+closed: replaced

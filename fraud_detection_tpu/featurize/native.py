@@ -180,6 +180,19 @@ def load_library() -> Optional[ctypes.CDLL]:
             lib._has_shards = True
         except AttributeError:
             lib._has_shards = False
+        try:  # stateless raw-JSON batch-shard encode (Python-side fan-out)
+            lib.ftok_shard_json_begin.restype = ctypes.c_void_p
+            lib.ftok_shard_json_begin.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+            lib._has_json_shards = True
+        except AttributeError:
+            lib._has_json_shards = False
         try:  # batch output-frame assembly (stateless)
             lib.ftok_build_frames.restype = ctypes.c_longlong
             lib.ftok_build_frames.argtypes = [
@@ -323,6 +336,28 @@ class NativeFeaturizer:
     def shard_destroy(self, shard: int) -> None:
         if shard:
             self._lib.ftok_shard_destroy(shard)
+
+    def supports_json_shards(self) -> bool:
+        """True when the library has the stateless raw-JSON shard entry
+        point (``ftok_shard_json_begin``) — like the text shards, it never
+        touches the handle's begin/fill row state, so N threads may encode
+        N message shards concurrently over this one handle."""
+        return bool(getattr(self._lib, "_has_json_shards", False))
+
+    def shard_json_begin(self, msgs_ptr, lens: np.ndarray, n: int,
+                         key: bytes, status: np.ndarray,
+                         span_start: np.ndarray,
+                         span_len: np.ndarray) -> Tuple[int, int]:
+        """Raw-JSON shard encode (phase 1): parse+extract+tokenize ``n``
+        messages starting at ``msgs_ptr`` (a sub-pointer into the batch's
+        one marshalled ``char*[]``), writing this shard's slice of the
+        status/span arrays. Returns ``(shard_handle, width)``; fill with
+        ``shard_fill_into`` exactly like a text shard."""
+        width = np.zeros(1, np.int32)
+        shard = self._lib.ftok_shard_json_begin(
+            self._handle, msgs_ptr, lens, n, key, len(key),
+            status, span_start, span_len, width)
+        return shard, int(width[0])
 
     def encode_json(self, values: Sequence[bytes], key: bytes, rows: int,
                     max_tokens: Optional[int], pad_len,

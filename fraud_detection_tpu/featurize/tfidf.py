@@ -238,9 +238,24 @@ class HashingTfIdfFeaturizer:
         b = batch_size if batch_size is not None else len(values)
         if len(values) > b:
             raise ValueError(f"{len(values)} values > batch_size {b}")
-        ids, counts, status, span_start, span_len, ctx = native.encode_json(
-            values, text_field.encode("utf-8"), b, max_tokens, _pad_len,
-            want16=self._ids_dtype() is np.int16)
+        workers = (self._encode_workers()
+                   if len(values) >= self.parallel_min_rows else 1)
+        if workers > 1 and native.supports_json_shards():
+            # Python-side fan-out over the process-wide pool (featurize/
+            # parallel.py): byte-identical to the serial call below, and
+            # the splice context (the batch's ONE marshalled char*[]) still
+            # feeds native frame assembly unchanged.
+            from fraud_detection_tpu.featurize import parallel
+
+            ids, counts, status, span_start, span_len, ctx = (
+                parallel.encode_json_sharded_native(
+                    native, values, text_field.encode("utf-8"), b,
+                    max_tokens, _pad_len,
+                    want16=self._ids_dtype() is np.int16, workers=workers))
+        else:
+            ids, counts, status, span_start, span_len, ctx = native.encode_json(
+                values, text_field.encode("utf-8"), b, max_tokens, _pad_len,
+                want16=self._ids_dtype() is np.int16)
         self._json_splice_ctx = ctx if keep_splice_ctx else None
         if ids.dtype != np.int16:
             ids, counts = self._narrow(ids, counts)

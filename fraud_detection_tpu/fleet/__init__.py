@@ -1,0 +1,16 @@
+"""Fleet serving lane: partition-owning workers behind one coordinator.
+
+The scale-out layer over the streaming engine (docs/fleet.md): workers own
+explicit partition leases (stream/broker.py manual-assignment consumers),
+a coordinator rebalances them with a revoke->drain->commit->reassign
+barrier on membership change and lease expiry on worker death, health
+flows over an in-process/file-backed bus, and load shedding coordinates on
+the GLOBAL backlog watermark instead of per-worker guesses.
+"""
+
+from fraud_detection_tpu.fleet.bus import FleetBus
+from fraud_detection_tpu.fleet.coordinator import FleetCoordinator, Lease
+from fraud_detection_tpu.fleet.fleet import Fleet
+from fraud_detection_tpu.fleet.worker import FleetWorker
+
+__all__ = ["Fleet", "FleetBus", "FleetCoordinator", "FleetWorker", "Lease"]

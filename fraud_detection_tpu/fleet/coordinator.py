@@ -1,0 +1,298 @@
+"""Fleet coordinator: group membership, partition leases, the global view.
+
+The broker's own consumer groups (stream/broker.py) already scale N engines
+behind one topic, but their assignor is reactive — a member only discovers a
+rebalance when its next poll is fenced. The fleet layer makes ownership a
+first-class, *coordinated* object instead:
+
+* **membership** — workers ``join``/``sync`` (heartbeat) /``leave``; a
+  worker that stops heartbeating for ``lease_ttl`` seconds is expired and
+  its partitions reassigned (the crash path).
+* **leases** — every worker owns an EXPLICIT (topic, partition) set,
+  granted by the balanced-sticky assignor here and consumed through the
+  broker's manual-assignment mode (``InProcessBroker.assigned_consumer``).
+* **revoke barrier** — when a rebalance moves a partition away from a LIVE
+  worker, the new owner's lease withholds it until the old owner has
+  drained its in-flight batches, committed, and ``ack``ed (the
+  revoke->drain->commit->reassign choreography, docs/fleet.md). A dead
+  worker's partitions skip the barrier: its lease expiry IS the barrier,
+  and the group-durable committed offsets are the zero-loss resume point.
+* **global backlog watermark** — each tick aggregates the per-worker
+  backlogs published on the fleet bus into ONE global number and publishes
+  it back (``backlog_per_worker``); every worker's admission controller
+  then sheds against the FLEET's queue depth instead of its own partitions'
+  (sched/scheduler.py ``fleet_backlog``), so one drowning fleet sheds
+  everywhere at once instead of each worker guessing from its own slice.
+
+Thread model: workers call join/sync/ack/leave/fence_lost from their own
+threads and the monitor thread calls ``tick`` — every mutation sits under
+one lock, and the coordinator never calls back into engines, consumers, or
+the broker while holding it (the fleet's lock graph stays acyclic;
+flightcheck FC101 checks the composed ordering).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Lease:
+    """One worker's partition ownership at one assignment generation."""
+
+    worker_id: str
+    generation: int
+    partitions: Tuple[tuple, ...]    # granted pairs, sorted
+    pending: Tuple[tuple, ...]       # target pairs withheld behind a live
+                                     # previous owner's drain barrier
+
+
+class FleetCoordinator:
+    """Lease-based partition assignment + fleet-view aggregation."""
+
+    def __init__(self, topics: Sequence[str], num_partitions: int, *,
+                 bus=None, lease_ttl: float = 30.0,
+                 lag_fn: Optional[Callable[[], Optional[int]]] = None,
+                 clock=time.monotonic, wall=time.time):
+        if num_partitions < 1:
+            raise ValueError(
+                f"num_partitions must be >= 1, got {num_partitions}")
+        if lease_ttl <= 0:
+            raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
+        self.topics = tuple(topics)
+        self.num_partitions = num_partitions
+        self.bus = bus
+        self.lease_ttl = lease_ttl
+        # Optional committed-offset lag probe (rows appended but not yet
+        # committed by the group, fleet-wide): the drain-run termination
+        # signal workers consult when idle — it still counts a dead
+        # worker's unreassigned partitions, which per-worker backlogs
+        # cannot see (Fleet.in_process wires it to the broker).
+        self._lag_fn = lag_fn
+        self._clock = clock
+        self._wall = wall
+        self._lock = threading.Lock()
+        self._members: Dict[str, dict] = {}   # wid -> {renewed, joined}
+        self._target: Dict[str, Set[tuple]] = {}
+        self._pending: Dict[tuple, str] = {}  # pair -> live holder draining it
+        self._generation = 0
+        self._join_seq = 0
+        self._all_pairs = [(t, p) for t in self.topics
+                           for p in range(num_partitions)]
+        self.rebalances = 0
+        self.expirations = 0
+        self._last_view: Optional[dict] = None
+        self._peak_backlog = 0   # max global backlog any tick aggregated
+
+    # ------------------------------------------------------------------
+    # membership (worker threads)
+    # ------------------------------------------------------------------
+
+    def join(self, worker_id: str) -> Lease:
+        with self._lock:
+            now = self._clock()
+            # Renew the caller FIRST: a syncing member is alive by
+            # definition and must never fall to its own expiry scan.
+            new = worker_id not in self._members
+            if new:
+                self._members[worker_id] = {"renewed": now,
+                                            "joined": self._join_seq}
+                self._join_seq += 1
+            else:
+                self._members[worker_id]["renewed"] = now
+            expired = self._expire_locked(now)
+            if new or expired:
+                self._rebalance_locked()
+            return self._lease_locked(worker_id)
+
+    def sync(self, worker_id: str) -> Lease:
+        """Heartbeat + current lease. A worker whose lease expired while it
+        wasn't heartbeating transparently rejoins — with a FRESH lease whose
+        partitions resume from the group's committed offsets (its old
+        read-ahead is gone; the in-between owner was authoritative)."""
+        return self.join(worker_id)
+
+    def ack(self, worker_id: str) -> Lease:
+        """The worker declares it has stopped consuming everything outside
+        its current lease (engine drained, offsets committed, old consumer
+        closed) — releases every partition it was holding behind the revoke
+        barrier, so the new owners' next ``sync`` grants them."""
+        with self._lock:
+            released = [pair for pair, holder in self._pending.items()
+                        if holder == worker_id]
+            for pair in released:
+                del self._pending[pair]
+            if worker_id in self._members:
+                self._members[worker_id]["renewed"] = self._clock()
+            return self._lease_locked(worker_id)
+
+    def leave(self, worker_id: str) -> None:
+        """Graceful departure (the worker already drained + committed):
+        its partitions reassign immediately — no barrier, no ttl wait."""
+        with self._lock:
+            if worker_id not in self._members:
+                return
+            del self._members[worker_id]
+            for pair in [p for p, h in self._pending.items()
+                         if h == worker_id]:
+                del self._pending[pair]
+            self._rebalance_locked()
+
+    def fence_lost(self, worker_id: str, pairs: Sequence[tuple]) -> List[tuple]:
+        """Commit fence for the assigned consumer: which of ``pairs`` does
+        ``worker_id`` NOT currently own? Non-empty for a zombie whose lease
+        expired (its commit must fail — the new owner is authoritative),
+        empty in normal operation. A pair the worker is still draining
+        behind the revoke barrier is still the worker's to commit."""
+        with self._lock:
+            owned = self._target.get(worker_id, set())
+            held = {p for p, h in self._pending.items() if h == worker_id}
+            return [p for p in pairs if tuple(p) not in owned
+                    and tuple(p) not in held]
+
+    # ------------------------------------------------------------------
+    # assignment internals (caller holds self._lock)
+    # ------------------------------------------------------------------
+
+    def _expire_locked(self, now: float) -> bool:
+        """Drop members whose lease ran out; returns True when any did
+        (the CALLER then rebalances — join/tick fold it into one re-deal)."""
+        stale = [w for w, info in self._members.items()
+                 if now - info["renewed"] > self.lease_ttl]
+        for w in stale:
+            del self._members[w]
+            # Expiry IS the drain barrier for a dead worker: release its
+            # holds — the committed offsets are the resume point.
+            for pair in [p for p, h in self._pending.items() if h == w]:
+                del self._pending[pair]
+            self.expirations += 1
+        return bool(stale)
+
+    def _rebalance_locked(self) -> None:
+        """Balanced-sticky re-deal (same shape as the broker's assignor):
+        every member keeps what it owns up to its fair share; only orphaned
+        pairs and the excess above a shrunken share move. Pairs leaving a
+        LIVE member enter the revoke barrier (``_pending``) until that
+        member acks its drain."""
+        old = {pair: w for w, pairs in self._target.items() for pair in pairs}
+        members = sorted(self._members,
+                         key=lambda w: self._members[w]["joined"])
+        self._generation += 1
+        self.rebalances += 1
+        self._target = {w: set() for w in members}
+        if not members:
+            return
+        base, extra = divmod(len(self._all_pairs), len(members))
+        share = {w: base + (1 if i < extra else 0)
+                 for i, w in enumerate(members)}
+        kept: Dict[str, list] = {w: [] for w in members}
+        pool = []
+        for pair in self._all_pairs:          # partition order: deterministic
+            w = old.get(pair)
+            if w in share and len(kept[w]) < share[w]:
+                kept[w].append(pair)
+            else:
+                pool.append(pair)
+        for w in members:                     # join order: deterministic
+            take = share[w] - len(kept[w])
+            if take > 0:
+                kept[w].extend(pool[:take])
+                del pool[:take]
+        for w in members:
+            self._target[w].update(kept[w])
+        # Barrier: pairs that moved away from a still-live previous owner
+        # wait for its drain ack; everything else (dead/absent owner, or
+        # still with its owner) clears immediately.
+        self._pending = {
+            pair: old[pair]
+            for w in members for pair in self._target[w]
+            if old.get(pair) not in (None, w)
+            and old.get(pair) in self._members}
+
+    def _lease_locked(self, worker_id: str) -> Lease:
+        target = self._target.get(worker_id, set())
+        withheld = tuple(sorted(
+            p for p in target
+            if self._pending.get(p) not in (None, worker_id)))
+        granted = tuple(sorted(p for p in target if p not in withheld))
+        return Lease(worker_id, self._generation, granted, withheld)
+
+    # ------------------------------------------------------------------
+    # observability + aggregation (monitor thread)
+    # ------------------------------------------------------------------
+
+    def assignments(self) -> Dict[str, List[tuple]]:
+        with self._lock:
+            return {w: sorted(pairs) for w, pairs in self._target.items()}
+
+    def committed_lag(self) -> Optional[int]:
+        """Rows not yet committed by the group, fleet-wide (None when no
+        probe is wired). Counts dead workers' unreassigned partitions."""
+        fn = self._lag_fn
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — observability must not kill serving
+            return None
+
+    def tick(self) -> dict:
+        """One coordinator pass: expire dead leases, aggregate the bus into
+        the fleet view, publish it back. Returns the view."""
+        with self._lock:
+            if self._expire_locked(self._clock()):
+                self._rebalance_locked()
+            generation = self._generation
+            members = set(self._members)
+            assignments = {w: sorted(pairs)
+                           for w, pairs in self._target.items()}
+            pending = len(self._pending)
+            rebalances, expirations = self.rebalances, self.expirations
+        snaps = self.bus.snapshots() if self.bus is not None else {}
+        backlogs: Dict[str, int] = {}
+        shed_total = 0
+        processed_total = 0
+        for wid, entry in snaps.items():
+            if wid not in members:
+                continue    # departed/expired worker's stale publish
+            doc = entry.get("health") or {}
+            b = doc.get("backlog")
+            if isinstance(b, (int, float)):
+                backlogs[wid] = int(b)
+            engine = doc.get("engine") or {}
+            shed_total += engine.get("shed") or 0
+            processed_total += engine.get("processed") or 0
+        global_backlog = sum(backlogs.values()) if backlogs else None
+        if global_backlog is not None:
+            self._peak_backlog = max(self._peak_backlog, global_backlog)
+        view = {
+            "time": self._wall(),
+            "generation": generation,
+            "workers": sorted(members),
+            "assignments": assignments,
+            "pending_release": pending,
+            "rebalances": rebalances,
+            "expirations": expirations,
+            "lease_ttl_sec": self.lease_ttl,
+            "global_backlog": global_backlog,
+            "peak_global_backlog": self._peak_backlog,
+            "backlog_per_worker": (
+                round(global_backlog / max(1, len(members)), 1)
+                if global_backlog is not None else None),
+            "per_worker_backlog": backlogs,
+            "shed_total": shed_total,
+            "processed_total": processed_total,
+            "committed_lag": self.committed_lag(),
+        }
+        with self._lock:
+            self._last_view = view
+        if self.bus is not None:
+            self.bus.publish_fleet(view)
+        return view
+
+    def last_view(self) -> Optional[dict]:
+        with self._lock:
+            return self._last_view

@@ -1,0 +1,255 @@
+"""Fleet facade: N partition-owning workers, one coordinator, one bus.
+
+The assembly layer the serve CLI (``--fleet N``) and the bench's ``fleet``
+section drive: construct the bus + coordinator, build one
+:class:`~fraud_detection_tpu.fleet.worker.FleetWorker` per slot, run them
+on threads with a monitor thread ticking the coordinator (lease expiry,
+global-backlog aggregation, optional fleet health file), and merge the
+results into one stats dict. ``Fleet.in_process`` wires everything against
+an :class:`~fraud_detection_tpu.stream.broker.InProcessBroker` — the
+manual-assignment consumers, the commit fence, the group-lag drain signal,
+per-worker adaptive schedulers with the fleet backlog source — which is
+the configuration the tests, the bench, and the demo CLI all share
+(docs/fleet.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from fraud_detection_tpu.fleet.bus import FleetBus
+from fraud_detection_tpu.fleet.coordinator import FleetCoordinator
+from fraud_detection_tpu.fleet.worker import FleetWorker
+from fraud_detection_tpu.stream.engine import StreamStats, _merge_stats
+from fraud_detection_tpu.utils import get_logger
+
+log = get_logger("fleet")
+
+
+class Fleet:
+    """N fleet workers + coordinator + monitor, run to completion or until
+    ``stop()``. Build directly with factories, or via :meth:`in_process`."""
+
+    def __init__(self, n_workers: int, make_engine: Callable,
+                 make_consumer: Callable, *,
+                 topics, num_partitions: int,
+                 bus: Optional[FleetBus] = None,
+                 lease_ttl: float = 30.0,
+                 lag_fn=None,
+                 death_plan=None,
+                 heartbeat_interval: float = 0.2,
+                 tick_interval: float = 0.2,
+                 health_file: Optional[str] = None,
+                 worker_prefix: str = "w"):
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        if tick_interval <= 0:
+            raise ValueError(
+                f"tick_interval must be > 0, got {tick_interval}")
+        self.bus = bus if bus is not None else FleetBus()
+        self.coordinator = FleetCoordinator(
+            topics, num_partitions, bus=self.bus, lease_ttl=lease_ttl,
+            lag_fn=lag_fn)
+        self.death_plan = death_plan
+        self.tick_interval = tick_interval
+        self.health_file = health_file
+        self.workers: List[FleetWorker] = [
+            FleetWorker(f"{worker_prefix}{i}", self.coordinator, self.bus,
+                        make_engine,
+                        self._bind_consumer_factory(make_consumer),
+                        death_plan=death_plan,
+                        heartbeat_interval=heartbeat_interval)
+            for i in range(n_workers)]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    @staticmethod
+    def _bind_consumer_factory(make_consumer):
+        return make_consumer
+
+    # ------------------------------------------------------------------
+    # in-process wiring (tests / bench / demo CLI)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def in_process(cls, broker, pipeline, input_topic: str,
+                   output_topic: str, n_workers: int, *,
+                   group_id: str = "fleet",
+                   batch_size: int = 1024,
+                   max_wait: float = 0.02,
+                   pipeline_depth: int = 2,
+                   async_dispatch: bool = False,
+                   sched_config=None,
+                   dlq_topic: Optional[str] = None,
+                   death_plan=None,
+                   bus_dir: Optional[str] = None,
+                   lease_ttl: float = 5.0,
+                   heartbeat_interval: float = 0.05,
+                   tick_interval: float = 0.05,
+                   health_file: Optional[str] = None) -> "Fleet":
+        """A fleet over an InProcessBroker: assigned consumers with the
+        coordinator's commit fence, group-lag drain signal, one shared
+        scoring pipeline, and (with ``sched_config``) a per-worker adaptive
+        scheduler shedding against the fleet's global backlog watermark."""
+        from fraud_detection_tpu.stream.engine import StreamingClassifier
+
+        fleet_holder: dict = {}
+        schedulers: dict = {}
+
+        def make_consumer(lease):
+            coordinator = fleet_holder["fleet"].coordinator
+            return broker.assigned_consumer(
+                lease.partitions, group_id,
+                fence=lambda pairs, wid=lease.worker_id:
+                    coordinator.fence_lost(wid, pairs))
+
+        def make_engine(consumer, worker_id):
+            scheduler = None
+            if sched_config is not None:
+                from fraud_detection_tpu.sched import AdaptiveScheduler
+
+                # One scheduler per worker, shared across its incarnations
+                # (same contract as serve.py --workers): incarnations run
+                # sequentially, so the single-driver region holds.
+                scheduler = schedulers.get(worker_id)
+                if scheduler is None:
+                    scheduler = AdaptiveScheduler(sched_config, batch_size)
+                    bus = fleet_holder["fleet"].bus
+                    scheduler.fleet_backlog = (
+                        lambda b=bus: (b.fleet_view() or {}).get(
+                            "backlog_per_worker"))
+                    schedulers[worker_id] = scheduler
+            return StreamingClassifier(
+                pipeline, consumer, broker.producer(), output_topic,
+                batch_size=batch_size, max_wait=max_wait,
+                pipeline_depth=pipeline_depth,
+                async_dispatch=async_dispatch,
+                scheduler=scheduler, dlq_topic=dlq_topic)
+
+        fleet = cls(
+            n_workers, make_engine, make_consumer,
+            topics=[input_topic], num_partitions=broker.num_partitions,
+            bus=FleetBus(dir=bus_dir), lease_ttl=lease_ttl,
+            lag_fn=lambda: broker.group_lag(group_id, [input_topic]),
+            death_plan=death_plan, heartbeat_interval=heartbeat_interval,
+            tick_interval=tick_interval, health_file=health_file)
+        fleet_holder["fleet"] = fleet
+        return fleet
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def stop(self) -> None:
+        """Cooperative shutdown: every worker drains + commits and leaves."""
+        self._stop.set()
+        for w in self.workers:
+            w.stop()
+
+    def fleet_health(self) -> dict:
+        """Monitor-thread-safe aggregate: the coordinator's last view plus
+        every live worker's engine health (the ``--fleet-health-file``
+        payload and the serve CLI's exit report)."""
+        return {
+            "time": time.time(),
+            "fleet": self.coordinator.last_view(),
+            "workers": {w.worker_id: {**w.result(), "health": w.health()}
+                        for w in self.workers},
+        }
+
+    def _write_health_file(self) -> None:
+        path = self.health_file
+        if path is None:
+            return
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self.fleet_health(), f, indent=2)
+            os.replace(tmp, path)
+        except OSError:
+            pass    # health reporting must never kill serving
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.tick_interval):
+            try:
+                self.coordinator.tick()
+            except Exception:  # noqa: BLE001 — the tick must keep ticking
+                log.exception("fleet coordinator tick failed")
+            self._write_health_file()
+
+    def _worker_main(self, worker: FleetWorker,
+                     idle_timeout: Optional[float]) -> None:
+        try:
+            worker.run(idle_timeout=idle_timeout)
+        except BaseException as e:  # noqa: BLE001 — surfaced via results
+            if worker.error is None:
+                worker.error = e
+            log.warning("fleet worker %s died: %r (survivors take over "
+                        "its partitions)", worker.worker_id, e)
+
+    def run(self, idle_timeout: Optional[float] = 1.0,
+            join_timeout: Optional[float] = None) -> dict:
+        """Run the whole fleet; returns the merged stats dict. With
+        ``idle_timeout`` set this is a drain run (workers exit once input
+        is idle AND the group's committed lag is zero — see
+        FleetWorker.run); None serves until ``stop()``."""
+        if self.death_plan is not None:
+            # Deterministic arming order — the seeded plan draws per ARM,
+            # so victims must not depend on thread start races.
+            for w in self.workers:
+                self.death_plan.arm(w.worker_id)
+        t0 = time.perf_counter()
+        monitor = threading.Thread(target=self._monitor_loop,
+                                   name="fleet-monitor", daemon=True)
+        monitor.start()
+        self._threads = [
+            threading.Thread(target=self._worker_main,
+                             args=(w, idle_timeout),
+                             name=f"fleet-{w.worker_id}", daemon=True)
+            for w in self.workers]
+        for t in self._threads:
+            t.start()
+        try:
+            for t in self._threads:
+                t.join(join_timeout)
+        except KeyboardInterrupt:
+            # Operator shutdown: drain + leave gracefully (partitions
+            # reassign immediately; nothing waits out a lease ttl).
+            self.stop()
+            for t in self._threads:
+                t.join(timeout=30.0)
+        finally:
+            self._stop.set()
+            monitor.join(timeout=5.0)
+        wall = time.perf_counter() - t0
+        try:
+            final_view = self.coordinator.tick()   # post-run aggregate
+        except Exception:  # noqa: BLE001
+            final_view = self.coordinator.last_view()
+        self._write_health_file()
+        total = StreamStats()
+        for w in self.workers:
+            _merge_stats(total, w.stats)
+        total.elapsed = wall     # workers overlap: wall-clock, not the sum
+        deaths = [w.result() for w in self.workers if w.death is not None]
+        errors = [w.result() for w in self.workers if w.error is not None]
+        out = {
+            **total.as_dict(),
+            "workers": len(self.workers),
+            "per_worker": [w.result() for w in self.workers],
+            "per_worker_processed": [w.stats.processed
+                                     for w in self.workers],
+            "incarnations": sum(w.incarnations for w in self.workers),
+            "rebalances": self.coordinator.rebalances,
+            "lease_expirations": self.coordinator.expirations,
+            "deaths": deaths,
+            "errors": [e["error"] for e in errors],
+            "fleet": final_view,
+        }
+        if self.death_plan is not None:
+            out["death_plan"] = self.death_plan.report()
+        return out

@@ -86,9 +86,9 @@ class DeviceStats:
     from health pollers by design (a monitoring sample, like StreamStats)."""
 
     __slots__ = ("uploads", "upload_bytes", "chunks", "donated",
-                 "pinned_bytes", "pins", "int8")
+                 "pinned_bytes", "pins", "int8", "mesh_devices", "_rungs")
 
-    def __init__(self, int8: bool = False):
+    def __init__(self, int8: bool = False, mesh_devices: int = 0):
         self.uploads = 0        # host->device transfer events
         self.upload_bytes = 0
         self.chunks = 0         # micro-batch chunks dispatched
@@ -96,11 +96,26 @@ class DeviceStats:
         self.pinned_bytes = 0   # model-side bytes made device-resident
         self.pins = 0           # pin_device() calls (1/version; re-pin on swap)
         self.int8 = int8
+        # Mesh data-parallel scoring (parallel/serving.py): chips on the
+        # mesh's data axis (0 = single-device path), and every distinct
+        # padded row count dispatched — prewarm populates it, so health
+        # shows which per-chip rungs are compiled BEFORE traffic arrives.
+        self.mesh_devices = mesh_devices
+        self._rungs: set = set()
 
-    def record_chunk(self, nbytes: int, transfers: int = 1) -> None:
+    def record_chunk(self, nbytes: int, transfers: int = 1,
+                     rows: Optional[int] = None) -> None:
         self.chunks += 1
         self.uploads += transfers
         self.upload_bytes += nbytes
+        if rows:
+            self._rungs.add(rows)   # set.add is atomic; snapshot copies
+
+    def per_chip_rungs(self) -> list:
+        """Distinct padded row counts dispatched, PER CHIP on the data
+        axis (== the global rungs on the single-device path)."""
+        dp = max(1, self.mesh_devices)
+        return sorted({-(-r // dp) for r in self._rungs})
 
     def snapshot(self) -> dict:
         chunks = self.chunks
@@ -114,6 +129,8 @@ class DeviceStats:
             "pinned_bytes": self.pinned_bytes,
             "model_pins": self.pins,
             "int8": self.int8,
+            "mesh_devices": self.mesh_devices,
+            "per_chip_rungs": self.per_chip_rungs(),
         }
 
 
@@ -191,7 +208,11 @@ class ServingPipeline:
                     "tree ensembles serve fp32 (their traversal compares "
                     "thresholds, not dot products)")
             self._q8 = linear_mod.quantize_weights(self._fused_model)
-        self.device_stats = DeviceStats(int8=self.int8)
+        if mesh is not None:
+            dp = int(dict(mesh.shape).get("data", 1))
+        else:
+            dp = 0
+        self.device_stats = DeviceStats(int8=self.int8, mesh_devices=dp)
         # Donate per-batch staging buffers into the scoring program when the
         # platform consumes them (probed once; False on CPU).
         self._donate = donation_effective()
@@ -378,7 +399,7 @@ class ServingPipeline:
         ids = np.asarray(ids)
         counts = np.asarray(counts)
         self.device_stats.record_chunk(ids.nbytes + counts.nbytes,
-                                       transfers=2)
+                                       transfers=2, rows=ids.shape[0])
         if self.mesh is None:
             return jnp.asarray(ids), jnp.asarray(counts)
         from fraud_detection_tpu.parallel.mesh import shard_rows
@@ -389,7 +410,8 @@ class ServingPipeline:
         """Place one packed (B, 2, L) staging buffer: ONE host->device
         transfer per micro-batch chunk (the accounting the bench's
         ``device`` block commits)."""
-        self.device_stats.record_chunk(packed.nbytes, transfers=1)
+        self.device_stats.record_chunk(packed.nbytes, transfers=1,
+                                       rows=packed.shape[0])
         if self.mesh is None:
             return jnp.asarray(packed)
         from fraud_detection_tpu.parallel.mesh import shard_rows

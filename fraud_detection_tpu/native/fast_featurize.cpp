@@ -925,6 +925,38 @@ void ftok_shard_fill16(void* sh, int16_t* ids, uint16_t* counts, int n_rows,
 
 void ftok_shard_destroy(void* sh) { delete static_cast<ShardState*>(sh); }
 
+// Raw-JSON shard twin of ftok_shard_begin: parse+extract+tokenize one shard
+// of a message batch into an opaque shard object, writing that shard's
+// status/span entries into the CALLER's (disjoint) array slices. The handle
+// is read-only here, so N Python worker threads fan a batch out over one
+// handle exactly like the text shards — and because the caller marshals ONE
+// char*[] for the whole batch and passes sub-pointers, the full array stays
+// valid as the splice context for ftok_build_frames afterwards
+// (featurize/parallel.py encode_json_sharded_native).
+void* ftok_shard_json_begin(void* h, const char** msgs, const int32_t* lens,
+                            int n_msgs, const char* key, int key_len,
+                            int32_t* status, int32_t* span_start,
+                            int32_t* span_len, int32_t* width_out) {
+  auto* f = static_cast<Featurizer*>(h);
+  auto* s = new ShardState;
+  s->rows.resize(size_t(std::max(n_msgs, 0)));
+  std::string_view key_view(key, size_t(key_len));
+  StampCounter acc;  // per-shard: no shared mutable state with other shards
+  acc.init(f->num_features);
+  int width = 0;
+  for (int d = 0; d < n_msgs; ++d) {
+    span_start[d] = 0;
+    span_len[d] = 0;
+    s->rows[d].clear();
+    status[d] = parse_json_message(
+        f, reinterpret_cast<const unsigned char*>(msgs[d]), lens[d], key_view,
+        span_start + d, span_len + d, acc, s->rows[d]);
+    if (status[d]) width = std::max(width, int(s->rows[d].size()));
+  }
+  *width_out = width;
+  return s;
+}
+
 // %.6f, locale-independent and hard-bounded: a co-loaded library calling
 // setlocale must not turn the decimal point into a comma, and out-of-[0,1]
 // inputs whose fixed rendering exceeds the caller's size estimate must fail

@@ -1,0 +1,92 @@
+"""Mesh data-parallel serving: one worker drives every local chip.
+
+``ServingPipeline`` already accepts a ``mesh`` and shards row batches over
+its "data" axis (models/pipeline.py ``_device_rows``/``_device_packed`` via
+``shard_rows``) — jit follows input shardings, so the SAME compiled scoring
+programs serve single-chip and mesh placements. What this module adds is
+the serving-lane packaging of that placement (docs/fleet.md "Mesh
+data-parallel scoring"):
+
+* :class:`MeshServingPipeline` — a drop-in ``ServingPipeline`` whose chunk
+  size scales with the chip count (``per_chip_batch`` rows per chip) and
+  whose padding-ladder targets stay divisible by the data axis, so every
+  compiled shape splits into identical per-chip shards (the ladder's rungs
+  become per-chip rungs: a global rung R runs R/dp rows on each chip).
+  On ONE device it constructs the plain single-device pipeline — byte-
+  identical scoring, no mesh in the way.
+* :func:`make_serving_mesh` — all local devices on the data axis (models
+  are tiny and replicated; rows are plentiful — the right layout for this
+  workload, parallel/mesh.py).
+
+Parity contract: labels and probabilities equal the single-device pipeline
+on the same inputs (padding rows are zeros, sliced off at resolve;
+per-row scoring has no cross-row collectives) — pinned by
+tests/test_fleet.py. ``health()['device']`` carries ``mesh_devices`` and
+the ``per_chip_rungs`` prewarm populated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from fraud_detection_tpu.models.pipeline import ServingPipeline
+from fraud_detection_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+
+def make_serving_mesh(n_devices: Optional[int] = None,
+                      devices: Optional[Sequence[jax.Device]] = None):
+    """All (or the first ``n_devices``) local devices on the data axis."""
+    return make_mesh(n_devices=n_devices, devices=devices)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+class MeshServingPipeline(ServingPipeline):
+    """Data-parallel ``ServingPipeline`` over the local device mesh.
+
+    ``per_chip_batch`` is the chunk size EACH chip scores; the pipeline's
+    ``batch_size`` becomes ``per_chip_batch * data_parallel`` so one
+    engine micro-batch feeds every chip at single-chip occupancy. With one
+    device the constructor degrades to the exact single-device pipeline
+    (``mesh=None`` — the fall-back-byte-identically contract)."""
+
+    def __init__(self, featurizer, model, *, per_chip_batch: int = 256,
+                 mesh=None, fold_idf: bool = True, int8: bool = False):
+        if per_chip_batch < 1:
+            raise ValueError(
+                f"per_chip_batch must be >= 1, got {per_chip_batch}")
+        if mesh is None:
+            mesh = make_serving_mesh()
+        dp = int(dict(mesh.shape).get(DATA_AXIS, 1))
+        self.data_parallel = dp
+        self.per_chip_batch = per_chip_batch
+        super().__init__(featurizer, model, fold_idf=fold_idf,
+                         batch_size=per_chip_batch * dp,
+                         mesh=mesh if dp > 1 else None, int8=int8)
+        # The 1-device fallback drops the mesh (exact single-device path)
+        # but the health block still says "mesh lane, 1 chip" rather than
+        # the plain pipeline's 0 — observers can tell the lane apart.
+        self.device_stats.mesh_devices = dp
+
+    def _pad_rows(self, n: int) -> int:
+        """Ladder rung for an n-row chunk, rounded up to a data-axis
+        multiple: keeps every compiled shape exactly shardable, so
+        ``shard_rows`` never appends its own padding rows (which would
+        silently fork the compiled-shape menu per chunk size)."""
+        target = super()._pad_rows(n)
+        dp = self.data_parallel
+        return -(-target // dp) * dp if dp > 1 else target
+
+    @classmethod
+    def from_pipeline(cls, pipe: ServingPipeline, *,
+                      per_chip_batch: Optional[int] = None,
+                      mesh=None) -> "MeshServingPipeline":
+        """Mesh twin of an existing pipeline (same featurizer + model —
+        the bench's parity comparisons build both from one artifact)."""
+        return cls(pipe.featurizer, pipe.model,
+                   per_chip_batch=per_chip_batch or pipe.batch_size,
+                   mesh=mesh, int8=pipe.int8)

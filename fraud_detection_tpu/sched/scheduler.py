@@ -134,6 +134,15 @@ class AdaptiveScheduler:
             config.resolved_max_batch_sec(),
             min_budget=self.buckets[0])
         self._sleep = sleep
+        # Fleet-coordinated shedding (fleet/coordinator.py, docs/fleet.md):
+        # an optional zero-arg callable returning the fleet's aggregated
+        # backlog-per-worker (None when the fleet view is stale/absent).
+        # When it reports MORE queued work than this worker's own
+        # partitions show, admission sheds against the global watermark —
+        # a drowning fleet sheds everywhere at once instead of each worker
+        # guessing from its own slice. None (the default) keeps the purely
+        # local signal.
+        self.fleet_backlog: Optional[callable] = None
         # collect/admit mutate shared control state (token bucket, EWMAs,
         # AIMD fraction) and are single-driver by the same contract as the
         # engine loop that calls them; snapshot() deliberately does NOT
@@ -160,16 +169,29 @@ class AdaptiveScheduler:
             return self.batcher.collect(consumer, budget, first_wait)
 
     def backlog_of(self, consumer) -> Optional[int]:
-        """Rows still queued behind the current poll position, when the
-        transport can report it (InProcessConsumer.backlog; None otherwise —
-        watermark shedding is then inert)."""
+        """The queue-depth signal admission sheds against: rows queued
+        behind this worker's poll position (InProcessConsumer.backlog; None
+        when the transport can't report it), raised to the fleet's
+        backlog-per-worker watermark when a ``fleet_backlog`` source is
+        wired and reports more (the global number keeps each worker's
+        ``max_queue`` threshold meaningful while coordinating WHEN the
+        fleet sheds)."""
         backlog = getattr(consumer, "backlog", None)
-        if backlog is None:
-            return None
-        try:
-            return backlog()
-        except Exception:  # noqa: BLE001 — lag reporting must never kill serving
-            return None
+        local: Optional[int] = None
+        if backlog is not None:
+            try:
+                local = backlog()
+            except Exception:  # noqa: BLE001 — lag reporting must never kill serving
+                local = None
+        fleet = self.fleet_backlog
+        if fleet is not None:
+            try:
+                g = fleet()
+            except Exception:  # noqa: BLE001 — same contract as the local probe
+                g = None
+            if g is not None:
+                return max(int(g), local if local is not None else 0)
+        return local
 
     def admit(self, msgs: List, backlog: Optional[int]
               ) -> Tuple[List, List[Tuple[object, str]]]:

@@ -821,6 +821,12 @@ class StreamingClassifier:
             "pinned_bytes": snap.get("pinned_bytes"),
             "model_pins": snap.get("model_pins"),
             "int8": snap.get("int8"),
+            # Mesh data-parallel scoring (parallel/serving.py): chips on
+            # the data axis (0/None = single-device) and the per-chip
+            # padded rungs dispatched — prewarm counts here, so a mesh
+            # worker's health proves its rungs compiled before traffic.
+            "mesh_devices": snap.get("mesh_devices"),
+            "per_chip_rungs": snap.get("per_chip_rungs"),
         }
 
     def close_annotations(self, timeout: float = 30.0) -> bool:

@@ -138,6 +138,90 @@ class FaultPlan:
         return ChaosProducer(inner, self)
 
 
+class WorkerKilled(RuntimeError):
+    """An injected whole-worker death (WorkerDeathPlan). Raised out of the
+    victim worker's poll path — BEFORE any new batch dispatches, so nothing
+    is produced-but-uncommitted when it fires; the engine's abort path
+    discards in-flight (unproduced) batches and the partitions' next owner
+    resumes from the committed offsets with zero loss and zero duplicates.
+    ``mode`` is "graceful" (the worker releases its lease immediately —
+    revoke->drain->commit->reassign) or "crash" (the worker just vanishes;
+    its lease must EXPIRE before the coordinator reassigns)."""
+
+    def __init__(self, worker_id: str, mode: str):
+        self.worker_id = worker_id
+        self.mode = mode
+        super().__init__(f"chaos: worker {worker_id!r} killed ({mode})")
+
+
+@dataclass
+class WorkerDeathPlan:
+    """A seeded schedule of whole-worker deaths for the fleet chaos harness
+    (the PR 1 fault plan kills *calls*; this kills *workers* — the failure
+    the fleet rebalance protocol exists to survive, docs/fleet.md).
+
+    For each victim the plan draws, deterministically from one seeded rng:
+    which worker dies, after how many of ITS polls, and how (graceful
+    lease release vs crash + lease expiry). ``arm(worker_id)`` is called
+    once per worker as it joins (arming order must therefore be
+    deterministic — the fleet arms workers in index order); ``tick`` is
+    called per poll and raises :class:`WorkerKilled` when that worker's
+    time comes. Workers beyond ``kills`` never die."""
+
+    seed: int = 0
+    kills: int = 1
+    min_polls: int = 2
+    max_polls: int = 12
+    modes: tuple = ("graceful", "crash")
+
+    def __post_init__(self):
+        if self.kills < 0:
+            raise ValueError(f"kills must be >= 0, got {self.kills}")
+        if not 0 < self.min_polls <= self.max_polls:
+            raise ValueError(
+                f"need 0 < min_polls <= max_polls, got "
+                f"{self.min_polls}/{self.max_polls}")
+        self._rng = random.Random(self.seed)
+        self._schedule: Dict[str, tuple] = {}   # worker_id -> (at_poll, mode)
+        self._polls: Dict[str, int] = {}
+        self._armed: List[str] = []
+        self.killed: List[tuple] = []           # (worker_id, mode, at_poll)
+        self._lock = threading.Lock()
+
+    def arm(self, worker_id: str) -> None:
+        """Register a worker with the plan; the first ``kills`` armed
+        workers draw a death (poll count + mode) from the seeded rng."""
+        with self._lock:
+            if worker_id in self._polls:
+                return
+            self._polls[worker_id] = 0
+            self._armed.append(worker_id)
+            if len(self._schedule) < self.kills:
+                at = self._rng.randint(self.min_polls, self.max_polls)
+                mode = self.modes[self._rng.randrange(len(self.modes))]
+                self._schedule[worker_id] = (at, mode)
+
+    def tick(self, worker_id: str) -> None:
+        """One poll by ``worker_id``; raises WorkerKilled at its drawn poll."""
+        with self._lock:
+            if worker_id not in self._polls:
+                return
+            self._polls[worker_id] += 1
+            death = self._schedule.get(worker_id)
+            if death is None or self._polls[worker_id] < death[0]:
+                return
+            del self._schedule[worker_id]
+            self.killed.append((worker_id, death[1], death[0]))
+            mode = death[1]
+        raise WorkerKilled(worker_id, mode)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"kills_planned": self.kills,
+                    "killed": [{"worker": w, "mode": m, "at_poll": p}
+                               for w, m, p in self.killed]}
+
+
 def _corrupt(msg: Message) -> Message:
     """A copy of ``msg`` with an undecodable value and everything else —
     key, partition, offset — intact, so commit accounting and key-set

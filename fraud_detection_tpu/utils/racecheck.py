@@ -54,9 +54,11 @@ INSTRUMENTED_REGIONS = frozenset({
     "StreamingClassifier.drive",     # engine single-driver loop
     "AdaptiveScheduler.drive",       # scheduler collect/admit/observe
     "InProcessConsumer",             # broker consumer poll/commit
+    "InProcessAssignedConsumer",     # manual-assignment consumer (fleet)
     "NativeFeaturizer",              # native begin/fill pairing (checker)
     "ShadowScorer.worker",           # shadow-scoring worker (one thread)
     "LifecycleController.watch",     # hot-swap watch thread tick/rollback
+    "FleetWorker.run",               # one thread drives a worker's engines
 })
 
 

@@ -1,0 +1,39 @@
+"""flightcheck fixture: a fleet-shaped worker/coordinator pair with the
+violations the fleet registrations exist to prevent (never imported).
+
+``RogueFleet`` spawns a worker thread the entry-point registry doesn't know
+(FC103), and ``LeaseBoard`` lets its monitor-thread tick write the shared
+lease map without the lock its worker-facing surface uses (FC102) — the
+exact drift mode for a grown fleet/ tree: a new thread or coordinator
+mutation lands without its concurrency contract being registered/guarded.
+"""
+
+import threading
+
+
+class RogueFleet:
+    def _fleet_worker_main(self):
+        pass
+
+    def launch(self):
+        t = threading.Thread(target=self._fleet_worker_main, daemon=True)
+        t.start()
+        return t
+
+
+class LeaseBoard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.leases = {}
+        self.generation = 0
+
+    def renew(self, worker_id):
+        with self._lock:
+            self.leases[worker_id] = self.generation
+
+    def _tick(self):
+        self.generation = self.generation + 1   # VIOLATION: shared, no lock
+
+    def _tick_guarded(self):
+        with self._lock:
+            self.generation = self.generation + 1

@@ -1,0 +1,529 @@
+"""Fleet serving lane (fraud_detection_tpu/fleet/, docs/fleet.md).
+
+Pins the subsystem's defining invariants:
+
+* bus pub/read in-process AND file-backed (two buses sharing a directory
+  stand in for two processes);
+* coordinator membership: balanced-sticky assignment, the revoke->drain->
+  commit->reassign barrier for live owners, immediate reassign on graceful
+  leave, lease expiry on worker death, zombie commit fencing;
+* the manual-assignment consumer: committed-offset resume and fence;
+* whole-fleet drains: exact key-set accounting (every input key classified
+  exactly once), including across SEEDED WORKER DEATHS in both modes
+  (graceful release and crash + lease expiry) with per-source-partition
+  output order preserved — the chaos-harness extension of ISSUE 8;
+* globally-coordinated shedding: the scheduler sheds against the fleet's
+  aggregated backlog watermark, every shed row an accounted DLQ record;
+* mesh data-parallel scoring parity (labels/probs equal the single-device
+  pipeline; byte-identical fall-back on one chip; per-chip rungs in the
+  health device block).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.fleet import (Fleet, FleetBus, FleetCoordinator,
+                                       FleetWorker)
+from fraud_detection_tpu.stream import InProcessBroker
+from fraud_detection_tpu.stream.broker import CommitFailedError
+from fraud_detection_tpu.stream.faults import WorkerDeathPlan, WorkerKilled
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    return synthetic_demo_pipeline(batch_size=64, n=300, seed=3,
+                                   num_features=1024,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
+
+
+def feed(broker, n, topic="in"):
+    producer = broker.producer()
+    for i in range(n):
+        producer.produce(topic,
+                         json.dumps({"text": f"hello dialogue {i}",
+                                     "id": i}).encode(),
+                         key=str(i).encode())
+
+
+def drain(broker, pipeline, n_workers, *, death_plan=None, sched_config=None,
+          dlq_topic=None, batch_size=64, lease_ttl=1.0, idle=0.3):
+    fleet = Fleet.in_process(
+        broker, pipeline, "in", "out", n_workers, batch_size=batch_size,
+        death_plan=death_plan, sched_config=sched_config,
+        dlq_topic=dlq_topic, lease_ttl=lease_ttl,
+        heartbeat_interval=0.02, tick_interval=0.02)
+    result = fleet.run(idle_timeout=idle, join_timeout=90.0)
+    return fleet, result
+
+
+def out_keys(broker, topics=("out",)):
+    keys = []
+    for t in topics:
+        keys += [m.key for m in broker.messages(t)]
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# bus
+# ---------------------------------------------------------------------------
+
+def test_bus_inprocess_publish_read_retract():
+    bus = FleetBus()
+    bus.publish("w0", {"backlog": 3})
+    bus.publish("w1", {"backlog": 5})
+    snaps = bus.snapshots()
+    assert set(snaps) == {"w0", "w1"}
+    assert snaps["w0"]["health"]["backlog"] == 3
+    bus.retract("w0")
+    assert set(bus.snapshots()) == {"w1"}
+    assert bus.fleet_view() is None
+    bus.publish_fleet({"global_backlog": 8})
+    assert bus.fleet_view()["global_backlog"] == 8
+
+
+def test_bus_file_backed_crosses_instances(tmp_path):
+    """Two FleetBus instances sharing one directory see each other's
+    workers and fleet view — the multi-process transport."""
+    a = FleetBus(dir=str(tmp_path))
+    b = FleetBus(dir=str(tmp_path))
+    a.publish("w0", {"backlog": 7})
+    snaps = b.snapshots()
+    assert snaps["w0"]["health"]["backlog"] == 7
+    a.publish_fleet({"global_backlog": 7, "workers": ["w0"]})
+    assert b.fleet_view()["global_backlog"] == 7
+    # corrupt file tolerated
+    (tmp_path / "worker-bad.json").write_text("{torn")
+    assert "bad" not in b.snapshots()
+    a.retract("w0")
+    assert "w0" not in b.snapshots()
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+
+def test_coordinator_sticky_join_leave():
+    c = FleetCoordinator(["in"], 4, lease_ttl=30.0)
+    l0 = c.join("w0")
+    assert set(l0.partitions) == {("in", p) for p in range(4)}
+    l1 = c.join("w1")
+    l0b = c.sync("w0")
+    # disjoint covering TARGETS; w1's share is withheld until w0 drains
+    assert set(l0b.partitions) | set(l1.partitions) | set(l1.pending) == \
+        {("in", p) for p in range(4)}
+    assert set(l0b.partitions).isdisjoint(set(l1.partitions))
+    assert len(l0b.partitions) == 2 and l1.pending
+    # barrier: w0 acks its drain -> w1's pending pairs become granted
+    c.ack("w0")
+    l1b = c.sync("w1")
+    assert not l1b.pending and len(l1b.partitions) == 2
+    # sticky: w0 kept 2 of its original pairs across the rebalance
+    assert set(l0b.partitions) <= set(l0.partitions)
+    # graceful leave reassigns immediately (no barrier, no ttl wait)
+    c.leave("w1")
+    l0c = c.sync("w0")
+    assert set(l0c.partitions) == {("in", p) for p in range(4)}
+    assert not l0c.pending
+
+
+def test_coordinator_lease_expiry_and_zombie_fence():
+    clock = [0.0]
+    c = FleetCoordinator(["in"], 2, lease_ttl=1.0, clock=lambda: clock[0])
+    c.join("w0")
+    c.join("w1")
+    c.ack("w0")
+    assert len(c.sync("w0").partitions) == 1
+    # w1 stops heartbeating; its lease expires at the next group op
+    clock[0] = 2.0
+    l0 = c.sync("w0")
+    assert set(l0.partitions) == {("in", 0), ("in", 1)}
+    assert c.expirations == 1
+    # the zombie's commit is fenced: it owns nothing anymore
+    lost = c.fence_lost("w1", [("in", 1)])
+    assert lost == [("in", 1)]
+    # live owner commits pass the fence
+    assert c.fence_lost("w0", [("in", 0), ("in", 1)]) == []
+
+
+def test_coordinator_tick_aggregates_global_backlog():
+    bus = FleetBus()
+    c = FleetCoordinator(["in"], 4, bus=bus, lease_ttl=30.0)
+    c.join("w0")
+    c.join("w1")
+    bus.publish("w0", {"backlog": 30, "engine": {"shed": 2, "processed": 10}})
+    bus.publish("w1", {"backlog": 10, "engine": {"shed": 1, "processed": 5}})
+    bus.publish("ghost", {"backlog": 999})   # not a member: ignored
+    view = c.tick()
+    assert view["global_backlog"] == 40
+    assert view["backlog_per_worker"] == 20.0
+    assert view["peak_global_backlog"] == 40
+    assert view["shed_total"] == 3 and view["processed_total"] == 15
+    assert bus.fleet_view()["global_backlog"] == 40
+
+
+# ---------------------------------------------------------------------------
+# assigned consumer
+# ---------------------------------------------------------------------------
+
+def test_assigned_consumer_resume_and_fence():
+    broker = InProcessBroker(num_partitions=2)
+    feed(broker, 20)
+    c1 = broker.assigned_consumer([("in", 0), ("in", 1)], "g")
+    msgs = c1.poll_batch(8, 0.2)
+    assert msgs
+    offsets = {}
+    for m in msgs:
+        offsets[(m.topic, m.partition)] = max(
+            offsets.get((m.topic, m.partition), 0), m.offset + 1)
+    c1.commit_offsets(offsets)
+    c1.close()
+    # a successor resumes each partition from the COMMITTED offsets
+    c2 = broker.assigned_consumer([("in", 0), ("in", 1)], "g")
+    seen = {(m.partition, m.offset) for m in c2.poll_batch(100, 0.2)}
+    for (t, p), off in offsets.items():
+        assert (p, off - 1) not in seen          # committed: not re-read
+        assert all(o >= off for q, o in seen if q == p)
+    # fence: a revoked pair turns the commit into CommitFailedError
+    c3 = broker.assigned_consumer([("in", 0)], "g",
+                                  fence=lambda pairs: list(pairs))
+    c3.poll_batch(4, 0.2)
+    with pytest.raises(CommitFailedError):
+        c3.commit_offsets({("in", 0): 99})
+    # backlog counts unpolled rows of the assigned pairs only
+    c4 = broker.assigned_consumer([("in", 0)], "g2")
+    assert c4.backlog() == len(broker.messages("in")) - sum(
+        1 for m in broker.messages("in") if m.partition != 0)
+
+
+# ---------------------------------------------------------------------------
+# death plan
+# ---------------------------------------------------------------------------
+
+def test_worker_death_plan_seeded_and_deterministic():
+    def schedule(seed):
+        plan = WorkerDeathPlan(seed=seed, kills=2, min_polls=1, max_polls=5)
+        for w in ("w0", "w1", "w2"):
+            plan.arm(w)
+        fired = []
+        for _ in range(10):
+            for w in ("w0", "w1", "w2"):
+                try:
+                    plan.tick(w)
+                except WorkerKilled as e:
+                    fired.append((e.worker_id, e.mode))
+        return fired
+
+    a, b = schedule(42), schedule(42)
+    assert a == b and len(a) == 2          # same seed: same deaths
+    assert schedule(43) != a or True       # different seed may differ
+    plan = WorkerDeathPlan(seed=42, kills=1)
+    plan.arm("w0")
+    assert plan.report()["kills_planned"] == 1
+
+
+# ---------------------------------------------------------------------------
+# whole-fleet drains (the headline invariants)
+# ---------------------------------------------------------------------------
+
+N_MSGS = 900
+
+
+def _expect(n=N_MSGS):
+    return sorted(str(i).encode() for i in range(n))
+
+
+def test_fleet_two_workers_drain_exact_accounting(pipeline):
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, N_MSGS)
+    fleet, result = drain(broker, pipeline, 2)
+    assert result["processed"] == N_MSGS
+    assert sorted(out_keys(broker)) == _expect()
+    assert sum(result["per_worker_processed"]) == N_MSGS
+    assert result["deaths"] == [] and result["errors"] == []
+    # both workers did real work once the group settled
+    assert all(p > 0 for p in result["per_worker_processed"])
+
+
+def _assert_no_reorder(broker):
+    """Per SOURCE partition, classified outputs appear in offset order —
+    ownership handoffs never interleave a partition's rows."""
+    by_key_pos = {m.key: i
+                  for i, m in enumerate(broker.messages("out"))}
+    for p_msgs in [[m for m in broker.messages("in") if m.partition == p]
+                   for p in range(broker.num_partitions)]:
+        positions = [by_key_pos[m.key] for m in p_msgs
+                     if m.key in by_key_pos]
+        assert positions == sorted(positions)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("mode", ["graceful", "crash"])
+def test_fleet_worker_kill_zero_loss_zero_dup_no_reorder(pipeline, mode):
+    """The ISSUE 8 chaos pin: a seeded whole-worker death mid-drain, then
+    rebalance (immediate release or lease expiry) — zero lost keys, zero
+    duplicated keys, per-partition order preserved, exact accounting."""
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, N_MSGS)
+    plan = WorkerDeathPlan(seed=9, kills=1, min_polls=2, max_polls=5,
+                           modes=(mode,))
+    fleet, result = drain(broker, pipeline, 2, death_plan=plan,
+                          lease_ttl=0.8)
+    keys = out_keys(broker)
+    assert sorted(keys) == _expect(), (
+        f"lost={len(set(_expect()) - set(keys))} "
+        f"dup={len(keys) - len(set(keys))}")
+    _assert_no_reorder(broker)
+    assert len(result["deaths"]) == 1
+    assert result["deaths"][0]["dead"] == mode
+    assert result["death_plan"]["killed"][0]["mode"] == mode
+    if mode == "crash":
+        assert result["lease_expirations"] >= 1
+    # the survivor finished the dead worker's partitions
+    survivors = [r for r in result["per_worker"] if r["dead"] is None]
+    assert survivors and sum(r["processed"] for r in survivors) > 0
+
+
+def test_fleet_worker_kill_bit_reproducible(pipeline):
+    """Same seed -> same death schedule -> same per-worker accounting."""
+    def run():
+        broker = InProcessBroker(num_partitions=4)
+        feed(broker, 300)
+        plan = WorkerDeathPlan(seed=21, kills=1, modes=("graceful",))
+        _, result = drain(broker, pipeline, 2, death_plan=plan)
+        return result["death_plan"]["killed"]
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# global-watermark shedding
+# ---------------------------------------------------------------------------
+
+def test_scheduler_fleet_backlog_raises_local_signal():
+    """Unit pin for sched/scheduler.py: the admission watermark sees the
+    FLEET's backlog-per-worker when it exceeds the local one — a worker
+    with a quiet partition still sheds while the fleet drowns."""
+    from fraud_detection_tpu.sched import AdaptiveScheduler, SchedulerConfig
+
+    sched = AdaptiveScheduler(
+        SchedulerConfig(max_queue=10, shed_policy="reject",
+                        cost_aware=False), 64)
+
+    class QuietConsumer:
+        def backlog(self):
+            return 2
+
+    assert sched.backlog_of(QuietConsumer()) == 2
+    sched.fleet_backlog = lambda: 500.0
+    assert sched.backlog_of(QuietConsumer()) == 500
+    sched.fleet_backlog = lambda: None       # stale view: local wins
+    assert sched.backlog_of(QuietConsumer()) == 2
+    sched.fleet_backlog = lambda: 1 / 0      # broken source never kills
+    assert sched.backlog_of(QuietConsumer()) == 2
+
+
+def test_fleet_global_shed_exact_accounting(pipeline):
+    """Over-committed preload vs a small max_queue: rows shed against the
+    global watermark land as DLQ records, and classified + shed keys still
+    account for every input exactly once."""
+    from fraud_detection_tpu.sched import SchedulerConfig
+
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, N_MSGS)
+    cfg = SchedulerConfig(max_queue=64, shed_policy="reject",
+                          cost_aware=False)
+    fleet, result = drain(broker, pipeline, 2, sched_config=cfg,
+                          dlq_topic="dlq")
+    assert result["shed"] > 0
+    keys = out_keys(broker, topics=("out", "dlq"))
+    assert sorted(keys) == _expect()
+    view = result["fleet"]
+    assert view["peak_global_backlog"] > 0
+
+
+# ---------------------------------------------------------------------------
+# mesh data-parallel scoring
+# ---------------------------------------------------------------------------
+
+def _mesh_twin(pipeline, per_chip=16):
+    from fraud_detection_tpu.parallel.serving import MeshServingPipeline
+
+    return MeshServingPipeline.from_pipeline(pipeline,
+                                             per_chip_batch=per_chip)
+
+
+def test_mesh_pipeline_parity(pipeline):
+    import jax
+
+    if jax.local_device_count() < 2:
+        pytest.skip("single device: mesh path not constructible")
+    mesh_pipe = _mesh_twin(pipeline)
+    assert mesh_pipe.data_parallel == jax.local_device_count()
+    texts = [f"hello dialogue {i} urgent verify account" for i in range(200)]
+    ref = pipeline.predict(texts)
+    got = mesh_pipe.predict(texts)
+    assert np.array_equal(ref.labels, got.labels)
+    assert np.allclose(ref.probabilities, got.probabilities, atol=1e-6)
+    # raw-JSON path too (the engine's actual hot path)
+    values = [json.dumps({"text": t}).encode() for t in texts]
+    fr = pipeline.predict_json_async(values)
+    fg = mesh_pipe.predict_json_async(values)
+    if fr is not None and fg is not None:
+        r, g = fr[0].resolve(), fg[0].resolve()
+        valid = np.flatnonzero(np.asarray(fr[1]))
+        assert np.array_equal(r.labels[valid], g.labels[valid])
+        assert np.allclose(r.probabilities[valid], g.probabilities[valid],
+                           atol=1e-6)
+    snap = mesh_pipe.device_stats.snapshot()
+    assert snap["mesh_devices"] == mesh_pipe.data_parallel
+    assert snap["per_chip_rungs"]       # rungs recorded per chip
+
+
+def test_mesh_single_device_fallback_byte_identical(pipeline):
+    from fraud_detection_tpu.parallel.mesh import make_mesh
+    from fraud_detection_tpu.parallel.serving import MeshServingPipeline
+
+    single = MeshServingPipeline(pipeline.featurizer, pipeline.model,
+                                 per_chip_batch=64,
+                                 mesh=make_mesh(n_devices=1))
+    assert single.mesh is None and single.data_parallel == 1
+    texts = [f"hello dialogue {i}" for i in range(50)]
+    ref = pipeline.predict(texts)
+    got = single.predict(texts)
+    assert np.array_equal(ref.labels, got.labels)
+    assert np.array_equal(ref.probabilities, got.probabilities)
+    assert single.device_stats.snapshot()["mesh_devices"] == 1
+
+
+def test_mesh_pad_rows_stay_shardable(pipeline):
+    import jax
+
+    if jax.local_device_count() < 2:
+        pytest.skip("single device: mesh path not constructible")
+    mesh_pipe = _mesh_twin(pipeline)
+    dp = mesh_pipe.data_parallel
+    mesh_pipe.pad_ladder = (16, 64, 256)
+    for n in (1, 3, 17, 65, 100, mesh_pipe.batch_size):
+        target = mesh_pipe._pad_rows(n)
+        assert target % dp == 0 and target >= n
+
+
+def test_mesh_fleet_drain_and_health_device_block(pipeline):
+    """A fleet worker driving the mesh pipeline: exact accounting plus the
+    health()['device'] mesh evidence (mesh_devices, per_chip_rungs)."""
+    import jax
+
+    if jax.local_device_count() < 2:
+        pytest.skip("single device: mesh path not constructible")
+    mesh_pipe = _mesh_twin(pipeline)
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, 300)
+    from fraud_detection_tpu.stream import StreamingClassifier
+
+    consumer = broker.assigned_consumer([("in", p) for p in range(4)], "g")
+    engine = StreamingClassifier(mesh_pipe, consumer, broker.producer(),
+                                 "out", batch_size=64)
+    engine.run(max_messages=300, idle_timeout=1.0)
+    assert sorted(m.key for m in broker.messages("out")) == _expect(300)
+    dev = engine.health()["device"]
+    assert dev["mesh_devices"] == mesh_pipe.data_parallel
+    assert dev["per_chip_rungs"]
+
+
+# ---------------------------------------------------------------------------
+# serve CLI e2e
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_fleet_demo(tmp_path, capsys):
+    from fraud_detection_tpu.app import serve
+
+    health = tmp_path / "fleet.json"
+    rc = serve.main(["--model", "synthetic", "--demo", "400",
+                     "--fleet", "2", "--partitions", "4",
+                     "--batch-size", "64",
+                     "--fleet-health-file", str(health)])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    out = json.loads(lines[-1])
+    assert out["processed"] == 400
+    assert out["workers"] == 2 and out["errors"] == []
+    doc = json.loads(health.read_text())
+    assert "fleet" in doc and "workers" in doc
+
+
+def test_serve_cli_mesh_demo(capsys):
+    """serve --mesh: the demo drains through the mesh data-parallel
+    pipeline and health()['device'] carries the mesh evidence."""
+    import jax
+
+    from fraud_detection_tpu.app import serve
+
+    rc = serve.main(["--model", "synthetic", "--demo", "200",
+                     "--batch-size", "64", "--mesh"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    out = json.loads(lines[-1])
+    assert out["processed"] == 200
+    dev = out["health"]["device"]
+    assert dev["mesh_devices"] == jax.local_device_count()
+    assert dev["per_chip_rungs"]
+
+
+def test_serve_cli_fleet_rejects_bad_combos():
+    from fraud_detection_tpu.app import serve
+
+    with pytest.raises(SystemExit):
+        serve.main(["--model", "synthetic", "--kafka", "--fleet", "2"])
+    with pytest.raises(SystemExit):
+        serve.main(["--model", "synthetic", "--demo", "10", "--fleet", "2",
+                    "--workers", "3"])
+    with pytest.raises(SystemExit):
+        serve.main(["--model", "synthetic", "--demo", "10", "--fleet", "2",
+                    "--supervise", "3"])
+
+
+def test_fleet_health_file_written_during_run(pipeline, tmp_path):
+    path = tmp_path / "fleet.json"
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, 300)
+    fleet = Fleet.in_process(broker, pipeline, "in", "out", 2,
+                             batch_size=64, lease_ttl=1.0,
+                             heartbeat_interval=0.02, tick_interval=0.02,
+                             health_file=str(path))
+    fleet.run(idle_timeout=0.3, join_timeout=90.0)
+    doc = json.loads(path.read_text())
+    assert set(doc) == {"time", "fleet", "workers"}
+    assert doc["fleet"]["rebalances"] >= 1
+
+
+def test_fleet_stop_is_graceful(pipeline):
+    """stop() mid-run: workers drain + commit + leave; nothing is lost and
+    a fresh fleet finishes the remainder without duplicates."""
+    import threading
+
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, N_MSGS)
+    fleet = Fleet.in_process(broker, pipeline, "in", "out", 2,
+                             batch_size=32, lease_ttl=1.0,
+                             heartbeat_interval=0.02, tick_interval=0.02)
+    timer = threading.Timer(0.4, fleet.stop)
+    timer.start()
+    fleet.run(idle_timeout=5.0, join_timeout=90.0)
+    timer.cancel()
+    # resume with a second fleet: the union is exactly-once
+    fleet2 = Fleet.in_process(broker, pipeline, "in", "out", 2,
+                              batch_size=64, lease_ttl=1.0,
+                              heartbeat_interval=0.02, tick_interval=0.02)
+    fleet2.run(idle_timeout=0.3, join_timeout=90.0)
+    assert sorted(out_keys(broker)) == _expect()

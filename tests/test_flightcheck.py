@@ -156,6 +156,51 @@ def test_fc103_unregistered_thread_detected():
     assert len(spawn) == 1 and "rogue" in spawn[0].message
 
 
+def test_fleet_fixture_violations_detected():
+    """The fleet drift modes the PR 8 registrations guard against: an
+    unregistered fleet worker thread (FC103) and a coordinator tick
+    mutating the shared lease state without the lock its worker-facing
+    surface uses (FC102)."""
+    sf = load_fixture("fx_fleet.py")
+    spawn = [f for f in threadmap.analyze([sf], package_root=PKG,
+                                          sites_registry=frozenset(),
+                                          entry_points=())
+             if "spawn site" in f.message]
+    assert len(spawn) == 1 and "_fleet_worker_main" in spawn[0].message
+    spec = ClassSpec(any_thread=frozenset({"renew"}),
+                     workers={"monitor": frozenset({"_tick",
+                                                    "_tick_guarded"})})
+    fc102 = [f for f in concurrency.analyze(
+        [sf], registry={"fx_fleet.py::LeaseBoard": spec})
+        if f.rule == "FC102"]
+    assert len(fc102) == 1 and "_tick" in fc102[0].message, fc102
+    assert "_tick_guarded" not in fc102[0].message
+
+
+def test_fleet_threads_and_regions_registered():
+    """The real fleet tree's concurrency map is registered end to end:
+    thread sites, entry points with live racecheck regions, role maps for
+    every fleet class, and the manual-assignment consumer's region."""
+    from fraud_detection_tpu.analysis.entrypoints import (IMPLEMENTATIONS,
+                                                          OBJECT_BINDINGS,
+                                                          THREAD_SITES)
+
+    assert ("fleet/fleet.py", "self._worker_main") in THREAD_SITES
+    assert ("fleet/fleet.py", "self._monitor_loop") in THREAD_SITES
+    eps = {(ep.module, ep.qualname): ep for ep in THREAD_ENTRY_POINTS}
+    worker_ep = eps[("fleet/fleet.py", "Fleet._worker_main")]
+    assert worker_ep.racecheck == "FleetWorker.run"
+    assert worker_ep.racecheck in racecheck.INSTRUMENTED_REGIONS
+    assert "InProcessAssignedConsumer" in racecheck.INSTRUMENTED_REGIONS
+    for key in ("fleet/bus.py::FleetBus",
+                "fleet/coordinator.py::FleetCoordinator",
+                "fleet/worker.py::FleetWorker",
+                "fleet/fleet.py::Fleet"):
+        assert key in CONCURRENT_CLASSES, key
+    assert "fleet/worker.py::FleetWorker.coordinator" in OBJECT_BINDINGS
+    assert "InProcessAssignedConsumer" in IMPLEMENTATIONS["Consumer"]
+
+
 # ---------------------------------------------------------------------------
 # 1b. whole-program + protocol rules (PR 6) catch their fixtures
 # ---------------------------------------------------------------------------

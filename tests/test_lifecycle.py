@@ -396,6 +396,8 @@ DEVICE_BLOCK_SCHEMA = {
     "pinned_bytes": (type(None), int),
     "model_pins": (type(None), int),
     "int8": (type(None), bool),
+    "mesh_devices": (type(None), int),       # 0/None: single-device path
+    "per_chip_rungs": (type(None), list),
 }
 
 MODEL_BLOCK_SCHEMA = {

@@ -123,6 +123,98 @@ class TestNativeSharded:
             _assert_batches_equal(got, want)
 
 
+class TestShardedJsonEncode:
+    """The raw-JSON shard fan-out (ftok_shard_json_begin, PR 8 satellite):
+    byte parity vs the serial ``encode_json`` across malformed rows,
+    escaped keys, wide feature spaces — and the splice context must still
+    feed native frame assembly to identical bytes."""
+
+    @staticmethod
+    def _values():
+        import json as _json
+
+        vals = [_json.dumps({"text": t, "id": i}).encode()
+                for i, t in enumerate(TEXTS)]
+        vals[3] = b"not json at all"
+        vals[11] = _json.dumps({"text": 42}).encode()       # non-string
+        vals[17] = b'{"other": "x"}'                        # key missing
+        vals[23] = b'{"te\\u0078t": "escaped key"}'         # -> slow path
+        return vals
+
+    @staticmethod
+    def _needs_json_shards():
+        feat = _feat(4)
+        nat = feat._native_featurizer()
+        if nat is None or not nat.supports_json():
+            pytest.skip("native featurizer unavailable")
+        if not nat.supports_json_shards():
+            pytest.skip("library predates the JSON shard entry point")
+        return feat, nat
+
+    def _serial_vs_sharded(self, num_features=10000):
+        feat, _ = self._needs_json_shards()
+        serial = _feat(1, num_features=num_features)
+        sharded = _feat(4, num_features=num_features)
+        vals = self._values()
+        out_s = serial.encode_json(vals, "text", batch_size=len(vals),
+                                   keep_splice_ctx=True)
+        ctx_s = serial.pop_json_splice_ctx()
+        out_p = sharded.encode_json(vals, "text", batch_size=len(vals),
+                                    keep_splice_ctx=True)
+        ctx_p = sharded.pop_json_splice_ctx()
+        assert out_s is not None and out_p is not None
+        _assert_batches_equal(out_s[0], out_p[0])
+        for i in (1, 2, 3):
+            np.testing.assert_array_equal(out_s[i], out_p[i])
+        return vals, out_s, ctx_s, out_p, ctx_p
+
+    def test_parity_with_serial(self):
+        self._serial_vs_sharded()
+
+    def test_parity_wide_feature_space_int32(self):
+        self._serial_vs_sharded(num_features=70000)
+
+    def test_splice_ctx_feeds_frame_assembly_identically(self):
+        if not native_mod.frames_available():
+            pytest.skip("frame assembly unavailable")
+        vals, out_s, ctx_s, out_p, ctx_p = self._serial_vs_sharded()
+        assert ctx_s is not None and ctx_p is not None
+        _, status, ss, sl = out_s[1], out_s[1], out_s[2], out_s[3]
+        labels = np.where(out_s[1] > 0, 1, -1).astype(np.int32)
+        confs = np.linspace(0.0, 1.0, len(vals)).astype(np.float64)
+        table = [b'"benign"', b'"scam"']
+        blob_s, ends_s = native_mod.build_frames(ctx_s, ss, sl, labels,
+                                                 confs, table)
+        blob_p, ends_p = native_mod.build_frames(ctx_p, out_p[2], out_p[3],
+                                                 labels, confs, table)
+        assert blob_s == blob_p
+        np.testing.assert_array_equal(ends_s, ends_p)
+
+    def test_engine_hot_path_uses_shards_byte_identically(self):
+        """Through the pipeline: predict_json_async over a sharded
+        featurizer scores identically to the serial one."""
+        self._needs_json_shards()
+        from fraud_detection_tpu.models.pipeline import ServingPipeline
+        from fraud_detection_tpu.models.linear import LogisticRegression
+
+        rng = np.random.default_rng(5)
+        model = LogisticRegression.from_arrays(
+            rng.normal(size=1000).astype(np.float32) * 0.1, 0.0)
+        serial = ServingPipeline(_feat(1, num_features=1000), model,
+                                 batch_size=128)
+        sharded = ServingPipeline(_feat(4, num_features=1000), model,
+                                  batch_size=128)
+        vals = self._values()
+        a = serial.predict_json_async(vals)
+        b = sharded.predict_json_async(vals)
+        assert a is not None and b is not None
+        ra, rb = a[0].resolve(), b[0].resolve()
+        valid = np.flatnonzero(a[1])
+        np.testing.assert_array_equal(ra.labels[valid], rb.labels[valid])
+        np.testing.assert_array_equal(ra.probabilities[valid],
+                                      rb.probabilities[valid])
+
+
 def test_python_chunked_parity():
     got = _feat(4, native=False).encode(TEXTS, batch_size=1024)
     want = _feat(1, native=False).encode(TEXTS, batch_size=1024)
