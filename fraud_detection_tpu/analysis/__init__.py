@@ -20,13 +20,27 @@ executed):
   batch dims that bypass the prewarmed padding ladder;
 * health-schema lint (FC301): health()/snapshot() key sets cross-checked
   against the contract-test ``*_SCHEMA`` dicts, so schema drift fails lint
-  before it fails a soak.
+  before it fails a soak;
+* distributed-protocol rules (FC501-FC503, model.py): the fleet rebalance
+  choreography declared as per-role state machines
+  (entrypoints.FLEET_PROTOCOLS) and AST-verified against the tree —
+  unclaimed protocol call sites, spec transitions the code no longer
+  implements, and fence/barrier call-site ordering drift.
 
 CLI: ``flightcheck`` / ``python -m fraud_detection_tpu.analysis`` (exit 0
 = clean tree); ``--sarif`` emits SARIF 2.1.0 for CI code scanning,
 ``--fix`` scaffolds suppression pragmas with a required-justification
-stub. Suppressions: ``# flightcheck: ignore[RULE] — reason`` on (or right
+stub, and file-local passes ride an incremental content-hash cache
+(``.flightcheck_cache/``, ``--verbose`` for hit/miss counts).
+Suppressions: ``# flightcheck: ignore[RULE] — reason`` on (or right
 above) the flagged line.
+
+``flightcheck model`` (analysis/checker.py) goes beyond linting: an
+explicit-state model checker composes the FLEET_PROTOCOLS role machines
+with an environment model (crashes, lease expiry racing renewal) and
+exhaustively verifies the fleet's zero-loss/zero-dup/fencing/barrier
+invariants over every bounded interleaving, emitting shortest
+counterexample traces (rule FC504 in SARIF) when one breaks.
 """
 
 from fraud_detection_tpu.analysis.core import (Finding, RULES,  # noqa: F401

@@ -12,6 +12,17 @@ catalog, the pragma syntax, the ``--fix`` workflow, and SARIF usage.
   required-justification TODO stub) over every finding; ``--dry-run``
   prints the planned edits without touching files. The exit code still
   reflects the findings — scaffolding is triage, not absolution.
+* File-local passes ride the incremental cache (``.flightcheck_cache/``,
+  analysis/cache.py) keyed on content hash; ``--no-cache`` disables it
+  and ``--verbose`` reports hit/miss counts.
+
+``flightcheck model`` runs the distributed-protocol model checker
+(analysis/checker.py) over the fleet rebalance choreography: exit 0 when
+every invariant holds over all bounded interleavings, 1 with a
+counterexample trace (also written to ``--trace-file``, and to ``--sarif``
+as an FC504 result), 2 when the state/wall budget was exhausted before
+the frontier emptied. ``--mutate`` seeds a protocol mutation that MUST
+produce a counterexample — the checker checking itself.
 """
 
 from __future__ import annotations
@@ -25,7 +36,123 @@ from fraud_detection_tpu.analysis.core import (RULES, resolve_roots,
                                                run_analysis)
 
 
+def model_main(argv=None) -> int:
+    from fraud_detection_tpu.analysis.checker import (MUTATIONS, CheckConfig,
+                                                      check)
+    from fraud_detection_tpu.analysis import traces
+
+    parser = argparse.ArgumentParser(
+        prog="flightcheck model",
+        description="explicit-state model checking of the fleet rebalance "
+                    "choreography (docs/static_analysis.md)")
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--partitions", type=int, default=2)
+    parser.add_argument("--keys", type=int, default=2,
+                        help="messages per partition")
+    parser.add_argument("--max-crashes", type=int, default=1)
+    parser.add_argument("--max-lapses", type=int, default=1,
+                        help="live-worker lease lapses (the zombie-stall "
+                             "adversary budget)")
+    parser.add_argument("--mutate", default=None,
+                        help="comma-separated protocol mutations to seed "
+                             f"(known: {', '.join(MUTATIONS)})")
+    parser.add_argument("--max-states", type=int, default=400_000)
+    parser.add_argument("--max-seconds", type=float, default=120.0)
+    parser.add_argument("--no-symmetry", action="store_true",
+                        help="disable the worker-symmetry reduction")
+    parser.add_argument("--trace-file", default=None, metavar="PATH",
+                        help="write the full report (and any "
+                             "counterexample trace) to PATH")
+    parser.add_argument("--sarif", default=None, metavar="PATH",
+                        help="write any counterexample as a SARIF 2.1.0 "
+                             "FC504 result")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable result on stdout")
+    parser.add_argument("--list-mutations", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_mutations:
+        for m in MUTATIONS:
+            print(m)
+        return 0
+
+    mutations = frozenset(
+        m.strip() for m in (args.mutate or "").split(",") if m.strip())
+    try:
+        cfg = CheckConfig(
+            workers=args.workers, partitions=args.partitions,
+            keys_per_partition=args.keys, max_crashes=args.max_crashes,
+            max_lapses=args.max_lapses, mutations=mutations,
+            max_states=args.max_states, max_seconds=args.max_seconds,
+            symmetry=not args.no_symmetry)
+        cfg.validate()
+    except ValueError as e:
+        print(f"flightcheck model: {e}", file=sys.stderr)
+        return 2
+
+    result = check(cfg)
+    if result.violation is not None and cfg.symmetry:
+        # Re-search without the symmetry reduction so the trace's worker
+        # labels stay stable step to step (canonical relabeling can swap
+        # identities mid-trace); fall back to the symmetric trace if the
+        # plain search blows the budget first.
+        from dataclasses import replace
+
+        plain = check(replace(cfg, symmetry=False))
+        if plain.violation is not None:
+            plain.coverage = result.coverage
+            result = plain
+
+    report = traces.render(result, cfg)
+    if args.json:
+        payload = {
+            "ok": result.ok,
+            "states": result.states,
+            "transitions": result.transitions,
+            "depth": result.depth,
+            "elapsed_s": round(result.elapsed, 3),
+            "budget_exhausted": result.budget_exhausted,
+            "budget_reason": result.budget_reason,
+            "coverage": result.coverage,
+            "mutations": sorted(cfg.mutations),
+            "invariant_violated": (result.violation.invariant
+                                   if result.violation else None),
+            "trace": ([{"actor": s.actor, "action": s.action,
+                        "detail": s.detail}
+                       for s in result.violation.trace]
+                      if result.violation else []),
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        print(report)
+    if args.trace_file:
+        with open(args.trace_file, "w", encoding="utf-8") as f:
+            f.write(report + "\n")
+    if args.sarif:
+        from fraud_detection_tpu.analysis import sarif
+
+        findings = ([traces.to_finding(result.violation)]
+                    if result.violation else [])
+        doc = sarif.build(findings, suppressed=0, n_files=0)
+        problems = sarif.validate(doc)
+        if problems:  # pragma: no cover - emitter/validator drift guard
+            print("SARIF self-validation failed:\n  "
+                  + "\n  ".join(problems), file=sys.stderr)
+            return 2
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+    if result.violation is not None:
+        return 1
+    if result.budget_exhausted:
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "model":
+        return model_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="flightcheck",
         description="flightcheck: first-party static analysis "
@@ -54,6 +181,14 @@ def main(argv=None) -> int:
                              "nothing")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the incremental per-file analysis "
+                             "cache (.flightcheck_cache/)")
+    parser.add_argument("--cache-dir", default=None, metavar="PATH",
+                        help="cache directory (default: "
+                             ".flightcheck_cache/ at the repo root)")
+    parser.add_argument("--verbose", action="store_true",
+                        help="report cache hit/miss counts")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -78,8 +213,23 @@ def main(argv=None) -> int:
         print(f"--tests {tests_dir!r} is not a directory", file=sys.stderr)
         return 2
 
+    cache_dir = args.cache_dir
+    if cache_dir is None and not args.no_cache:
+        from fraud_detection_tpu.analysis.cache import default_cache_dir
+
+        package_root, _ = resolve_roots(args.root, tests_dir)
+        cache_dir = default_cache_dir(package_root)
+    if args.no_cache:
+        cache_dir = None
+
+    cache_stats: dict = {}
     findings, suppressed, n_files = run_analysis(
-        package_root=args.root, tests_dir=tests_dir, rules=rules)
+        package_root=args.root, tests_dir=tests_dir, rules=rules,
+        cache_dir=cache_dir, stats=cache_stats)
+    if args.verbose and cache_stats:
+        print(f"flightcheck: cache {cache_stats.get('hits', 0)} hit(s), "
+              f"{cache_stats.get('misses', 0)} miss(es) "
+              f"({cache_dir})", file=sys.stderr)
 
     if args.sarif:
         from fraud_detection_tpu.analysis import sarif

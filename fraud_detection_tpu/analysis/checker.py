@@ -1,0 +1,799 @@
+"""`flightcheck model` — explicit-state model checking of the fleet choreography.
+
+The chaos suite samples a handful of interleavings per seed; this module
+checks ALL of them, bounded. It composes the role machines declared in
+:data:`~fraud_detection_tpu.analysis.entrypoints.FLEET_PROTOCOLS` —
+Coordinator (lease deals, REVOKE BARRIER, expiry, fencing), Worker
+(poll/heartbeat/drain/commit/ack/rebuild, crash transitions from the
+``WorkerDeathPlan`` fault model), AssignedConsumer (committed-offset
+resume, fence-at-commit), Bus (publish folded into the sync step) — with
+an environment model (worker crash on the poll path, lease ttl elapsing
+and racing renewal), and explores every bounded interleaving breadth-first
+in the TLA+/SPIN explicit-state tradition, checking on every edge the
+invariants the chaos runs only sample:
+
+* ``no_duplicate`` — no input row's delivery is ever covered by two
+  successful offset commits;
+* ``no_loss`` — every quiescent run delivered (and committed) every row;
+* ``no_zombie_commit`` — a commit never advances a partition its worker no
+  longer owns (the fence's whole job);
+* ``revoke_barrier`` — a pair's new owner never polls it while a live,
+  unexpired previous owner still holds uncommitted read-ahead on it;
+* ``no_self_expiry`` — a syncing member never falls to its own expiry scan.
+
+**Fidelity notes** (docs/static_analysis.md "model checking the fleet").
+The model follows the code's fault model: crashes fire on the poll path
+(``WorkerDeathPlan`` kills before a batch dispatches), so the engine's
+produce -> flush -> check -> commit sequence — whose intra-batch shape
+FC401-FC403 already pin statically — collapses to one atomic
+deliver+commit step with the fence consulted first, exactly the
+``InProcessAssignedConsumer._commit_locked`` shape FC503 pins. A fenced
+commit matches the engine's real behavior: the incarnation carries on
+(``rebalanced_commits``), its outputs stand as documented at-least-once
+duplicates, and only *committed* deliveries count toward the
+duplicate/loss accounting — which is precisely the key-set invariant
+tests/test_fleet.py pins. Lease expiry is untimed: ``lapse`` marks any
+member's ttl as elapsed (the zombie-stall adversary), bounded by
+``max_lapses`` for live workers and always eventually enabled for crashed
+ones (ttl elapsing is inevitable, not an adversary move).
+
+**Reductions.** Two sound ones: (1) *macro-step fusion* (a partial-order
+reduction): protocol sequences that are invisible to every other role —
+coordinator renew+scan+re-deal inside one ``sync``, ack+release+rebuild,
+deliver+fence+commit — execute as single atomic actions, so commuting
+intermediate states are never materialized; (2) *worker symmetry*: workers
+start identical and the assignor depends only on join order, so states are
+canonicalized under worker relabeling (min over all permutations) before
+dedup. Budgets (``max_states``, ``max_seconds``) bound the search; BFS
+order makes every counterexample a SHORTEST trace.
+
+Seeded **mutations** re-introduce the bugs the choreography exists to
+prevent; each must produce a counterexample (tests/test_model_checker.py),
+which is the checker's own regression guard:
+
+* ``drop_fence`` — commit never consults the fence (zombie commits land);
+* ``skip_revoke_barrier`` — re-deals grant moved pairs immediately;
+* ``ack_before_drain`` — the worker releases the barrier before draining;
+* ``expire_before_renew`` — the expiry scan runs before the caller's
+  renewal (a syncing member can expire itself);
+* ``forget_barrier_holds`` — re-deals rebuild holds from the target map
+  alone, dropping a still-draining owner's hold (the TRUE POSITIVE this
+  checker found in ``FleetCoordinator._rebalance_locked``; fixed in-tree,
+  kept here as the regression mutant).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from itertools import permutations
+from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+MUTATIONS: Tuple[str, ...] = (
+    "drop_fence", "skip_revoke_barrier", "ack_before_drain",
+    "expire_before_renew", "forget_barrier_holds",
+)
+
+INVARIANTS: Tuple[str, ...] = (
+    "no_duplicate", "no_loss", "no_zombie_commit", "revoke_barrier",
+    "no_self_expiry",
+)
+
+#: checker action -> the FLEET_PROTOCOLS transitions (``Role.name``) each
+#: macro-step implements. tests pin that the union covers EVERY spec
+#: transition, so the spec, this model, and (through FC501/FC502) the code
+#: are one three-way-verified artifact.
+ACTION_IMPLEMENTS: Dict[str, Tuple[str, ...]] = {
+    "join": ("Worker.join", "Coordinator.join", "AssignedConsumer.resume"),
+    "sync": ("Worker.sync", "Coordinator.sync", "Bus.publish"),
+    "poll": ("Worker.poll", "AssignedConsumer.poll"),
+    "commit": ("Worker.commit", "AssignedConsumer.commit",
+               "Coordinator.fence"),
+    "ack": ("Worker.ack", "Coordinator.ack", "AssignedConsumer.close",
+            "AssignedConsumer.resume"),
+    "leave": ("Worker.leave", "Coordinator.leave", "AssignedConsumer.close",
+              "Bus.retract"),
+    "crash": ("Worker.crash",),
+    "lapse": ("Environment.lapse",),
+    "tick": ("Coordinator.tick", "Bus.aggregate"),
+}
+
+
+@dataclass(frozen=True)
+class CheckConfig:
+    workers: int = 2
+    partitions: int = 2
+    keys_per_partition: int = 2
+    max_crashes: int = 1
+    max_lapses: int = 1
+    mutations: FrozenSet[str] = frozenset()
+    max_states: int = 400_000
+    max_seconds: float = 120.0
+    symmetry: bool = True
+
+    def validate(self) -> None:
+        if self.workers < 1 or self.workers > 4:
+            raise ValueError(f"workers must be 1..4, got {self.workers}")
+        if self.partitions < 1 or self.partitions > 4:
+            raise ValueError(
+                f"partitions must be 1..4, got {self.partitions}")
+        if self.keys_per_partition < 1 or self.keys_per_partition > 3:
+            raise ValueError(
+                f"keys_per_partition must be 1..3, got "
+                f"{self.keys_per_partition}")
+        if self.max_crashes >= self.workers:
+            raise ValueError(
+                "max_crashes must leave at least one surviving worker "
+                f"(got {self.max_crashes} with {self.workers} workers): "
+                "the zero-loss guarantee is conditioned on a survivor")
+        unknown = set(self.mutations) - set(MUTATIONS)
+        if unknown:
+            raise ValueError(f"unknown mutations {sorted(unknown)} "
+                             f"(known: {list(MUTATIONS)})")
+
+
+@dataclass(frozen=True)
+class Step:
+    """One trace step: the action label plus its visible effect."""
+
+    actor: str
+    action: str
+    detail: str
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    detail: str
+    trace: Tuple[Step, ...]
+
+
+@dataclass
+class CheckResult:
+    ok: bool
+    violation: Optional[Violation]
+    states: int
+    transitions: int
+    depth: int
+    elapsed: float
+    budget_exhausted: bool = False
+    budget_reason: str = ""
+    coverage: Dict[str, int] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# state encoding
+#
+# state = (members, stale, target, pending, committed, workers,
+#          crashes, lapses)
+#   members:  tuple[int]      membership in JOIN ORDER (the assignor's key)
+#   stale:    tuple[int]      members whose lease ttl has elapsed, sorted
+#   target:   tuple[int]*P    authoritative owner per partition (-1 none)
+#   pending:  tuple[int]*P    live holder draining the pair (-1 none)
+#   committed:tuple[int]*P    group-durable committed offset
+#   workers:  tuple[W] of (wstate, lease, pos, base, zombie)
+#             wstate: i/r/d/c/l (init running draining crashed left)
+#             lease:  tuple[int] partitions of the CURRENT incarnation's
+#                     consumer (the worker's possibly-stale local view)
+#             pos/base: tuple[int]*P, -1 outside the lease; read-ahead on
+#                     p is the window [base[p], pos[p])
+#             zombie: True from lease expiry until the next rebuild —
+#                     its stale read-ahead is written off (expiry IS the
+#                     barrier for an expired owner) and its re-deliveries
+#                     are the DOCUMENTED at-least-once duplicates, exempt
+#                     from the committed-coverage dup accounting
+#   crashes, lapses: environment budget spent
+#
+# Delivery accounting rides ``committed`` alone: a success commit covers
+# exactly the rows it newly advances past (each row exactly once, by
+# monotonicity), so no_loss is "quiescent with committed < K" and
+# no_duplicate is "a live, unexpired worker success-commits a window
+# overlapping rows already covered" — the committed key-set accounting
+# tests/test_fleet.py pins, with the zombie-stall at-least-once caveat
+# exempted explicitly instead of hidden.
+# ---------------------------------------------------------------------------
+
+_INIT, _RUN, _DRAIN, _CRASH, _LEFT = "i", "r", "d", "c", "l"
+
+
+def _initial_state(cfg: CheckConfig):
+    P = cfg.partitions
+    worker = (_INIT, (), (-1,) * P, (-1,) * P, False)
+    return (
+        (),                       # members
+        (),                       # stale
+        (-1,) * P,                # target
+        (-1,) * P,                # pending
+        (0,) * P,                 # committed
+        tuple(worker for _ in range(cfg.workers)),
+        0, 0,
+    )
+
+
+def _relabel(state, perm):
+    """Apply worker permutation ``perm`` (old id -> new id). Join order is
+    positional, so the members tuple keeps its order with ids mapped —
+    relabeling is an automorphism of the deterministic assignor."""
+    members, stale, target, pending, committed, workers, cr, la = state
+    inv = [0] * len(perm)
+    for old, new in enumerate(perm):
+        inv[new] = old
+    return (
+        tuple(perm[w] for w in members),
+        tuple(sorted(perm[w] for w in stale)),
+        tuple(perm[w] if w >= 0 else -1 for w in target),
+        tuple(perm[w] if w >= 0 else -1 for w in pending),
+        committed,
+        tuple(workers[inv[new]] for new in range(len(workers))),
+        cr, la,
+    )
+
+
+def _canonical(state, cfg: CheckConfig):
+    if not cfg.symmetry or cfg.workers == 1:
+        return state
+    return min(_relabel(state, perm)
+               for perm in permutations(range(cfg.workers)))
+
+
+# ---------------------------------------------------------------------------
+# coordinator internals (pure functions over the state fields)
+# ---------------------------------------------------------------------------
+
+def _rebalance(members, old_target, old_pending, P, mutations):
+    """The balanced-sticky re-deal, mirroring
+    ``FleetCoordinator._rebalance_locked`` (with the barrier-hold
+    persistence fix; ``forget_barrier_holds`` restores the pre-fix shape,
+    ``skip_revoke_barrier`` drops the barrier entirely)."""
+    if not members:
+        return (-1,) * P, (-1,) * P
+    base_share, extra = divmod(P, len(members))
+    share = {w: base_share + (1 if i < extra else 0)
+             for i, w in enumerate(members)}
+    kept = {w: 0 for w in members}
+    target = [-1] * P
+    pool = []
+    for p in range(P):                    # partition order: deterministic
+        w = old_target[p]
+        if w in share and kept[w] < share[w]:
+            target[p] = w
+            kept[w] += 1
+        else:
+            pool.append(p)
+    for w in members:                     # join order: deterministic
+        take = share[w] - kept[w]
+        while take > 0 and pool:
+            target[pool.pop(0)] = w
+            take -= 1
+    pending = [-1] * P
+    if "skip_revoke_barrier" not in mutations:
+        for p in range(P):
+            w = target[p]
+            if w < 0:
+                continue
+            if "forget_barrier_holds" in mutations:
+                holder = old_target[p]
+            else:
+                holder = old_pending[p] if old_pending[p] >= 0 \
+                    else old_target[p]
+            if holder not in (-1, w) and holder in members:
+                pending[p] = holder
+    return tuple(target), tuple(pending)
+
+
+def _release_holds(pending, wid):
+    return tuple(-1 if h == wid else h for h in pending)
+
+
+def _granted(target, pending, wid) -> Tuple[Tuple[int, ...], bool]:
+    """(granted partitions, any-withheld) for ``wid`` — the Lease shape."""
+    granted, withheld = [], False
+    for p, owner in enumerate(target):
+        if owner != wid:
+            continue
+        if pending[p] in (-1, wid):
+            granted.append(p)
+        else:
+            withheld = True
+    return tuple(granted), withheld
+
+
+def _coord_sync(members, stale, target, pending, wid, mutations):
+    """join/sync(wid): renew-then-scan (or the mutant's scan-then-renew),
+    re-deal when membership changed. Returns the updated fields plus the
+    id the scan expired-of-itself (the no_self_expiry witness) and the
+    list of expired members."""
+    members = list(members)
+    stale_set = set(stale)
+    self_expired = False
+    changed = False
+
+    def scan():
+        nonlocal members, pending, changed
+        expired = [m for m in members if m in stale_set]
+        for e in expired:
+            members.remove(e)
+            stale_set.discard(e)
+            pending = _release_holds(pending, e)
+        if expired:
+            changed = True
+        return expired
+
+    if "expire_before_renew" in mutations:
+        expired = scan()
+        self_expired = wid in expired
+        stale_set.discard(wid)
+        if wid not in members:
+            members.append(wid)
+            changed = True
+    else:
+        stale_set.discard(wid)            # renew the caller FIRST
+        if wid not in members:
+            members.append(wid)
+            changed = True
+        expired = scan()
+
+    if changed:
+        target, pending = _rebalance(tuple(members), target, pending,
+                                     len(target), mutations)
+    return (tuple(members), tuple(sorted(stale_set)), target, pending,
+            expired, self_expired)
+
+
+def _mark_zombies(workers, expired):
+    if not expired:
+        return workers
+    out = list(workers)
+    for e in expired:
+        wstate, lease, pos, base, _ = out[e]
+        out[e] = (wstate, lease, pos, base, True)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+class FleetModel:
+    """Successor generator + invariant oracle for one configuration."""
+
+    def __init__(self, cfg: CheckConfig):
+        cfg.validate()
+        self.cfg = cfg
+        self.mut = cfg.mutations
+
+    def initial(self):
+        return _initial_state(self.cfg)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _read_ahead(self, worker) -> List[Tuple[int, int, int]]:
+        """[(p, base, pos)] windows with uncommitted read-ahead."""
+        _, lease, pos, base, _ = worker
+        return [(p, base[p], pos[p]) for p in lease if pos[p] > base[p]]
+
+    def _rebuild_worker(self, committed, granted):
+        P = self.cfg.partitions
+        pos = tuple(committed[p] if p in granted else -1 for p in range(P))
+        return (_RUN, tuple(sorted(granted)), pos, pos, False)
+
+    # -- successors --------------------------------------------------------
+
+    def successors(self, state) -> Iterator[Tuple[Step, object,
+                                                  Optional[Violation]]]:
+        """Yield (step, next_state, violation). A violation ends the
+        search; its step is included in the trace."""
+        (members, stale, target, pending, committed, workers,
+         crashes, lapses) = state
+        cfg, P, K = self.cfg, self.cfg.partitions, self.cfg.keys_per_partition
+
+        for wid, worker in enumerate(workers):
+            wstate, lease, pos, base, zombie = worker
+            actor = f"w{wid}"
+
+            # ---- join: init -> running ---------------------------------
+            if wstate == _INIT:
+                m2, s2, t2, p2, expired, self_exp = _coord_sync(
+                    members, stale, target, pending, wid, self.mut)
+                w2 = _mark_zombies(workers, expired)
+                granted, _ = _granted(t2, p2, wid)
+                w2 = list(w2)
+                w2[wid] = self._rebuild_worker(committed, granted)
+                nxt = (m2, s2, t2, p2, committed, tuple(w2),
+                       crashes, lapses)
+                yield (Step(actor, "join",
+                            f"joins; lease {{{_pp(granted)}}} (consumer "
+                            f"resumes from committed offsets)"),
+                       nxt, None)
+                continue
+
+            if wstate in (_CRASH, _LEFT):
+                # A hard-crashed member's ttl elapsing is inevitable (the
+                # fairness assumption, not an adversary move): always
+                # enabled, outside the lapse budget.
+                if wstate == _CRASH and wid in members and wid not in stale:
+                    s2 = tuple(sorted(set(stale) | {wid}))
+                    nxt = (members, s2, target, pending, committed,
+                           workers, crashes, lapses)
+                    yield (Step(actor, "lapse",
+                                f"lease ttl elapses for dead {actor}"),
+                           nxt, None)
+                continue
+
+            # ---- sync: heartbeat + lease refresh (running only; a
+            # draining engine no longer polls) -----------------------------
+            if wstate == _RUN:
+                m2, s2, t2, p2, expired, self_exp = _coord_sync(
+                    members, stale, target, pending, wid, self.mut)
+                w2 = list(_mark_zombies(workers, expired))
+                granted, withheld = _granted(t2, p2, wid)
+                detail = f"heartbeat; lease {{{_pp(granted)}}}"
+                violation = None
+                if self_exp:
+                    violation = Violation(
+                        "no_self_expiry",
+                        f"{actor}'s own sync expired it: the expiry scan "
+                        f"ran before the caller's renewal, so a live, "
+                        f"syncing member lost its lease to itself",
+                        ())
+                if set(granted) != set(lease) or withheld:
+                    # revoke detected: stop the engine, drain
+                    if "ack_before_drain" in self.mut:
+                        p2 = _release_holds(p2, wid)
+                        detail += ("; lease changed -> ACKS THE BARRIER "
+                                   "EARLY, then drains")
+                    else:
+                        detail += ("; lease changed -> stops engine, "
+                                   "drains in-flight")
+                    w2[wid] = (_DRAIN, lease, pos, base, zombie)
+                else:
+                    w2[wid] = (_RUN, lease, pos, base, zombie)
+                nxt = (m2, s2, t2, p2, committed, tuple(w2),
+                       crashes, lapses)
+                yield Step(actor, "sync", detail), nxt, violation
+
+                # ---- poll: one row from one granted partition ----------
+                for p in lease:
+                    if pos[p] >= K:
+                        continue
+                    violation = None
+                    if target[p] == wid and pending[p] == -1:
+                        # wid is the pair's authoritative owner: the
+                        # barrier says no live unexpired previous owner
+                        # may still hold uncommitted read-ahead on it.
+                        for hid, other in enumerate(workers):
+                            if hid == wid or hid not in members:
+                                continue
+                            ostate, olease, opos, obase, ozombie = other
+                            if ozombie or p not in olease:
+                                continue
+                            if opos[p] > obase[p]:
+                                violation = Violation(
+                                    "revoke_barrier",
+                                    f"{actor} polls p{p} (granted by the "
+                                    f"coordinator) while live member "
+                                    f"w{hid} still holds uncommitted "
+                                    f"read-ahead p{p}:[{obase[p]},"
+                                    f"{opos[p]}) and never commit-acked "
+                                    f"— the REVOKE BARRIER",
+                                    ())
+                                break
+                    w2 = list(workers)
+                    pos2 = list(pos)
+                    pos2[p] += 1
+                    w2[wid] = (_RUN, lease, tuple(pos2), base, zombie)
+                    nxt = (members, stale, target, pending, committed,
+                           tuple(w2), crashes, lapses)
+                    yield (Step(actor, "poll",
+                                f"polls p{p} offset {pos[p]}"),
+                           nxt, violation)
+
+            # ---- commit: deliver + fence + advance (atomic; the
+            # produce->flush->check->commit shape FC401 pins) --------------
+            if wstate in (_RUN, _DRAIN):
+                windows = self._read_ahead(worker)
+                if windows:
+                    # committable = granted-or-held: the pair's barrier
+                    # hold is mine, or I'm the target with NO peer hold
+                    # outstanding (a withheld target pair is the HOLDER's
+                    # to commit until it acks — fence fix, see
+                    # FleetCoordinator.fence_lost).
+                    def committable(p, w=wid):
+                        return pending[p] == w or (target[p] == w
+                                                  and pending[p] == -1)
+
+                    fenced = [p for p, _, _ in windows if not committable(p)]
+                    if "drop_fence" in self.mut:
+                        fenced = []
+                    base2 = list(base)
+                    for p, b, q in windows:
+                        base2[p] = q
+                    w2 = list(workers)
+                    w2[wid] = (wstate, lease, pos, tuple(base2), zombie)
+                    span = ", ".join(f"p{p}:[{b},{q})"
+                                     for p, b, q in windows)
+                    if fenced:
+                        # CommitFailedError: nothing advances; the engine
+                        # carries on (rebalanced_commits) and the rows
+                        # stand as documented at-least-once duplicates.
+                        nxt = (members, stale, target, pending, committed,
+                               tuple(w2), crashes, lapses)
+                        yield (Step(actor, "commit",
+                                    f"commit of {span} FENCED (lease "
+                                    f"revoked for "
+                                    f"{_pp(fenced, prefix='p')}); offsets "
+                                    f"stay; outputs stand as at-least-"
+                                    f"once duplicates"),
+                               nxt, None)
+                    else:
+                        violation = None
+                        rogue = [p for p, _, _ in windows
+                                 if not committable(p)]
+                        if rogue:
+                            violation = Violation(
+                                "no_zombie_commit",
+                                f"{actor} committed "
+                                f"{_pp(rogue, prefix='p')} it no longer "
+                                f"owns (lease expired/revoked, fence "
+                                f"absent) — offsets advanced for a "
+                                f"partition someone else is "
+                                f"authoritative for",
+                                ())
+                        # Committed-coverage accounting: each row is
+                        # covered by exactly the commit that advances past
+                        # it. A live, UNEXPIRED worker success-committing
+                        # a window overlapping already-covered rows means
+                        # the choreography let two owners both deliver and
+                        # both durably commit — the zero-dup breach. A
+                        # zombie's re-coverage (stall -> expiry -> pair
+                        # re-granted on rejoin) is the DOCUMENTED
+                        # at-least-once duplicate and exempt.
+                        committed2 = list(committed)
+                        for p, b, q in windows:
+                            if b < committed2[p] and not zombie \
+                                    and violation is None:
+                                violation = Violation(
+                                    "no_duplicate",
+                                    f"rows p{p}:[{b},"
+                                    f"{min(q, committed2[p])}) were "
+                                    f"already covered by a successful "
+                                    f"commit, and live unexpired {actor} "
+                                    f"delivered + committed them AGAIN — "
+                                    f"two owners durably committed the "
+                                    f"same rows (zero-dup broken)",
+                                    ())
+                            committed2[p] = max(committed2[p], q)
+                        nxt = (members, stale, target, pending,
+                               tuple(committed2),
+                               tuple(w2), crashes, lapses)
+                        yield (Step(actor, "commit",
+                                    f"delivers + commits {span}"),
+                               nxt, violation)
+
+            # ---- ack: drain complete -> release barrier, rebuild -------
+            if wstate == _DRAIN and not self._read_ahead(worker):
+                p2 = _release_holds(pending, wid)
+                s2 = tuple(x for x in stale if x != wid)   # ack renews
+                granted, _ = _granted(target, p2, wid)
+                w2 = list(workers)
+                w2[wid] = self._rebuild_worker(committed, granted)
+                nxt = (members, s2, target, p2, committed,
+                       tuple(w2), crashes, lapses)
+                yield (Step(actor, "ack",
+                            f"drained + committed: acks the barrier, "
+                            f"rebuilds on lease {{{_pp(granted)}}}"),
+                       nxt, None)
+
+            # ---- leave: drain-run idle exit ----------------------------
+            if wstate == _RUN \
+                    and all(pos[p] >= K and base[p] == pos[p]
+                            for p in lease) \
+                    and all(c >= K for c in committed):
+                m2 = tuple(m for m in members if m != wid)
+                s2 = tuple(x for x in stale if x != wid)
+                t2, p2 = target, _release_holds(pending, wid)
+                if wid in members:
+                    t2, p2 = _rebalance(m2, t2, p2, P, self.mut)
+                w2 = list(workers)
+                w2[wid] = (_LEFT, (), (-1,) * P, (-1,) * P, False)
+                nxt = (m2, s2, t2, p2, committed, tuple(w2),
+                       crashes, lapses)
+                yield (Step(actor, "leave",
+                            "input idle and group lag 0: leaves "
+                            "gracefully (partitions reassign immediately)"),
+                       nxt, None)
+
+            # ---- idle incarnation, group lag remains: ack + rebuild ----
+            # (FleetWorker._run's loop: engine.run exits idle, the lag
+            # probe says the fleet still owes committed work — e.g. this
+            # worker's own fenced-away rows, or a dead peer's partitions —
+            # so it rebuilds a FRESH consumer resuming from the committed
+            # offsets instead of leaving. The at-least-once recovery.)
+            if wstate == _RUN \
+                    and all(pos[p] >= K and base[p] == pos[p]
+                            for p in lease) \
+                    and any(c < K for c in committed):
+                p2 = _release_holds(pending, wid)
+                s2 = tuple(x for x in stale if x != wid)   # ack renews
+                granted, _ = _granted(target, p2, wid)
+                if set(granted) != set(lease) \
+                        or any(committed[p] < pos[p] for p in granted):
+                    w2 = list(workers)
+                    w2[wid] = self._rebuild_worker(committed, granted)
+                    nxt = (members, s2, target, p2, committed,
+                           tuple(w2), crashes, lapses)
+                    yield (Step(actor, "ack",
+                                f"incarnation idle but group lag remains: "
+                                f"acks + rebuilds a fresh consumer on "
+                                f"lease {{{_pp(granted)}}} from the "
+                                f"committed offsets"),
+                           nxt, None)
+
+            # ---- crash: the WorkerDeathPlan, on the poll path ----------
+            if wstate in (_RUN, _DRAIN) and crashes < cfg.max_crashes:
+                w2 = list(workers)
+                w2[wid] = (_CRASH, lease, pos, base, zombie)
+                nxt = (members, stale, target, pending, committed,
+                       tuple(w2), crashes + 1, lapses)
+                yield (Step(actor, "crash",
+                            "KILLED (crash mode): stops heartbeating; "
+                            "read-ahead dies with it; lease must expire"),
+                       nxt, None)
+                # graceful death: the plan releases the lease NOW
+                m2 = tuple(m for m in members if m != wid)
+                s2 = tuple(x for x in stale if x != wid)
+                t2, p2 = target, _release_holds(pending, wid)
+                if wid in members:
+                    t2, p2 = _rebalance(m2, t2, p2, P, self.mut)
+                w2 = list(workers)
+                w2[wid] = (_CRASH, (), (-1,) * P, (-1,) * P, False)
+                nxt = (m2, s2, t2, p2, committed, tuple(w2),
+                       crashes + 1, lapses)
+                yield (Step(actor, "crash",
+                            "KILLED (graceful mode): leaves the group; "
+                            "partitions reassign immediately"),
+                       nxt, None)
+
+            # ---- lapse: a LIVE worker stalls past its ttl (the zombie
+            # adversary, budgeted; dead workers' lapse is handled above) --
+            if wid in members and wid not in stale \
+                    and lapses < cfg.max_lapses:
+                s2 = tuple(sorted(set(stale) | {wid}))
+                nxt = (members, s2, target, pending, committed,
+                       workers, crashes, lapses + 1)
+                yield (Step(actor, "lapse",
+                            f"lease ttl elapses for {actor} (stalled; "
+                            f"expiry races its renewal)"),
+                       nxt, None)
+
+        # ---- tick: the monitor thread's expiry scan ---------------------
+        expired = [m for m in members if m in stale]
+        if expired:
+            m2 = tuple(m for m in members if m not in expired)
+            p2 = pending
+            for e in expired:
+                p2 = _release_holds(p2, e)
+            t2, p2 = _rebalance(m2, target, p2, P, self.mut)
+            w2 = _mark_zombies(workers, expired)
+            nxt = (m2, (), t2, p2, committed, w2, crashes, lapses)
+            yield (Step("coord", "tick",
+                        f"monitor tick expires "
+                        f"{', '.join(f'w{e}' for e in expired)}: leases "
+                        f"released, partitions re-dealt (expiry IS the "
+                        f"dead owner's barrier)"),
+                   nxt, None)
+
+    # -- terminal loss check ----------------------------------------------
+
+    def quiescent_loss(self, state) -> Optional[Violation]:
+        """In a state with no enabled actions (or only self-loops), every
+        row must have been delivered under a successful commit."""
+        committed = state[4]
+        K = self.cfg.keys_per_partition
+        missing = {p: K - c for p, c in enumerate(committed) if c < K}
+        if not missing:
+            return None
+        spans = ", ".join(f"p{p}:[{K - n},{K})" for p, n in missing.items())
+        return Violation(
+            "no_loss",
+            f"the run went quiescent with {sum(missing.values())} row(s) "
+            f"never delivered under a successful commit ({spans}) — keys "
+            f"lost",
+            ())
+
+
+def _pp(items, prefix="p") -> str:
+    return ", ".join(f"{prefix}{p}" for p in items)
+
+
+# ---------------------------------------------------------------------------
+# the search
+# ---------------------------------------------------------------------------
+
+def check(cfg: CheckConfig) -> CheckResult:
+    """Exhaustive bounded BFS over the composed model. Counterexamples are
+    shortest traces by construction."""
+    model = FleetModel(cfg)
+    start = time.perf_counter()
+    init = _canonical(model.initial(), cfg)
+    visited = {init}
+    # parent pointers for trace reconstruction
+    parents: Dict[object, Tuple[object, Step]] = {}
+    frontier = [init]
+    states = 1
+    transitions = 0
+    depth = 0
+    coverage: Dict[str, int] = {}
+
+    def trace_to(state, last_step: Step) -> Tuple[Step, ...]:
+        steps = [last_step]
+        cur = state
+        while cur in parents:
+            cur, step = parents[cur]
+            steps.append(step)
+        return tuple(reversed(steps))
+
+    while frontier:
+        depth += 1
+        nxt_frontier = []
+        for state in frontier:
+            progressed = False
+            for step, succ, violation in model.successors(state):
+                transitions += 1
+                coverage[step.action] = coverage.get(step.action, 0) + 1
+                if violation is not None:
+                    return CheckResult(
+                        False,
+                        Violation(violation.invariant, violation.detail,
+                                  trace_to(state, step)),
+                        states, transitions, depth,
+                        time.perf_counter() - start, coverage=coverage)
+                canon = _canonical(succ, cfg)
+                if canon != state:
+                    progressed = True
+                if canon in visited:
+                    continue
+                visited.add(canon)
+                parents[canon] = (state, step)
+                nxt_frontier.append(canon)
+                states += 1
+                if states > cfg.max_states:
+                    return CheckResult(
+                        False, None, states, transitions, depth,
+                        time.perf_counter() - start, budget_exhausted=True,
+                        budget_reason=f"state budget exceeded "
+                                      f"({cfg.max_states})",
+                        coverage=coverage)
+            if not progressed:
+                # quiescent (terminal or self-loop-only): nothing will
+                # ever change from here — the loss check applies.
+                violation = model.quiescent_loss(state)
+                if violation is not None:
+                    last = Step("-", "quiescent",
+                                "no action can make further progress")
+                    return CheckResult(
+                        False,
+                        Violation(violation.invariant, violation.detail,
+                                  trace_to(state, last)),
+                        states, transitions, depth,
+                        time.perf_counter() - start, coverage=coverage)
+            if time.perf_counter() - start > cfg.max_seconds:
+                return CheckResult(
+                    False, None, states, transitions, depth,
+                    time.perf_counter() - start, budget_exhausted=True,
+                    budget_reason=f"wall budget exceeded "
+                                  f"({cfg.max_seconds}s)",
+                    coverage=coverage)
+        frontier = nxt_frontier
+
+    return CheckResult(True, None, states, transitions, depth,
+                       time.perf_counter() - start, coverage=coverage)
+
+
+def spec_transition_names() -> FrozenSet[str]:
+    """Every ``Role.name`` in FLEET_PROTOCOLS (the coverage test's ground
+    truth for ACTION_IMPLEMENTS)."""
+    from fraud_detection_tpu.analysis.entrypoints import FLEET_PROTOCOLS
+
+    return frozenset(q for role in FLEET_PROTOCOLS
+                     for q in role.qualnames())

@@ -61,6 +61,21 @@ RULES: Dict[str, tuple] = {
     "FC404": ("lock-leak",
               "bare lock.acquire() without a with/try-finally release — "
               "an exception between acquire and release leaks the lock"),
+    "FC501": ("transition-missing-from-spec",
+              "a fleet-protocol call site no FLEET_PROTOCOLS transition "
+              "claims — the model checker never explores this "
+              "interleaving"),
+    "FC502": ("spec-transition-unreachable",
+              "a FLEET_PROTOCOLS transition whose code anchor (or its "
+              "required implementation call) no longer exists — spec "
+              "drifted from the tree"),
+    "FC503": ("fence-barrier-drift",
+              "a fence/barrier call-site shape obligation violated "
+              "(ordering or presence) — the choreography's safety "
+              "argument no longer holds as written"),
+    "FC504": ("protocol-model-violation",
+              "the fleet protocol model checker found an invariant-"
+              "violating interleaving (counterexample trace attached)"),
 }
 
 
@@ -165,14 +180,19 @@ def resolve_roots(package_root: Optional[str] = None,
 
 def run_analysis(package_root: Optional[str] = None,
                  tests_dir: Optional[str] = None,
-                 rules: Optional[Set[str]] = None) -> tuple:
+                 rules: Optional[Set[str]] = None,
+                 cache_dir: Optional[str] = None,
+                 stats: Optional[dict] = None) -> tuple:
     """Run every analyzer over the package tree.
 
     Returns ``(findings, n_suppressed, n_files)`` with pragma suppression
     applied. ``rules`` restricts to a subset of rule ids (a finding whose
-    rule is excluded is neither reported nor counted)."""
+    rule is excluded is neither reported nor counted). ``cache_dir``
+    enables the incremental per-file cache (analysis/cache.py) for the
+    file-local passes; whole-program passes always run fresh. ``stats``,
+    when given, is filled in place with cache hit/miss counts."""
     from fraud_detection_tpu.analysis import (callgraph, concurrency, health,
-                                              jaxlint, protocol)
+                                              jaxlint, model, protocol)
     from fraud_detection_tpu.analysis import threads as threadmap
 
     package_root, tests_dir = resolve_roots(package_root, tests_dir)
@@ -180,13 +200,32 @@ def run_analysis(package_root: Optional[str] = None,
     files = load_package(package_root)
     by_rel = {f.relpath: f for f in files}
 
+    cache = None
+    if cache_dir is not None:
+        from fraud_detection_tpu.analysis.cache import AnalysisCache
+
+        cache = AnalysisCache(cache_dir)
+
+    # file-local passes (cacheable per file: findings depend only on the
+    # file's content + the registries folded into the cache salt)
     raw: List[Finding] = []
-    raw += concurrency.analyze(files)
+    for sf in files:
+        cached = cache.get(sf) if cache is not None else None
+        if cached is None:
+            cached = (concurrency.analyze([sf]) + protocol.analyze([sf])
+                      + jaxlint.analyze([sf]))
+            if cache is not None:
+                cache.put(sf, cached)
+        raw += cached
+
+    # whole-program passes (always fresh: they read the cross-file facts)
     raw += callgraph.analyze(files)
-    raw += protocol.analyze(files)
-    raw += jaxlint.analyze(files)
     raw += threadmap.analyze(files, package_root=package_root)
     raw += health.analyze(files, tests_dir=tests_dir)
+    raw += model.analyze(files)
+
+    if stats is not None and cache is not None:
+        stats.update(cache.stats())
 
     if rules is not None:
         raw = [f for f in raw if f.rule in rules]

@@ -331,6 +331,244 @@ COMMIT_PROTOCOLS: Tuple[CommitProtocolSpec, ...] = (
 
 
 # ---------------------------------------------------------------------------
+# Fleet rebalance choreography (the FC501-FC503 scope, analysis/model.py, and
+# the `flightcheck model` checker's vocabulary, analysis/checker.py): the
+# distributed protocol PR 8 built — coordinator lease deals, the REVOKE
+# BARRIER (revoke -> drain -> commit -> reassign), zombie commit fencing —
+# declared as per-role state machines. Every code-anchored transition is
+# AST-verified against the real tree (FC502), every protocol-vocabulary call
+# site in fleet code must be claimed by a transition (FC501), and the
+# fence/barrier call-site shapes that make the choreography safe are pinned
+# as ordering obligations (FC503) — so this spec, the model the checker
+# explores, and the implementation can never drift apart silently.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ProtocolTransition:
+    """One labeled transition of a role machine.
+
+    ``anchors`` are the code sites ("relpath::Class.method") that implement
+    the transition; each anchor must exist and contain every ``calls``
+    pattern (FC502). An empty ``anchors`` marks an environment transition
+    (lease ttl elapsing) with no code to verify. Call patterns are dotted
+    suffixes of the receiver chain as written at the call site:
+    ``"coordinator.sync"`` matches ``self.coordinator.sync(...)``,
+    ``"_expire_locked"`` matches ``self._expire_locked(...)``."""
+
+    name: str
+    source: str
+    target: str
+    anchors: Tuple[str, ...] = ()
+    calls: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class RoleSpec:
+    """One role's protocol machine (states + labeled transitions)."""
+
+    role: str
+    cls_key: Optional[str]          # "relpath::Class"; None = environment
+    states: Tuple[str, ...]
+    initial: str
+    transitions: Tuple[ProtocolTransition, ...]
+
+    def qualnames(self) -> Tuple[str, ...]:
+        return tuple(f"{self.role}.{t.name}" for t in self.transitions)
+
+
+def _t(name, source, target, anchors=(), calls=()):
+    return ProtocolTransition(name, source, target, tuple(anchors),
+                              tuple(calls))
+
+
+FLEET_PROTOCOLS: Tuple[RoleSpec, ...] = (
+    # The coordinator is a passive monitor object: its machine is the set of
+    # entry points workers/monitor drive, each verified against its method
+    # body (join folds renew -> expiry scan -> re-deal; the scan/deal
+    # helpers are the required calls).
+    RoleSpec("Coordinator", "fleet/coordinator.py::FleetCoordinator",
+             ("steady",), "steady", (
+        _t("join", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.join",),
+           ("_expire_locked", "_rebalance_locked", "_lease_locked")),
+        _t("sync", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.sync",),
+           ("join",)),
+        _t("ack", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.ack",),
+           ("_lease_locked",)),
+        _t("leave", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.leave",),
+           ("_rebalance_locked",)),
+        _t("fence", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.fence_lost",)),
+        # ...and the call site wiring the fence into every fleet consumer.
+        _t("fence", "steady", "steady",
+           ("fleet/fleet.py::Fleet.in_process",),
+           ("coordinator.fence_lost",)),
+        _t("tick", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.tick",),
+           ("_expire_locked", "_rebalance_locked")),
+        # ...and the monitor-thread (plus post-run aggregate) drive sites.
+        _t("tick", "steady", "steady",
+           ("fleet/fleet.py::Fleet._monitor_loop", "fleet/fleet.py::Fleet.run"),
+           ("coordinator.tick",)),
+    )),
+    # The worker half of revoke->drain->commit->reassign: one engine
+    # incarnation chain per lease, heartbeat-on-poll, crash transitions
+    # from the seeded WorkerDeathPlan.
+    RoleSpec("Worker", "fleet/worker.py::FleetWorker",
+             ("init", "running", "draining", "crashed", "left"), "init", (
+        _t("join", "init", "running",
+           ("fleet/worker.py::FleetWorker._run",),
+           ("coordinator.join",)),
+        _t("sync", "running", "running",
+           ("fleet/worker.py::FleetWorker._on_poll",),
+           ("coordinator.sync",)),
+        # lease changed (or pairs withheld): stop the engine, drain
+        _t("sync", "running", "draining",
+           ("fleet/worker.py::FleetWorker._on_poll",),
+           ("engine.stop",)),
+        _t("poll", "running", "running",
+           ("fleet/worker.py::_FleetConsumer.poll_batch",),
+           ("_on_poll", "inner.poll_batch")),
+        _t("commit", "running", "running",
+           ("fleet/worker.py::_FleetConsumer.commit_offsets",),
+           ("inner.commit_offsets",)),
+        # the engine's shutdown path drains + commits in-flight batches
+        _t("commit", "draining", "draining",
+           ("fleet/worker.py::FleetWorker._run",),
+           ("engine.run",)),
+        _t("ack", "draining", "running",
+           ("fleet/worker.py::FleetWorker._run",),
+           ("coordinator.ack",)),
+        _t("leave", "running", "left",
+           ("fleet/worker.py::FleetWorker._run",),
+           ("coordinator.leave", "coordinator.committed_lag")),
+        _t("crash", "running", "crashed",
+           ("fleet/worker.py::FleetWorker._on_poll",),
+           ("death_plan.tick",)),
+        _t("crash", "draining", "crashed",
+           ("fleet/worker.py::FleetWorker._on_poll",),
+           ("death_plan.tick",)),
+    )),
+    # The transport's manual-assignment consumer: committed-offset resume at
+    # construction, fence consulted at commit time.
+    RoleSpec("AssignedConsumer", "stream/broker.py::InProcessAssignedConsumer",
+             ("consuming", "closed"), "consuming", (
+        _t("resume", "consuming", "consuming",
+           ("stream/broker.py::InProcessAssignedConsumer.__init__",)),
+        _t("poll", "consuming", "consuming",
+           ("stream/broker.py::InProcessAssignedConsumer.poll_batch",),
+           ("poll",)),
+        _t("commit", "consuming", "consuming",
+           ("stream/broker.py::InProcessAssignedConsumer._commit_locked",),
+           ("fence",)),
+        _t("close", "consuming", "closed",
+           ("stream/broker.py::InProcessAssignedConsumer.close",)),
+    )),
+    # The blackboard: workers publish, the coordinator aggregates per tick.
+    RoleSpec("Bus", "fleet/bus.py::FleetBus", ("steady",), "steady", (
+        _t("publish", "steady", "steady",
+           ("fleet/worker.py::FleetWorker._publish",),
+           ("bus.publish",)),
+        _t("retract", "steady", "steady",
+           ("fleet/worker.py::FleetWorker._run",),
+           ("bus.retract",)),
+        _t("aggregate", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.tick",),
+           ("bus.snapshots", "bus.publish_fleet")),
+    )),
+    # Environment: no code anchor — lease ttl elapsing is the adversary.
+    RoleSpec("Environment", None, ("world",), "world", (
+        _t("lapse", "world", "world"),
+    )),
+)
+
+
+@dataclass(frozen=True)
+class BarrierObligation:
+    """An FC503 call-site shape: ``first`` must lexically precede ``then``
+    inside ``anchor`` (or, with ``then`` empty, just exist). Event syntax:
+    ``call:<pattern>`` (dotted call suffix), ``store:<attr>`` (assignment or
+    ``del`` whose target chain mentions the attribute), and
+    ``kwarg:<call_pattern>:<kwarg>`` (the call must pass the keyword)."""
+
+    name: str
+    anchor: str
+    first: str
+    then: str = ""
+    why: str = ""
+
+
+FLEET_BARRIER_OBLIGATIONS: Tuple[BarrierObligation, ...] = (
+    BarrierObligation(
+        "renew-before-expiry-scan",
+        "fleet/coordinator.py::FleetCoordinator.join",
+        first="store:_members", then="call:_expire_locked",
+        why="a syncing member is alive by definition; scanning before the "
+            "renewal lets a member expire ITSELF (checker invariant "
+            "no_self_expiry, mutation expire_before_renew)"),
+    BarrierObligation(
+        "fence-before-offsets-advance",
+        "stream/broker.py::InProcessAssignedConsumer._commit_locked",
+        first="call:fence", then="store:_committed",
+        why="the fence must refuse a revoked lease BEFORE any offset "
+            "advances, or a zombie commit silently moves a partition "
+            "someone else owns (checker invariant no_zombie_commit, "
+            "mutation drop_fence)"),
+    BarrierObligation(
+        "fence-wired-into-fleet-consumers",
+        "fleet/fleet.py::Fleet.in_process",
+        first="kwarg:assigned_consumer:fence",
+        why="an assigned consumer without the coordinator fence cannot "
+            "fail stale commits (mutation drop_fence)"),
+    BarrierObligation(
+        "drain-before-ack",
+        "fleet/worker.py::FleetWorker._run",
+        first="call:engine.run", then="call:coordinator.ack",
+        why="the ack releases the revoke barrier; acking before the engine "
+            "drained + committed hands partitions over with uncommitted "
+            "read-ahead outstanding (checker invariant revoke_barrier, "
+            "mutation ack_before_drain)"),
+    BarrierObligation(
+        "rebalance-populates-revoke-barrier",
+        "fleet/coordinator.py::FleetCoordinator._rebalance_locked",
+        first="store:_pending",
+        why="pairs leaving a live owner must enter the barrier or the new "
+            "owner polls before the old owner commits (checker invariant "
+            "revoke_barrier, mutation skip_revoke_barrier)"),
+    BarrierObligation(
+        "expiry-releases-holds",
+        "fleet/coordinator.py::FleetCoordinator._expire_locked",
+        first="store:_pending",
+        why="a dead holder's barrier holds must release on lease expiry — "
+            "expiry IS the drain barrier for a dead worker"),
+    BarrierObligation(
+        "resume-from-group-offsets",
+        "stream/broker.py::InProcessAssignedConsumer.__init__",
+        first="store:_position", then="store:_committed",
+        why="construction must seed positions from the group-durable "
+            "offsets before anything consumes — the zero-loss handoff"),
+)
+
+
+#: Dotted call patterns that ARE the fleet protocol (FC501 scope): any call
+#: site in a fleet module matching one of these must be claimed by a
+#: FLEET_PROTOCOLS transition's (anchor, calls) pair — new protocol traffic
+#: cannot land unregistered.
+FLEET_PROTOCOL_VOCABULARY: Tuple[str, ...] = (
+    "coordinator.join", "coordinator.sync", "coordinator.ack",
+    "coordinator.leave", "coordinator.fence_lost", "coordinator.tick",
+    "coordinator.committed_lag",
+    "bus.publish", "bus.retract", "bus.publish_fleet", "bus.snapshots",
+)
+
+#: Package-relative path prefixes FC501 scans for vocabulary call sites.
+FLEET_PROTOCOL_SCOPE: Tuple[str, ...] = ("fleet/",)
+
+
+# ---------------------------------------------------------------------------
 # Hot-loop functions (FC203 host-sync / FC204 ladder-bypass scope): the
 # per-batch serving path, where one stray device sync or unwarmed shape
 # costs throughput on EVERY batch.

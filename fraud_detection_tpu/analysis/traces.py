@@ -1,0 +1,94 @@
+"""Counterexample trace rendering for ``flightcheck model``.
+
+A violated invariant is only useful if a human can replay it: the checker
+returns the SHORTEST offending interleaving (BFS order), and this module
+renders it as a numbered step list — who acted, what they did, what it
+means — followed by the invariant and its explanation, the same shape the
+chaos suite's failure dumps take. ``to_finding`` adapts a violation onto
+the ordinary :class:`~fraud_detection_tpu.analysis.core.Finding` model so
+counterexamples ride the existing ``--sarif`` output (rule FC504) and CI
+code-scanning annotates the module that owns the violated choreography.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from fraud_detection_tpu.analysis.checker import (CheckConfig, CheckResult,
+                                                  Violation)
+from fraud_detection_tpu.analysis.core import Finding
+
+#: invariant -> (owning module, one-line meaning) for finding anchoring.
+_INVARIANT_HOME = {
+    "no_duplicate": ("fleet/coordinator.py",
+                     "a row was delivered under two successful commits"),
+    "no_loss": ("fleet/worker.py",
+                "the fleet went quiescent with undelivered rows"),
+    "no_zombie_commit": ("stream/broker.py",
+                         "a commit advanced a partition its worker no "
+                         "longer owns"),
+    "revoke_barrier": ("fleet/coordinator.py",
+                       "a pair's new owner polled it before the old "
+                       "owner's commit-ack"),
+    "no_self_expiry": ("fleet/coordinator.py",
+                       "a syncing member expired itself"),
+}
+
+
+def render(result: CheckResult, cfg: CheckConfig) -> str:
+    """Human-readable report for any checker outcome."""
+    lines: List[str] = []
+    muts = ",".join(sorted(cfg.mutations)) or "none"
+    lines.append(
+        f"flightcheck model: workers={cfg.workers} "
+        f"partitions={cfg.partitions} keys={cfg.keys_per_partition} "
+        f"crashes<={cfg.max_crashes} lapses<={cfg.max_lapses} "
+        f"mutations={muts}")
+    lines.append(
+        f"  explored {result.states} states / {result.transitions} "
+        f"transitions to depth {result.depth} in {result.elapsed:.2f}s")
+    if result.coverage:
+        cov = "  ".join(f"{k}:{v}" for k, v in sorted(result.coverage.items()))
+        lines.append(f"  action coverage: {cov}")
+    if result.budget_exhausted:
+        lines.append(f"  BUDGET EXHAUSTED: {result.budget_reason} — "
+                     f"verification incomplete (shrink the configuration "
+                     f"or raise the budget)")
+        return "\n".join(lines)
+    if result.ok:
+        lines.append("  VERIFIED: all invariants hold over every explored "
+                     "interleaving (no_duplicate, no_loss, "
+                     "no_zombie_commit, revoke_barrier, no_self_expiry)")
+        return "\n".join(lines)
+    lines.append("")
+    lines.append(render_trace(result.violation))
+    return "\n".join(lines)
+
+
+def render_trace(violation: Violation) -> str:
+    lines: List[str] = []
+    lines.append(f"counterexample: invariant `{violation.invariant}` "
+                 f"violated after {len(violation.trace)} step(s) "
+                 f"(shortest such interleaving):")
+    width = len(str(len(violation.trace)))
+    for i, step in enumerate(violation.trace, start=1):
+        lines.append(f"  step {i:>{width}}  [{step.actor:>5}] "
+                     f"{step.action:<6} {step.detail}")
+    lines.append(f"  VIOLATION: {violation.detail}")
+    return "\n".join(lines)
+
+
+def to_finding(violation: Violation) -> Finding:
+    """Adapt a counterexample onto the Finding model (rule FC504) so it
+    rides ``--sarif``: anchored at the module owning the violated
+    invariant, message = meaning + the full replayable trace."""
+    home, meaning = _INVARIANT_HOME.get(
+        violation.invariant, ("fleet/coordinator.py", violation.invariant))
+    steps = "; ".join(
+        f"{i}. {s.actor} {s.action}: {s.detail}"
+        for i, s in enumerate(violation.trace, start=1))
+    return Finding(
+        "FC504", home, 1,
+        f"model checker counterexample — {meaning} "
+        f"(invariant {violation.invariant}): {violation.detail}. "
+        f"Trace: {steps}")

@@ -146,11 +146,20 @@ class FleetCoordinator:
         ``worker_id`` NOT currently own? Non-empty for a zombie whose lease
         expired (its commit must fail — the new owner is authoritative),
         empty in normal operation. A pair the worker is still draining
-        behind the revoke barrier is still the worker's to commit."""
+        behind the revoke barrier is still the worker's to commit — but a
+        pair merely TARGETED at the worker while withheld behind a peer's
+        drain is not: until that peer commit-acks, the peer's commits are
+        the authoritative ones, and letting the target owner commit too
+        lets both sides durably commit the same rows (flightcheck
+        model-checker counterexample: a stalled worker rejoins and is
+        re-dealt its old pair as target while the in-between owner is
+        mid-drain; regression: tests/test_fleet.py
+        test_coordinator_fence_blocks_withheld_target)."""
         with self._lock:
-            owned = self._target.get(worker_id, set())
             held = {p for p, h in self._pending.items() if h == worker_id}
-            return [p for p in pairs if tuple(p) not in owned
+            granted = {p for p in self._target.get(worker_id, set())
+                       if self._pending.get(p) in (None, worker_id)}
+            return [p for p in pairs if tuple(p) not in granted
                     and tuple(p) not in held]
 
     # ------------------------------------------------------------------
@@ -205,12 +214,19 @@ class FleetCoordinator:
             self._target[w].update(kept[w])
         # Barrier: pairs that moved away from a still-live previous owner
         # wait for its drain ack; everything else (dead/absent owner, or
-        # still with its owner) clears immediately.
+        # still with its owner) clears immediately. An EXISTING hold outlives
+        # re-deals: the holder is whoever actually consumed the pair, and
+        # until it acks, re-targeting the pair (a second rebalance before the
+        # drain finishes) must not hand it to the next owner — rebuilding
+        # from the target map alone dropped exactly those holds (flightcheck
+        # model-checker counterexample, mutation `forget_barrier_holds`;
+        # regression: tests/test_fleet.py
+        # test_coordinator_barrier_survives_consecutive_rebalances).
         self._pending = {
-            pair: old[pair]
+            pair: holder
             for w in members for pair in self._target[w]
-            if old.get(pair) not in (None, w)
-            and old.get(pair) in self._members}
+            for holder in (self._pending.get(pair, old.get(pair)),)
+            if holder not in (None, w) and holder in self._members}
 
     def _lease_locked(self, worker_id: str) -> Lease:
         target = self._target.get(worker_id, set())

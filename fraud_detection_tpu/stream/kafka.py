@@ -236,6 +236,101 @@ class KafkaConsumer:
         self._consumer.close()
 
 
+class KafkaAssignedConsumer(KafkaConsumer):
+    """confluent_kafka consumer in manual-assignment (``assign()``) mode —
+    the transport the fleet's lease-based partition ownership drives
+    against real Kafka, mirroring
+    :class:`~fraud_detection_tpu.stream.broker.InProcessAssignedConsumer`
+    (docs/fleet.md):
+
+    * **explicit pairs** — reads EXACTLY the given (topic, partition)
+      set; never joins the group's assignor (ownership/exclusivity lives
+      in the fleet coordinator's leases);
+    * **committed-offset resume** — construction queries the group's
+      committed offsets and assigns each pair at them (earliest where the
+      group never committed), the zero-loss handoff contract: whatever a
+      dead owner failed to commit is exactly what the next owner
+      re-reads;
+    * **fence** — an optional callable consulted with the pairs BEFORE
+      every commit (the FC503 ``fence-before-offsets-advance`` shape): a
+      non-empty return means the lease was revoked and the commit raises
+      :class:`~fraud_detection_tpu.stream.broker.CommitFailedError`
+      instead of silently advancing a partition someone else now owns.
+
+    ``client`` injects a pre-built consumer (tests drive the adapter
+    contract without the wheel or a broker, like PR 4's ``backlog()``
+    tests); the group id still matters to Kafka — pass it via ``config``
+    (``KafkaConfig.consumer_group``)."""
+
+    def __init__(self, partitions, config: Optional[KafkaConfig] = None, *,
+                 fence=None, client=None, backlog_interval: float = 1.0,
+                 clock=time.monotonic):
+        self.partitions = [tuple(p) for p in partitions]
+        self._fence = fence
+        if client is not None:
+            self._consumer = client
+        else:
+            _require()
+            cfg = config or KafkaConfig.from_env()
+            self._consumer = _ck.Consumer({
+                "bootstrap.servers": cfg.bootstrap_servers,
+                "group.id": cfg.consumer_group,
+                "auto.offset.reset": "earliest",
+                "enable.auto.commit": False,
+                **_security_config(cfg),
+            })
+        self._clock = clock
+        self._backlog_interval = backlog_interval
+        self._backlog_at: Optional[float] = None
+        self._backlog_val: Optional[int] = None
+        # Resume every pair from the GROUP's committed offset; where the
+        # group never committed, OFFSET_BEGINNING honors the earliest
+        # policy explicitly (assign() bypasses auto.offset.reset until
+        # the first fetch, and an unset offset would resume from the
+        # consumer's default of "latest stored" semantics).
+        tps = [self._tp(t, p) for t, p in self.partitions]
+        begin = getattr(_ck, "OFFSET_BEGINNING", -2) if _ck is not None \
+            else -2
+        try:
+            committed = self._consumer.committed(tps, timeout=10.0)
+        except TypeError:       # pragma: no cover - older client signature
+            committed = self._consumer.committed(tps)
+        for tp in committed:
+            if tp.offset is None or tp.offset < 0:
+                tp.offset = begin
+        self._consumer.assign(committed)
+
+    @staticmethod
+    def _tp(topic: str, partition: int, offset: Optional[int] = None):
+        if _ck is not None:
+            if offset is None:
+                return _ck.TopicPartition(topic, partition)
+            return _ck.TopicPartition(topic, partition, offset)
+        raise RuntimeError("confluent_kafka unavailable")  # pragma: no cover
+
+    def assignment(self):
+        return sorted(self.partitions)
+
+    def _check_fence(self, pairs) -> None:
+        fence = self._fence
+        if fence is None or not pairs:
+            return
+        lost = fence(sorted(pairs))
+        if lost:
+            raise CommitFailedError(
+                f"lease for {sorted(lost)} was revoked from this worker; "
+                "offsets stay uncommitted — the partitions' new owner "
+                "reprocesses")
+
+    def commit(self) -> None:
+        self._check_fence(self.partitions)
+        super().commit()
+
+    def commit_offsets(self, offsets) -> None:
+        self._check_fence(list(offsets))
+        super().commit_offsets(offsets)
+
+
 class KafkaProducer:
     def __init__(self, config: Optional[KafkaConfig] = None):
         _require()

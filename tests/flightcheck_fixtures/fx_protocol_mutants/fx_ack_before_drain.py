@@ -1,0 +1,33 @@
+"""Protocol mutant: drain-before-commit inverted — the worker acks the
+revoke barrier BEFORE its engine drained + committed.
+
+The checker mutation ``ack_before_drain`` gives this shape its dynamic
+counterexample (invariant ``revoke_barrier``); statically, FC503's
+``drain-before-ack`` obligation must flag the ack preceding the engine
+drain in the incarnation loop."""
+
+
+class MutantWorker:
+    def __init__(self, worker_id, coordinator, make_engine, make_consumer):
+        self.worker_id = worker_id
+        self.coordinator = coordinator
+        self.make_engine = make_engine
+        self.make_consumer = make_consumer
+        self._stopped = False
+
+    def _run(self, idle_timeout):
+        lease = self.coordinator.join(self.worker_id)
+        while not self._stopped:
+            # VIOLATION FC503 drain-before-ack: the barrier releases here,
+            # handing partitions to their new owner while THIS worker's
+            # engine still holds uncommitted read-ahead on them.
+            lease = self.coordinator.ack(self.worker_id)
+            inner = self.make_consumer(lease)
+            engine = self.make_engine(inner, self.worker_id)
+            stats = engine.run(idle_timeout=idle_timeout)
+            inner.close()
+            lag = self.coordinator.committed_lag()
+            if lag is None or lag <= 0:
+                break
+        self.coordinator.leave(self.worker_id)
+        return stats

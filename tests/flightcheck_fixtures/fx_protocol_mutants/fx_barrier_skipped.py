@@ -1,0 +1,24 @@
+"""Protocol mutant: the revoke barrier skipped in the re-deal.
+
+The checker mutation ``skip_revoke_barrier`` gives this shape its dynamic
+counterexample (invariant ``revoke_barrier``); statically, FC503's
+``rebalance-populates-revoke-barrier`` obligation must flag that the
+re-deal never populates the pending-hold map, so pairs leaving a live
+owner are granted to their new owner immediately."""
+
+
+class MutantCoordinator:
+    def __init__(self, pairs):
+        self._all_pairs = list(pairs)
+        self._members = {}
+        self._target = {}
+
+    def _rebalance_locked(self):
+        # VIOLATION FC503 rebalance-populates-revoke-barrier: no pending
+        # holds — the new owner polls a moved pair while the old owner
+        # still has uncommitted read-ahead on it.
+        members = sorted(self._members)
+        self._target = {w: set() for w in members}
+        for i, pair in enumerate(self._all_pairs):
+            if members:
+                self._target[members[i % len(members)]].add(pair)

@@ -1,0 +1,41 @@
+"""Protocol mutant: the expiry scan runs before the syncing member's
+renewal — a live member can expire ITSELF.
+
+The checker mutation ``expire_before_renew`` gives this shape its dynamic
+counterexample (invariant ``no_self_expiry``); statically, FC503's
+``renew-before-expiry-scan`` obligation must flag the scan preceding the
+caller's membership renewal in ``join``."""
+
+
+class MutantCoordinator:
+    def __init__(self, clock, lease_ttl):
+        self._members = {}
+        self._clock = clock
+        self.lease_ttl = lease_ttl
+        self._join_seq = 0
+
+    def _expire_locked(self, now):
+        stale = [w for w, info in self._members.items()
+                 if now - info["renewed"] > self.lease_ttl]
+        for w in stale:
+            del self._members[w]
+        return bool(stale)
+
+    def _rebalance_locked(self):
+        pass
+
+    def join(self, worker_id):
+        now = self._clock()
+        # VIOLATION FC503 renew-before-expiry-scan: the scan runs first,
+        # so a stale-but-alive caller expires itself and loses its lease
+        # to its own heartbeat.
+        expired = self._expire_locked(now)
+        new = worker_id not in self._members
+        if new:
+            self._members[worker_id] = {"renewed": now,
+                                        "joined": self._join_seq}
+            self._join_seq += 1
+        else:
+            self._members[worker_id]["renewed"] = now
+        if new or expired:
+            self._rebalance_locked()

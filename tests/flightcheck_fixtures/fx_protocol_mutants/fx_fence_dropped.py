@@ -1,0 +1,25 @@
+"""Protocol mutant: the commit fence dropped from the assigned consumer.
+
+The checker mutation ``drop_fence`` gives this shape its dynamic
+counterexample (invariant ``no_zombie_commit``); statically, FC503's
+``fence-before-offsets-advance`` obligation must flag that ``_commit_locked``
+advances offsets without ever consulting the fence."""
+
+
+class MutantAssignedConsumer:
+    def __init__(self, broker, partitions, group_id, fence=None):
+        self.broker = broker
+        self.group_id = group_id
+        self.partitions = [tuple(p) for p in partitions]
+        self._fence = fence
+        self._committed = dict()
+
+    def _commit_locked(self, advances):
+        # VIOLATION FC503 fence-before-offsets-advance: a zombie whose
+        # lease expired sails right through — offsets advance for
+        # partitions someone else now owns.
+        self._committed.update(advances)
+        for (t, p), off in advances.items():
+            key = (self.group_id, t, p)
+            if off > self.broker._group_offsets.get(key, 0):
+                self.broker._group_offsets[key] = off

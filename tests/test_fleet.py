@@ -154,6 +154,56 @@ def test_coordinator_lease_expiry_and_zombie_fence():
     assert c.fence_lost("w0", [("in", 0), ("in", 1)]) == []
 
 
+def test_coordinator_barrier_survives_consecutive_rebalances():
+    """Flightcheck model-checker true positive (ISSUE 9): a second re-deal
+    before a revoked owner's drain-ack used to rebuild ``_pending`` from the
+    TARGET map alone, dropping the still-draining holder's hold — the pair's
+    next owner could poll it before the old owner commit-acked (a REVOKE
+    BARRIER breach; fenced commits then duplicate the old owner's outputs).
+    Holds must follow the actual consumer until it acks."""
+    c = FleetCoordinator(["in"], 3, lease_ttl=30.0)
+    c.join("w0")                       # w0 owns all three pairs
+    l1 = c.join("w1")
+    assert l1.pending                  # w1's share waits on w0's drain
+    held = set(l1.pending)
+    c.join("w2")                       # second re-deal, w0 still draining
+    l1b, l2b = c.sync("w1"), c.sync("w2")
+    granted = set(l1b.partitions) | set(l2b.partitions)
+    assert not (held & granted), (
+        f"barrier hold dropped by the second rebalance: {held & granted}")
+    for pair in held:
+        assert c._pending.get(pair) == "w0"
+    # the ack releases every held pair to its (current) new owner
+    c.ack("w0")
+    l1c, l2c = c.sync("w1"), c.sync("w2")
+    assert not l1c.pending and not l2c.pending
+    assert held <= (set(l1c.partitions) | set(l2c.partitions)
+                    | set(c.sync("w0").partitions))
+
+
+def test_coordinator_fence_blocks_withheld_target():
+    """Second flightcheck model-checker true positive (ISSUE 9): the fence
+    used to pass any pair in the worker's TARGET set — including pairs
+    withheld behind a peer's drain hold. A stalled worker that expired,
+    rejoined, and was re-dealt its old pair as target could then commit
+    pre-expiry read-ahead while the in-between owner was mid-drain: both
+    sides durably commit the same rows. Target-while-withheld must fence;
+    the HOLDER keeps commit rights until it acks."""
+    c = FleetCoordinator(["in"], 2, lease_ttl=30.0)
+    c.join("w0")
+    c.join("w1")                      # one pair moves w0 -> w1, held by w0
+    held = [p for p, h in c._pending.items() if h == "w0"]
+    assert len(held) == 1
+    pair = held[0]
+    # the holder (w0) may commit the pair it is draining...
+    assert c.fence_lost("w0", [pair]) == []
+    # ...but the target owner (w1) is FENCED until w0 acks
+    assert c.fence_lost("w1", [pair]) == [pair]
+    c.ack("w0")
+    assert c.fence_lost("w1", [pair]) == []
+    assert c.fence_lost("w0", [pair]) == [pair]   # and w0 lost it for good
+
+
 def test_coordinator_tick_aggregates_global_backlog():
     bus = FleetBus()
     c = FleetCoordinator(["in"], 4, bus=bus, lease_ttl=30.0)

@@ -543,6 +543,76 @@ def test_cli_fix_scaffolds_fixture_tree(tmp_path, capsys):
     assert "0 edit(s) applied" in out
 
 
+def test_incremental_cache_hits_and_invalidates(tmp_path):
+    """The per-file cache (analysis/cache.py): identical findings with and
+    without it, full hits on a warm second run, and a single-file edit
+    misses exactly that file."""
+    import shutil
+
+    from fraud_detection_tpu.analysis.cache import AnalysisCache
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    for name in ("fx_lock_leak.py", "fx_commit_protocol.py"):
+        shutil.copy(os.path.join(FIXTURES, name), root / name)
+    cache_dir = str(tmp_path / "cache")
+
+    def run(stats):
+        return run_analysis(package_root=str(root), tests_dir=None,
+                            cache_dir=cache_dir, stats=stats)
+
+    plain = run_analysis(package_root=str(root), tests_dir=None)
+    s1, s2 = {}, {}
+    cold = run(s1)
+    warm = run(s2)
+    assert cold[0] == warm[0] == plain[0]
+    assert s1 == {"hits": 0, "misses": 2}
+    assert s2 == {"hits": 2, "misses": 0}
+    # an edit misses only the edited file...
+    (root / "fx_lock_leak.py").write_text(
+        (root / "fx_lock_leak.py").read_text() + "\n# touched\n")
+    s3 = {}
+    run(s3)
+    assert s3 == {"hits": 1, "misses": 1}
+    # ...and a cache entry survives as plain JSON keyed on content hash
+    cache = AnalysisCache(cache_dir)
+    entries = [f for f in os.listdir(cache_dir) if f.endswith(".json")]
+    assert len(entries) == 3      # 2 originals + 1 edited variant
+    assert cache.stats() == {"hits": 0, "misses": 0}
+
+
+def test_cache_salt_invalidates_on_registry_change(tmp_path, monkeypatch):
+    """Changing a registry the file-local rules read (HOT_PATHS here) must
+    change the salt — stale verdicts under a new configuration would be
+    silently wrong."""
+    from fraud_detection_tpu.analysis import cache as cache_mod
+    from fraud_detection_tpu.analysis import entrypoints
+
+    before = cache_mod._registry_salt()
+    monkeypatch.setattr(entrypoints, "HOT_PATHS",
+                        frozenset({"nowhere.py::Nothing.nothing"}))
+    after = cache_mod._registry_salt()
+    assert before != after
+
+
+def test_cache_salt_stable_across_processes():
+    """frozenset repr is hash-seed ordered; the salt must not be (a fresh
+    process would miss the whole cache every run)."""
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, "-c",
+           "from fraud_detection_tpu.analysis.cache import _registry_salt;"
+           "print(_registry_salt())"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    a = subprocess.run(cmd, capture_output=True, text=True,
+                       env={**env, "PYTHONHASHSEED": "1"}, timeout=120)
+    b = subprocess.run(cmd, capture_output=True, text=True,
+                       env={**env, "PYTHONHASHSEED": "2"}, timeout=120)
+    assert a.returncode == 0 and b.returncode == 0, a.stderr + b.stderr
+    assert a.stdout.strip() == b.stdout.strip()
+
+
 def test_analyzer_runtime_budget():
     """Pinned analyzer-runtime budget: the whole-program pass must stay a
     sub-minute CI gate, not a soak. 30s is ~10x the measured cost on a

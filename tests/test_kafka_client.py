@@ -52,6 +52,18 @@ class FakeConsumer:
 
     def close(self): self.closed = True
 
+    # manual-assignment surface (KafkaAssignedConsumer)
+    committed_offsets: dict = {}
+
+    def committed(self, tps, timeout=None):
+        for tp in tps:
+            tp.offset = self.committed_offsets.get(
+                (tp.topic, tp.partition), -1001)   # OFFSET_INVALID
+        return tps
+
+    def assign(self, tps):
+        self.assigned = tps
+
 
 class FakeProducer:
     def __init__(self, config):
@@ -81,7 +93,7 @@ class FakeProducer:
 
 
 class FakeTopicPartition:
-    def __init__(self, topic, partition, offset):
+    def __init__(self, topic, partition, offset=None):
         self.topic, self.partition, self.offset = topic, partition, offset
 
 
@@ -435,3 +447,73 @@ def test_commit_rebalance_error_translates(kafka_mod):
     c._consumer.commit = broken
     with pytest.raises(FakeKafkaException):
         c.commit_offsets({("raw", 0): 5})
+
+
+# ---------------------------------------------------------------------------
+# manual-assignment adapter (KafkaAssignedConsumer) — the fleet lane's real-
+# Kafka transport, mirroring InProcessAssignedConsumer (docs/fleet.md)
+# ---------------------------------------------------------------------------
+
+def test_assigned_consumer_resumes_from_committed(kafka_mod):
+    client = FakeConsumer({})
+    client.committed_offsets = {("raw", 0): 42}   # p1 never committed
+    c = kafka_mod.KafkaAssignedConsumer(
+        [("raw", 0), ("raw", 1)], config=CFG, client=client)
+    got = sorted((tp.topic, tp.partition, tp.offset)
+                 for tp in client.assigned)
+    # committed pair resumes AT the committed offset; uncommitted pair at
+    # OFFSET_BEGINNING (-2) — the explicit form of the earliest policy
+    assert got == [("raw", 0, 42), ("raw", 1, -2)]
+    assert c.assignment() == [("raw", 0), ("raw", 1)]
+    # never joins the group assignor: no subscribe happened
+    assert client.subscribed is None
+
+
+def test_assigned_consumer_fence_blocks_commit(kafka_mod):
+    client = FakeConsumer({})
+    client.committed_offsets = {}
+    fenced_calls = []
+
+    def fence(pairs):
+        fenced_calls.append(list(pairs))
+        return [("raw", 1)]      # lease revoked for p1
+
+    c = kafka_mod.KafkaAssignedConsumer(
+        [("raw", 0), ("raw", 1)], config=CFG, client=client, fence=fence)
+    from fraud_detection_tpu.stream.broker import CommitFailedError
+
+    with pytest.raises(CommitFailedError):
+        c.commit_offsets({("raw", 0): 5, ("raw", 1): 9})
+    with pytest.raises(CommitFailedError):
+        c.commit()
+    # the FC503 shape: fence consulted BEFORE any offset advanced
+    assert client.commits == []
+    assert fenced_calls[0] == [("raw", 0), ("raw", 1)]
+
+
+def test_assigned_consumer_fence_pass_commits_through(kafka_mod):
+    client = FakeConsumer({})
+    client.committed_offsets = {}
+    c = kafka_mod.KafkaAssignedConsumer(
+        [("raw", 0)], config=CFG, client=client, fence=lambda pairs: [])
+    c.commit_offsets({("raw", 0): 7})
+    (tps, asynchronous), = client.commits
+    assert asynchronous is False
+    assert [(tp.topic, tp.partition, tp.offset) for tp in tps] == \
+        [("raw", 0, 7)]
+    # no fence at all behaves like an always-empty fence
+    c2 = kafka_mod.KafkaAssignedConsumer(
+        [("raw", 0)], config=CFG, client=FakeConsumer({}))
+    c2.commit()
+    assert c2._consumer.commits == [(None, False)]
+
+
+def test_assigned_consumer_polls_like_group_consumer(kafka_mod):
+    client = FakeConsumer({})
+    client.committed_offsets = {}
+    c = kafka_mod.KafkaAssignedConsumer([("raw", 0)], config=CFG,
+                                        client=client)
+    client.queue = [FakeKafkaMessage("raw", b"v", b"k", 0, 3)]
+    m = c.poll(0.1)
+    assert (m.topic, m.value, m.key, m.partition, m.offset) == \
+        ("raw", b"v", b"k", 0, 3)

@@ -1,0 +1,353 @@
+"""flightcheck v3 — distributed protocol model checking (ISSUE 9).
+
+Four layers:
+
+1. the **explicit-state checker** (analysis/checker.py): the clean fleet
+   spec verifies ALL FIVE invariants over every bounded interleaving of
+   the default configuration within the pinned state/wall budget, and
+   every seeded protocol mutation produces a counterexample trace caught
+   by the intended invariant — including ``forget_barrier_holds`` and the
+   withheld-target fence hole, the two TRUE POSITIVES this checker found
+   in ``FleetCoordinator`` (fixed in-tree; regressions in test_fleet.py);
+2. the **spec <-> checker <-> code three-way pin**: every FLEET_PROTOCOLS
+   transition is implemented by a checker action (ACTION_IMPLEMENTS
+   covers the spec exactly), and FC501/FC502/FC503 hold the spec against
+   the real tree (fixture mutants under
+   tests/flightcheck_fixtures/fx_protocol_mutants/ are each caught
+   statically);
+3. **trace rendering + SARIF**: counterexamples render as replayable
+   numbered step lists and ride the existing SARIF output as FC504;
+4. the **CLI**: ``flightcheck model`` exits 0 on the clean spec, 1 with a
+   trace on a mutant, 2 on an impossible configuration or blown budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fraud_detection_tpu.analysis import model, sarif
+from fraud_detection_tpu.analysis.checker import (ACTION_IMPLEMENTS,
+                                                  INVARIANTS, MUTATIONS,
+                                                  CheckConfig, check,
+                                                  spec_transition_names)
+from fraud_detection_tpu.analysis.core import SourceFile, load_package
+from fraud_detection_tpu.analysis.entrypoints import (
+    BarrierObligation, FLEET_BARRIER_OBLIGATIONS, FLEET_PROTOCOLS,
+    ProtocolTransition, RoleSpec)
+from fraud_detection_tpu.analysis import traces
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "fraud_detection_tpu")
+MUTANT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "flightcheck_fixtures", "fx_protocol_mutants")
+
+
+def load_mutant(name: str) -> SourceFile:
+    sf = SourceFile.load(os.path.join(MUTANT_DIR, name), name)
+    assert sf is not None, f"mutant fixture {name} failed to parse"
+    return sf
+
+
+# ---------------------------------------------------------------------------
+# 1. the checker: clean spec verifies, every mutation yields a trace
+# ---------------------------------------------------------------------------
+
+def test_clean_spec_verifies_within_budget():
+    """THE acceptance pin: all five invariants hold over every bounded
+    interleaving of the default configuration, inside the pinned budget."""
+    cfg = CheckConfig()                      # the CI gate's configuration
+    result = check(cfg)
+    assert result.ok, (result.budget_reason if result.budget_exhausted
+                       else traces.render_trace(result.violation))
+    assert not result.budget_exhausted
+    assert result.states > 10_000            # a real exploration, not a stub
+    assert result.elapsed < 60.0
+    # every protocol action was exercised (no vacuous verification)
+    assert set(result.coverage) == set(ACTION_IMPLEMENTS)
+    assert all(n > 0 for n in result.coverage.values())
+
+
+_EXPECTED = {
+    "drop_fence": "no_zombie_commit",
+    "skip_revoke_barrier": "revoke_barrier",
+    "ack_before_drain": "revoke_barrier",
+    "expire_before_renew": "no_self_expiry",
+    "forget_barrier_holds": "revoke_barrier",
+}
+
+
+@pytest.mark.parametrize("mutation", MUTATIONS)
+def test_every_mutation_yields_counterexample(mutation):
+    kw = {}
+    if mutation == "forget_barrier_holds":
+        # needs a THIRD worker: the hold drops on the second re-deal
+        # while the first owner is still draining
+        kw = dict(workers=3, partitions=3, keys_per_partition=1)
+    cfg = CheckConfig(mutations=frozenset({mutation}), **kw)
+    result = check(cfg)
+    assert result.violation is not None, f"{mutation}: no counterexample"
+    assert result.violation.invariant == _EXPECTED[mutation]
+    assert len(result.violation.trace) >= 3
+    # the trace is replayable prose: every step has actor/action/detail
+    for step in result.violation.trace:
+        assert step.actor and step.action and step.detail
+
+
+def test_mutation_counterexamples_are_shortest_first():
+    """BFS order: the expire_before_renew counterexample is minimal —
+    join, lapse, sync. Pinning the exact shape keeps trace quality from
+    silently regressing."""
+    cfg = CheckConfig(mutations=frozenset({"expire_before_renew"}))
+    result = check(cfg)
+    actions = [s.action for s in result.violation.trace]
+    assert actions == ["join", "lapse", "sync"]
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="surviv"):
+        CheckConfig(workers=2, max_crashes=2).validate()
+    with pytest.raises(ValueError, match="unknown mutations"):
+        CheckConfig(mutations=frozenset({"nope"})).validate()
+    with pytest.raises(ValueError, match="workers"):
+        CheckConfig(workers=9).validate()
+
+
+def test_budget_exhaustion_is_honest():
+    cfg = CheckConfig(max_states=200)
+    result = check(cfg)
+    assert not result.ok and result.budget_exhausted
+    assert "state budget" in result.budget_reason
+    assert result.violation is None
+    report = traces.render(result, cfg)
+    assert "BUDGET EXHAUSTED" in report and "incomplete" in report
+
+
+def test_symmetry_reduction_preserves_the_verdict():
+    """The worker-symmetry canonicalization is an automorphism: same
+    verdict with it off, strictly more states explored."""
+    on = check(CheckConfig(keys_per_partition=1))
+    off = check(CheckConfig(keys_per_partition=1, symmetry=False))
+    assert on.ok and off.ok
+    assert off.states > on.states
+
+
+# ---------------------------------------------------------------------------
+# 2. spec <-> checker <-> code three-way pin
+# ---------------------------------------------------------------------------
+
+def test_checker_actions_cover_every_spec_transition():
+    """Every FLEET_PROTOCOLS transition is implemented by some checker
+    macro-step, and nothing in ACTION_IMPLEMENTS is stale — the spec the
+    FC5xx rules verify against the code IS the model the checker runs."""
+    spec = spec_transition_names()
+    implemented = {q for quals in ACTION_IMPLEMENTS.values() for q in quals}
+    assert implemented == spec, (
+        f"unimplemented spec transitions: {sorted(spec - implemented)}; "
+        f"stale checker claims: {sorted(implemented - spec)}")
+
+
+def test_invariant_catalog_and_mutations_documented():
+    doc = open(os.path.join(REPO, "docs", "static_analysis.md")).read()
+    for inv in INVARIANTS:
+        assert inv in doc, f"invariant {inv} missing from docs"
+    for m in MUTATIONS:
+        assert m in doc, f"mutation {m} missing from docs"
+
+
+def test_fc5xx_zero_findings_on_tree():
+    files = load_package(PKG)
+    findings = model.analyze(files)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_fc502_catches_spec_drift():
+    """A transition anchored at a method that doesn't exist, and one whose
+    required call vanished, both flag — the spec cannot silently outlive
+    the code it models."""
+    files = load_package(PKG)
+    ghost = (RoleSpec("Coordinator", "fleet/coordinator.py::FleetCoordinator",
+                      ("steady",), "steady", (
+        ProtocolTransition("join", "steady", "steady",
+                           ("fleet/coordinator.py::FleetCoordinator."
+                            "join_v2",)),
+        ProtocolTransition("tick", "steady", "steady",
+                           ("fleet/coordinator.py::FleetCoordinator.tick",),
+                           ("frobnicate",)),
+    )),)
+    findings = model.analyze(files, protocols=ghost, obligations=(),
+                             vocabulary=(), scope=())
+    assert len(findings) == 2
+    assert all(f.rule == "FC502" for f in findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "join_v2" in msgs and "frobnicate" in msgs
+
+
+def test_fc501_catches_unclaimed_protocol_call():
+    """A fleet-scoped call site matching the protocol vocabulary with no
+    claiming transition flags — new protocol traffic cannot land
+    unmodeled."""
+    files = load_package(PKG)
+    findings = model.analyze(files, protocols=(), obligations=())
+    fc501 = [f for f in findings if f.rule == "FC501"]
+    # with the spec emptied, every real protocol call site is unclaimed
+    assert len(fc501) >= 8
+    assert all(f.path.startswith("fleet/") for f in fc501)
+    msgs = "\n".join(f.message for f in fc501)
+    assert "coordinator.join" in msgs and "bus.publish" in msgs
+
+
+_MUTANT_OBLIGATIONS = {
+    "fx_fence_dropped.py": BarrierObligation(
+        "fence-before-offsets-advance",
+        "fx_fence_dropped.py::MutantAssignedConsumer._commit_locked",
+        first="call:fence", then="store:_committed", why="w"),
+    "fx_barrier_skipped.py": BarrierObligation(
+        "rebalance-populates-revoke-barrier",
+        "fx_barrier_skipped.py::MutantCoordinator._rebalance_locked",
+        first="store:_pending", why="w"),
+    "fx_ack_before_drain.py": BarrierObligation(
+        "drain-before-ack",
+        "fx_ack_before_drain.py::MutantWorker._run",
+        first="call:engine.run", then="call:coordinator.ack", why="w"),
+    "fx_expire_before_renew.py": BarrierObligation(
+        "renew-before-expiry-scan",
+        "fx_expire_before_renew.py::MutantCoordinator.join",
+        first="store:_members", then="call:_expire_locked", why="w"),
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(_MUTANT_OBLIGATIONS))
+def test_fc503_catches_each_protocol_mutant(fixture):
+    """Each seeded mutant fixture carries the code shape of one checker
+    mutation; FC503's obligation machinery must catch it statically."""
+    sf = load_mutant(fixture)
+    ob = _MUTANT_OBLIGATIONS[fixture]
+    findings = model.analyze([sf], protocols=(), obligations=(ob,),
+                             vocabulary=(), scope=())
+    assert len(findings) == 1, findings
+    assert findings[0].rule == "FC503"
+    assert ob.name in findings[0].message
+    text = sf.text.splitlines()
+    # the finding anchors at (or the obligation names) the VIOLATION line
+    flagged_region = "\n".join(
+        text[max(0, findings[0].line - 3):findings[0].line + 4])
+    assert "VIOLATION FC503" in flagged_region or "VIOLATION" in sf.text
+
+
+def test_fc503_clean_shapes_pass():
+    """The REAL coordinator/worker/consumer satisfy every obligation (the
+    tree-level zero-findings pin, scoped to FC503 for a sharp failure)."""
+    files = load_package(PKG)
+    findings = model.analyze(files, protocols=(), vocabulary=(), scope=(),
+                             obligations=FLEET_BARRIER_OBLIGATIONS)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_obligations_reference_real_anchors():
+    """Every default obligation and transition anchor resolves in the
+    tree (guards against anchor typos making FC502/FC503 vacuous)."""
+    files = load_package(PKG)
+    index = model._method_index(files)
+    for role in FLEET_PROTOCOLS:
+        for t in role.transitions:
+            for anchor in t.anchors:
+                assert anchor in index, f"{role.role}.{t.name}: {anchor}"
+    for ob in FLEET_BARRIER_OBLIGATIONS:
+        assert ob.anchor in index, f"{ob.name}: {ob.anchor}"
+        assert ob.why, f"{ob.name}: obligations must say why"
+
+
+# ---------------------------------------------------------------------------
+# 3. traces + SARIF
+# ---------------------------------------------------------------------------
+
+def test_trace_renders_replayable_steps():
+    cfg = CheckConfig(mutations=frozenset({"skip_revoke_barrier"}),
+                      symmetry=False)
+    result = check(cfg)
+    text = traces.render(result, cfg)
+    assert "counterexample: invariant `revoke_barrier`" in text
+    assert "step 1" in text and "VIOLATION:" in text
+    assert "REVOKE BARRIER" in text
+    # actor labels are stable without symmetry: w0 joins before anyone
+    assert "[   w0] join" in text
+
+
+def test_counterexample_rides_sarif_as_fc504():
+    cfg = CheckConfig(mutations=frozenset({"expire_before_renew"}))
+    result = check(cfg)
+    finding = traces.to_finding(result.violation)
+    assert finding.rule == "FC504"
+    assert finding.path == "fleet/coordinator.py"
+    assert "Trace:" in finding.message
+    doc = sarif.build([finding], suppressed=0, n_files=0)
+    assert sarif.validate(doc) == []
+    res, = doc["runs"][0]["results"]
+    assert res["ruleId"] == "FC504"
+    assert "no_self_expiry" in res["message"]["text"]
+
+
+# ---------------------------------------------------------------------------
+# 4. CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_model_clean_and_mutant(tmp_path, capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    trace_file = tmp_path / "trace.txt"
+    assert main(["model", "--trace-file", str(trace_file)]) == 0
+    out = capsys.readouterr().out
+    assert "VERIFIED" in out
+    assert "VERIFIED" in trace_file.read_text()
+
+    sarif_file = tmp_path / "model.sarif"
+    rc = main(["model", "--mutate", "expire_before_renew",
+               "--trace-file", str(trace_file),
+               "--sarif", str(sarif_file)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "no_self_expiry" in out
+    assert "counterexample" in trace_file.read_text()
+    doc = json.loads(sarif_file.read_text())
+    assert sarif.validate(doc) == []
+    assert doc["runs"][0]["results"][0]["ruleId"] == "FC504"
+
+
+def test_cli_model_json_and_errors(capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    assert main(["model", "--json", "--keys", "1"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True and payload["invariant_violated"] is None
+    assert payload["states"] > 100
+
+    assert main(["model", "--mutate", "bogus"]) == 2
+    assert "unknown mutations" in capsys.readouterr().err
+    assert main(["model", "--workers", "2", "--max-crashes", "2"]) == 2
+    capsys.readouterr()
+    assert main(["model", "--list-mutations"]) == 0
+    out = capsys.readouterr().out
+    for m in MUTATIONS:
+        assert m in out
+
+
+def test_cli_model_budget_exit_code(capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    assert main(["model", "--max-states", "150"]) == 2
+    assert "BUDGET EXHAUSTED" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_cli_model_subprocess_e2e():
+    proc = subprocess.run(
+        [sys.executable, "-m", "fraud_detection_tpu.analysis", "model",
+         "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
