@@ -121,6 +121,7 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
     sweep = line.get("load_sweep") or {}
     dev = line.get("device") or {}
     fleet = line.get("fleet") or {}
+    trace = line.get("trace") or {}
     record = {
         "time": round(time.time(), 1) if now is None else now,
         "metric": line.get("metric"),
@@ -132,6 +133,16 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
         "uploads_per_batch": dev.get("uploads_per_batch"),
         "dispatch_depth": dev.get("dispatch_depth") if dev else None,
         "int8_msgs_per_s": (line.get("int8_stream") or {}).get("msgs_per_s"),
+        # Per-stage wall attribution (ISSUE 10): the traced run's
+        # p50/p99/count per pipeline stage, so the next unexplained
+        # regression is diagnosable from the trend JSON alone; plus the
+        # traced/untraced throughput ratio (the <=5% overhead evidence).
+        "stages": ({stage: {"p50_ms": s.get("p50_ms"),
+                            "p99_ms": s.get("p99_ms"),
+                            "count": s.get("count")}
+                    for stage, s in (trace.get("stages") or {}).items()}
+                   or None),
+        "trace_ratio": trace.get("ratio"),
         "ladder": sweep.get("ladder"),
         "capacity_est_per_s": sweep.get("capacity_est_per_s"),
         "max_load_meeting_target_p99_per_s": sweep.get(
@@ -559,7 +570,7 @@ def _warm(pipe, texts, batch_size: int) -> None:
 
 
 def _stream_run(pipe, texts, batch_size: int, depth: int, n_msgs: int,
-                tracer=None, async_dispatch=None):
+                tracer=None, async_dispatch=None, rowtrace=None):
     """One timed streaming run: fresh broker, n_msgs produced, engine drains.
     The ONE definition of the measured loop — the headline and tree-family
     sections must not drift apart. ``tracer`` (utils.tracing.Tracer) records
@@ -586,7 +597,7 @@ def _stream_run(pipe, texts, batch_size: int, depth: int, n_msgs: int,
     engine = StreamingClassifier(
         pipe, consumer, broker.producer(), "dialogues-classified",
         batch_size=batch_size, max_wait=0.01, pipeline_depth=depth,
-        tracer=tracer, async_dispatch=async_dispatch)
+        tracer=tracer, async_dispatch=async_dispatch, rowtrace=rowtrace)
     stats = engine.run(max_messages=n_msgs, idle_timeout=1.0)
     assert stats.processed == n_msgs, stats.as_dict()
     stats.device_health = engine.health()["device"]
@@ -658,6 +669,57 @@ def featurize_bench(texts) -> dict:
             "speedup_vs_serial_python": (round(par_rate / serial_rate, 2)
                                          if serial_rate > 0 else None),
         },
+    }
+
+
+def trace_overhead_bench(pipe, texts, batch_size: int, depth: int,
+                         n_msgs: int, *, sample: float = 0.05) -> dict:
+    """Tracing-on vs tracing-off on the SAME stream, as back-to-back
+    PAIRS with alternating arm order. The committed ``ratio`` is the
+    MEDIAN of per-pair on/off ratios: the two arms of one pair share the
+    host's contention regime (the r04 lesson — absolute rates on a shared
+    box swing +-10%, far beyond the 5%% budget being verified; a paired
+    ratio cancels the swing), and the median throws away the pair a noise
+    spike still poisoned. Also commits the traced arm's per-stage p50/p99
+    sketch snapshot — the ``stages`` attribution block the trend file
+    carries, the committed answer to "which stage moved" for every future
+    unexplained regression."""
+    from statistics import median
+
+    from fraud_detection_tpu.obs import RowTracer
+
+    best_off = best_on = 0.0
+    ratios = []
+    best_tracer = None
+    for rep in range(5):
+        tr = RowTracer(worker=f"bench{rep}", sample=sample, seed=0)
+        if rep % 2 == 0:
+            off = _stream_run(pipe, texts, batch_size, depth, n_msgs)
+            on = _stream_run(pipe, texts, batch_size, depth, n_msgs,
+                             rowtrace=tr)
+        else:
+            on = _stream_run(pipe, texts, batch_size, depth, n_msgs,
+                             rowtrace=tr)
+            off = _stream_run(pipe, texts, batch_size, depth, n_msgs)
+        if off.msgs_per_sec > 0:
+            ratios.append(on.msgs_per_sec / off.msgs_per_sec)
+        best_off = max(best_off, off.msgs_per_sec)
+        if on.msgs_per_sec >= best_on:
+            best_on, best_tracer = on.msgs_per_sec, tr
+    snap = best_tracer.snapshot()
+    return {
+        "rows": n_msgs,
+        "sample": sample,
+        "untraced_msgs_per_s": round(best_off, 1),
+        "traced_msgs_per_s": round(best_on, 1),
+        # Median paired ratio; >= 0.95 is the acceptance bar (CI
+        # bench-smoke asserts it).
+        "ratio": round(median(ratios), 4) if ratios else None,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "spans": {k: snap[k] for k in
+                  ("spans_begun", "spans_ended", "kept", "sampled_out",
+                   "ring_dropped")},
+        "stages": best_tracer.stage_quantiles(),
     }
 
 
@@ -1614,6 +1676,20 @@ def main() -> int:
     # tight budget still captures the tentpole's evidence).
     harness.section("featurize", lambda scratch: featurize_bench(texts),
                     fraction=0.25, top_level=True)
+
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        # Tracing overhead pair + per-stage attribution (ISSUE 10): the
+        # traced arm's stage p50/p99 is the artifact's diagnosis surface,
+        # the off/on ratio the committed <=5% overhead evidence.
+        harness.section(
+            "trace",
+            lambda scratch: trace_overhead_bench(
+                pipe_or_raise(), texts, batch_size, depth,
+                # Longer than the headline runs on purpose: a +-5%
+                # comparison needs more than a couple hundred ms per arm
+                # on a contended host (the r04 lesson).
+                min(max(n_msgs, 60_000), 100_000)),
+            fraction=0.3)
 
     if model == "lr" and os.environ.get("BENCH_INT8", "1") != "0":
         # int8 scoring variant on the same stream: one run + a prediction-

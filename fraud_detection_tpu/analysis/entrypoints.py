@@ -65,6 +65,12 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     # Sanitizer workload driver: hammer threads racing the shard ABI on
     # purpose — TSan is the detector there, not racecheck.
     ("native/san_driver.py", "hammer"),
+    # Observability egress (obs/export.py, docs/observability.md):
+    # periodic --metrics-file dumper, the --metrics-port HTTP endpoint's
+    # serve thread, and the N-batch jax.profiler window watcher.
+    ("obs/export.py", "loop"),
+    ("obs/export.py", "serve_forever"),
+    ("obs/export.py", "watch"),
 })
 
 
@@ -133,6 +139,18 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
     EntryPoint("san-hammer", "native/san_driver.py", "hammer", None,
                "deliberately racing workload — the sanitizer runtime "
                "(ASan/TSan) is the detector"),
+    EntryPoint("metrics-writer", "obs/export.py", "loop", None,
+               "read-only: renders registry collectors (health() pulls) "
+               "and publishes via the atomic writer; mutates only its own "
+               "Counter, which locks internally"),
+    EntryPoint("metrics-http", "obs/export.py",
+               "ThreadingHTTPServer.serve_forever", None,
+               "stdlib HTTP server; handlers render the registry (same "
+               "read-only pull as the writer) — shared state is the "
+               "registry's own locked instruments"),
+    EntryPoint("profile-window", "obs/export.py", "watch", None,
+               "polls a batches counter and stops the jax profiler trace "
+               "once; all mutation behind the window's own lock"),
 )
 
 

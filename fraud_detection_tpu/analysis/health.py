@@ -64,6 +64,10 @@ CONTRACTS: Tuple[Contract, ...] = (
              "test_sched.py", "GOVERNOR_BLOCK_SCHEMA"),
     Contract("stream/annotations.py", "AsyncAnnotationLane.stats",
              "test_chaos.py", "ANNOTATION_STATS_SCHEMA"),
+    # Row-tracing health block (docs/observability.md): the engine's
+    # "trace" sub-object and the metrics exporter both serve it.
+    Contract("obs/trace.py", "RowTracer.snapshot",
+             "test_obs.py", "TRACE_BLOCK_SCHEMA"),
 )
 
 

@@ -43,13 +43,13 @@ def start_health_writer(path, interval, current_engines, fault_plan=None):
                             if e is not None]}
         if fault_plan is not None:
             snap["chaos"] = fault_plan.report()
-        tmp = f"{path}.tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(snap, f, indent=2)
-            os.replace(tmp, path)
-        except OSError:   # health reporting must never kill serving
-            pass
+        # Shared atomic writer (utils/atomicio.py): unique temp names per
+        # writer, so a second process pointed at the same health file can
+        # never tear it; failures swallowed inside (health reporting must
+        # never kill serving).
+        from fraud_detection_tpu.utils.atomicio import atomic_write_json
+
+        atomic_write_json(path, snap)
 
     stop = threading.Event()
 
@@ -251,6 +251,38 @@ def main(argv=None) -> int:
                          "at exit)")
     ap.add_argument("--health-interval", type=float, default=2.0,
                     help="seconds between --health-file dumps")
+    ap.add_argument("--metrics-file", default=None,
+                    help="periodically dump the unified metrics exporter "
+                         "to this path (atomic replace, final state at "
+                         "exit, exactly like --health-file): Prometheus "
+                         "text for .prom/.txt paths, JSON otherwise — "
+                         "every health() key maps in, ONE schema "
+                         "(docs/observability.md)")
+    ap.add_argument("--metrics-interval", type=float, default=2.0,
+                    help="seconds between --metrics-file dumps")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve /metrics (Prometheus text) and "
+                         "/metrics.json on this local port (stdlib HTTP, "
+                         "daemon thread; 0 picks a free port, printed at "
+                         "startup)")
+    ap.add_argument("--trace", action="store_true",
+                    help="row/batch tracing (obs/trace.py): correlation "
+                         "ids minted at poll ride every row to its "
+                         "terminal; flagged/shed/DLQ rows always keep "
+                         "their span chain, clean batches head-sample at "
+                         "--trace-sample; per-stage p50/p99 in health() "
+                         "and the exporter")
+    ap.add_argument("--trace-sample", type=float, default=0.05,
+                    help="fraction of CLEAN batches whose spans are kept "
+                         "(--trace; interesting batches are always kept)")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="capture jax.profiler traces: one around "
+                         "prewarm/ladder measurement, one over the first "
+                         "--profile-batches serving batches "
+                         "(TensorBoard/Perfetto readable)")
+    ap.add_argument("--profile-batches", type=int, default=50,
+                    help="batches in the serving-window profiler capture "
+                         "(--profile-dir)")
     ap.add_argument("--chaos", action="store_true",
                     help="demo mode only: run the in-process broker under a "
                          "seeded fault plan (poll errors, lossy flushes, "
@@ -386,6 +418,18 @@ def main(argv=None) -> int:
     if args.health_interval <= 0:
         raise SystemExit(
             f"--health-interval must be > 0, got {args.health_interval}")
+    if args.metrics_interval <= 0:
+        raise SystemExit(
+            f"--metrics-interval must be > 0, got {args.metrics_interval}")
+    if args.metrics_port is not None and args.metrics_port < 0:
+        raise SystemExit(
+            f"--metrics-port must be >= 0, got {args.metrics_port}")
+    if not 0.0 <= args.trace_sample <= 1.0:
+        raise SystemExit(
+            f"--trace-sample must be in [0, 1], got {args.trace_sample}")
+    if args.profile_batches < 1:
+        raise SystemExit(
+            f"--profile-batches must be >= 1, got {args.profile_batches}")
     if args.chaos and args.supervise == 0:
         # Chaos without supervision dies on the first injected fault by
         # design; default to enough restarts for the demo plan's budget.
@@ -509,8 +553,13 @@ def main(argv=None) -> int:
 
         from fraud_detection_tpu.sched import AdaptiveScheduler
 
+        from fraud_detection_tpu.utils.tracing import device_trace
+
         prewarmer = AdaptiveScheduler(sched_config, args.batch_size)
-        prewarmer.prewarm(pipe)
+        # --profile-dir: the prewarm/ladder measurement gets its own XLA
+        # profiler capture (compiles + rung timing, off the hot path).
+        with device_trace("prewarm", args.profile_dir):
+            prewarmer.prewarm(pipe)
         sched_config = dataclasses.replace(sched_config,
                                            buckets=tuple(prewarmer.buckets))
         sched_ladder_costs = prewarmer.ladder_costs
@@ -562,6 +611,56 @@ def main(argv=None) -> int:
     if args.dlq:
         dlq_topic = args.dlq_topic or f"{args.output_topic}-dlq"
 
+    # Unified metrics exporter (docs/observability.md): one registry,
+    # health() mapped in as collectors, published by file and/or HTTP.
+    metrics_registry = None
+    metrics_server = None
+    if args.metrics_file is not None or args.metrics_port is not None:
+        from fraud_detection_tpu.obs import MetricsRegistry
+
+        metrics_registry = MetricsRegistry()
+
+    def start_metrics():
+        """Start the --metrics-file writer + --metrics-port endpoint once
+        the collectors are registered; returns finish()."""
+        nonlocal metrics_server
+        if metrics_registry is None:
+            return lambda: None
+        from fraud_detection_tpu.obs.export import (MetricsServer,
+                                                    start_metrics_writer)
+
+        if args.metrics_port is not None:
+            metrics_server = MetricsServer(metrics_registry,
+                                           args.metrics_port)
+            print(f"metrics: http://127.0.0.1:{metrics_server.port}/metrics",
+                  flush=True)
+        finish_file = start_metrics_writer(args.metrics_file,
+                                           args.metrics_interval,
+                                           metrics_registry)
+
+        def finish():
+            finish_file()
+            if metrics_server is not None:
+                metrics_server.close()
+
+        return finish
+
+    # Row tracing (obs/trace.py): one tracer per worker, shared across a
+    # worker's supervised incarnations so chains survive restarts (same
+    # sharing contract as the DLQ poison tracker and the scheduler).
+    trace_per_worker: dict = {}
+
+    def rowtrace_for(worker: int):
+        if not args.trace:
+            return None
+        from fraud_detection_tpu.obs import RowTracer
+
+        tr = trace_per_worker.get(worker)
+        if tr is None:
+            tr = trace_per_worker[worker] = RowTracer(
+                worker=f"w{worker}", sample=args.trace_sample)
+        return tr
+
     if args.fleet > 0:
         # Fleet serving lane (docs/fleet.md): N partition-owning workers
         # under the lease coordinator, health on the fleet bus, shedding on
@@ -575,11 +674,18 @@ def main(argv=None) -> int:
             pipeline_depth=args.pipeline_depth,
             async_dispatch=args.async_dispatch,
             sched_config=sched_config, dlq_topic=dlq_topic,
-            health_file=args.fleet_health_file)
+            health_file=args.fleet_health_file,
+            trace=args.trace, trace_sample=args.trace_sample)
+        if metrics_registry is not None:
+            metrics_registry.add_collector("fleet", fleet.fleet_health)
+        finish_metrics = start_metrics()
         print(f"serving: model={model_desc} in={args.input_topic} "
               f"out={args.output_topic} batch={args.batch_size} "
               f"fleet={args.fleet} partitions={args.partitions}", flush=True)
-        out = fleet.run(idle_timeout=1.0)
+        try:
+            out = fleet.run(idle_timeout=1.0)
+        finally:
+            finish_metrics()
         print(json.dumps(out))
         n_out = broker.topic_size(args.output_topic)
         print(f"classified messages on {args.output_topic}: {n_out}")
@@ -650,7 +756,8 @@ def main(argv=None) -> int:
                                 breaker=breaker,
                                 shadow=shadow,
                                 scheduler=scheduler,
-                                async_dispatch=args.async_dispatch)
+                                async_dispatch=args.async_dispatch,
+                                rowtrace=rowtrace_for(worker))
         engines_built.append(e)
         return e
 
@@ -717,6 +824,17 @@ def main(argv=None) -> int:
         live = [None] * args.workers     # current engine, for Ctrl-C stop
         finish_health = start_health_writer(
             args.health_file, args.health_interval, lambda: live, fault_plan)
+        if metrics_registry is not None:
+            # One collector, every live worker's full health() — flattened
+            # with an index label per worker at render time.
+            metrics_registry.add_collector(
+                "engine", lambda: [e.health() for e in live if e is not None])
+        finish_metrics = start_metrics()
+        from fraud_detection_tpu.obs.export import start_profile_window
+
+        finish_profile = start_profile_window(
+            args.profile_dir, args.profile_batches,
+            lambda: sum(e.stats.batches for e in live if e is not None))
         # Cooperative shutdown: KeyboardInterrupt only reaches the MAIN
         # thread, so a supervised worker in its backoff sleep would rebuild
         # and keep consuming after the operator's Ctrl-C stopped its dead
@@ -814,6 +932,10 @@ def main(argv=None) -> int:
         lifecycle_out = finish_lifecycle()
         if lifecycle_out is not None:
             merged["lifecycle"] = lifecycle_out
+        profile = finish_profile()
+        if profile is not None:
+            merged["profile"] = profile
+        finish_metrics()
         finish_health()
         print(json.dumps(merged))
         if args.demo:
@@ -828,6 +950,16 @@ def main(argv=None) -> int:
     finish_health = start_health_writer(
         args.health_file, args.health_interval,
         lambda: engines_built[-1:], fault_plan)
+    if metrics_registry is not None:
+        metrics_registry.add_collector(
+            "engine", lambda: (engines_built[-1].health()
+                               if engines_built else None))
+    finish_metrics = start_metrics()
+    from fraud_detection_tpu.obs.export import start_profile_window
+
+    finish_profile = start_profile_window(
+        args.profile_dir, args.profile_batches,
+        lambda: engines_built[-1].stats.batches if engines_built else 0)
     gave_up = None
     if args.supervise > 0:
         # The supervisor builds and closes every consumer/producer itself
@@ -869,6 +1001,10 @@ def main(argv=None) -> int:
     lifecycle_out = finish_lifecycle()
     if lifecycle_out is not None:
         out["lifecycle"] = lifecycle_out
+    profile = finish_profile()
+    if profile is not None:
+        out["profile"] = profile
+    finish_metrics()
     finish_health()
     print(json.dumps(out))
     if args.demo:

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from fraud_detection_tpu.utils.atomicio import atomic_write_json
+
 _FLEET_FILE = "fleet.json"
 _WORKER_PREFIX = "worker-"
 
@@ -119,14 +121,12 @@ class FleetBus:
     # ------------------------------------------------------------------
 
     def _write(self, name: str, obj: dict) -> None:
-        path = os.path.join(self.dir, name)
-        tmp = f"{path}.tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(obj, f, indent=2)
-            os.replace(tmp, path)
-        except (OSError, TypeError, ValueError):
-            pass   # bus publishing must never kill serving
+        # Shared atomic writer (utils/atomicio.py): unique temp names mean
+        # two processes publishing the same worker id (a stale twin after
+        # a botched restart) can interleave without tearing the file —
+        # the old fixed ".tmp" name here could. Failures swallowed: bus
+        # publishing must never kill serving.
+        atomic_write_json(os.path.join(self.dir, name), obj)
 
     def _read(self, name: str) -> Optional[dict]:
         try:

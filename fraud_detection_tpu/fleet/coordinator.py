@@ -38,6 +38,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from fraud_detection_tpu.obs.trace import fleet_stage_latency
+
 
 @dataclass(frozen=True)
 class Lease:
@@ -271,6 +273,7 @@ class FleetCoordinator:
         backlogs: Dict[str, int] = {}
         shed_total = 0
         processed_total = 0
+        stage_wires: List[dict] = []
         for wid, entry in snaps.items():
             if wid not in members:
                 continue    # departed/expired worker's stale publish
@@ -281,6 +284,9 @@ class FleetCoordinator:
             engine = doc.get("engine") or {}
             shed_total += engine.get("shed") or 0
             processed_total += engine.get("processed") or 0
+            obs = doc.get("obs") or {}
+            if isinstance(obs.get("stages"), dict):
+                stage_wires.append(obs["stages"])
         global_backlog = sum(backlogs.values()) if backlogs else None
         if global_backlog is not None:
             self._peak_backlog = max(self._peak_backlog, global_backlog)
@@ -302,6 +308,12 @@ class FleetCoordinator:
             "shed_total": shed_total,
             "processed_total": processed_total,
             "committed_lag": self.committed_lag(),
+            # Fleet-level p50/p99 per pipeline stage: the workers' sketch
+            # wires merge LOSSLESSLY (bucket counts add — obs/trace.py),
+            # so this equals a single-process run over the same samples.
+            # None when no worker is tracing.
+            "stage_latency_ms": (fleet_stage_latency(stage_wires)
+                                 if stage_wires else None),
         }
         with self._lock:
             self._last_view = view

@@ -15,8 +15,6 @@ the configuration the tests, the bench, and the demo CLI all share
 
 from __future__ import annotations
 
-import json
-import os
 import threading
 import time
 from typing import Callable, List, Optional
@@ -24,8 +22,10 @@ from typing import Callable, List, Optional
 from fraud_detection_tpu.fleet.bus import FleetBus
 from fraud_detection_tpu.fleet.coordinator import FleetCoordinator
 from fraud_detection_tpu.fleet.worker import FleetWorker
+from fraud_detection_tpu.obs.trace import RowTracer
 from fraud_detection_tpu.stream.engine import StreamStats, _merge_stats
 from fraud_detection_tpu.utils import get_logger
+from fraud_detection_tpu.utils.atomicio import atomic_write_json
 
 log = get_logger("fleet")
 
@@ -44,6 +44,9 @@ class Fleet:
                  heartbeat_interval: float = 0.2,
                  tick_interval: float = 0.2,
                  health_file: Optional[str] = None,
+                 trace: bool = False,
+                 trace_sample: float = 1.0,
+                 trace_seed: Optional[int] = None,
                  worker_prefix: str = "w"):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
@@ -57,12 +60,22 @@ class Fleet:
         self.death_plan = death_plan
         self.tick_interval = tick_interval
         self.health_file = health_file
+        # Row tracing (docs/observability.md): one RowTracer per worker,
+        # shared across that worker's engine incarnations — make_engine
+        # factories look it up via ``tracers`` (Fleet.in_process wires it
+        # automatically) and the workers publish stage-sketch wires on
+        # the bus for the coordinator's fleet-level merge.
+        self.tracers = ({f"{worker_prefix}{i}": RowTracer(
+                            worker=f"{worker_prefix}{i}",
+                            sample=trace_sample, seed=trace_seed)
+                         for i in range(n_workers)} if trace else {})
         self.workers: List[FleetWorker] = [
             FleetWorker(f"{worker_prefix}{i}", self.coordinator, self.bus,
                         make_engine,
                         self._bind_consumer_factory(make_consumer),
                         death_plan=death_plan,
-                        heartbeat_interval=heartbeat_interval)
+                        heartbeat_interval=heartbeat_interval,
+                        rowtrace=self.tracers.get(f"{worker_prefix}{i}"))
             for i in range(n_workers)]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -90,7 +103,10 @@ class Fleet:
                    lease_ttl: float = 5.0,
                    heartbeat_interval: float = 0.05,
                    tick_interval: float = 0.05,
-                   health_file: Optional[str] = None) -> "Fleet":
+                   health_file: Optional[str] = None,
+                   trace: bool = False,
+                   trace_sample: float = 1.0,
+                   trace_seed: Optional[int] = None) -> "Fleet":
         """A fleet over an InProcessBroker: assigned consumers with the
         coordinator's commit fence, group-lag drain signal, one shared
         scoring pipeline, and (with ``sched_config``) a per-worker adaptive
@@ -128,7 +144,11 @@ class Fleet:
                 batch_size=batch_size, max_wait=max_wait,
                 pipeline_depth=pipeline_depth,
                 async_dispatch=async_dispatch,
-                scheduler=scheduler, dlq_topic=dlq_topic)
+                scheduler=scheduler, dlq_topic=dlq_topic,
+                # One tracer per worker, shared across incarnations —
+                # chains and stage sketches survive rebalances exactly
+                # like the scheduler's SLO window does.
+                rowtrace=fleet_holder["fleet"].tracers.get(worker_id))
 
         fleet = cls(
             n_workers, make_engine, make_consumer,
@@ -136,7 +156,8 @@ class Fleet:
             bus=FleetBus(dir=bus_dir), lease_ttl=lease_ttl,
             lag_fn=lambda: broker.group_lag(group_id, [input_topic]),
             death_plan=death_plan, heartbeat_interval=heartbeat_interval,
-            tick_interval=tick_interval, health_file=health_file)
+            tick_interval=tick_interval, health_file=health_file,
+            trace=trace, trace_sample=trace_sample, trace_seed=trace_seed)
         fleet_holder["fleet"] = fleet
         return fleet
 
@@ -165,13 +186,9 @@ class Fleet:
         path = self.health_file
         if path is None:
             return
-        tmp = f"{path}.tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(self.fleet_health(), f, indent=2)
-            os.replace(tmp, path)
-        except OSError:
-            pass    # health reporting must never kill serving
+        # Shared atomic writer: failures swallowed inside (health
+        # reporting must never kill serving).
+        atomic_write_json(path, self.fleet_health())
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.tick_interval):
@@ -252,4 +269,13 @@ class Fleet:
         }
         if self.death_plan is not None:
             out["death_plan"] = self.death_plan.report()
+        if self.tracers:
+            # Final fleet-level stage attribution straight from the
+            # tracers (the post-drain coordinator tick sees no members —
+            # workers retract their bus docs as they leave); lossless, so
+            # it equals a single-process run over the same samples.
+            from fraud_detection_tpu.obs.trace import fleet_stage_latency
+
+            out["stage_latency_ms"] = fleet_stage_latency(
+                [t.stages_wire() for t in self.tracers.values()])
         return out

@@ -70,7 +70,7 @@ class FleetWorker:
     def __init__(self, worker_id: str, coordinator, bus,
                  make_engine: Callable, make_consumer: Callable, *,
                  death_plan=None, heartbeat_interval: float = 0.2,
-                 clock=time.monotonic):
+                 rowtrace=None, clock=time.monotonic):
         if heartbeat_interval <= 0:
             raise ValueError(
                 f"heartbeat_interval must be > 0, got {heartbeat_interval}")
@@ -83,6 +83,12 @@ class FleetWorker:
         self.make_consumer = make_consumer
         self.death_plan = death_plan
         self.heartbeat_interval = heartbeat_interval
+        # Optional obs.trace.RowTracer shared by this worker's engine
+        # incarnations (Fleet wires the SAME tracer into make_engine):
+        # every bus publish then carries the worker's per-stage sketch
+        # wires, which the coordinator merges losslessly into fleet-level
+        # p50/p99 per stage (docs/observability.md).
+        self.rowtrace = rowtrace
         self._clock = clock
         self.stats = StreamStats()
         self.incarnations = 0
@@ -178,6 +184,10 @@ class FleetWorker:
             "backlog": backlog,
             "dead": None if self.death is None else self.death.mode,
             "engine": engine_health,
+            # Lossless per-stage sketch wires for the coordinator's
+            # fleet-level stage-latency merge (None when not tracing).
+            "obs": ({"stages": self.rowtrace.stages_wire()}
+                    if self.rowtrace is not None else None),
         })
 
     def run(self, idle_timeout: Optional[float] = None) -> StreamStats:

@@ -1,0 +1,21 @@
+"""Observability: row/batch tracing, unified metrics, telemetry export.
+
+The serving tree's attribution layer (docs/observability.md): correlation
+ids minted at poll ride every row to its terminal, per-stage wall time
+feeds mergeable quantile sketches, and one metrics registry maps every
+``health()`` block into Prometheus text + JSON served by file, HTTP, and
+the fleet bus.
+"""
+
+from fraud_detection_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                             MetricsRegistry, leaf_paths,
+                                             metric_name, parse_prometheus)
+from fraud_detection_tpu.obs.trace import (BatchTrace, RowTracer, Span,
+                                           SpanRing, aggregate_stage_wires,
+                                           fleet_stage_latency)
+
+__all__ = [
+    "BatchTrace", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "RowTracer", "Span", "SpanRing", "aggregate_stage_wires",
+    "fleet_stage_latency", "leaf_paths", "metric_name", "parse_prometheus",
+]

@@ -1,0 +1,188 @@
+"""Metric/trace egress: atomic file publication, a scrape endpoint, and
+JAX profiler capture windows.
+
+Three small adapters from the in-process registry to the outside world:
+
+* :func:`start_metrics_writer` — the ``--metrics-file`` dumper, the exact
+  shape of serve's ``--health-file`` writer (periodic + final write,
+  atomic publication via ``utils.atomicio``): a ``.prom``/``.txt`` path
+  gets Prometheus text, anything else the JSON rendering.
+* :class:`MetricsServer` — a ``--metrics-port`` stdlib HTTP endpoint
+  (``/metrics`` Prometheus text, ``/metrics.json`` JSON) on a daemon
+  thread; ``port=0`` binds an ephemeral port (tests read ``.port``).
+  Scrapes are counted through the registry's own counter, so the exporter
+  observes itself.
+* :func:`start_profile_window` — an N-batch ``jax.profiler`` capture
+  started when serving begins and stopped once the engine has delivered
+  ``n_batches`` (or at shutdown): ``serve --profile-dir`` hands the
+  TensorBoard/Perfetto trace of exactly the warmed steady state instead
+  of a compile-noise-dominated whole run. Prewarm/ladder measurement gets
+  its own capture via ``utils.tracing.device_trace`` at the call site.
+
+Everything here follows the observability prime directive: failures are
+logged/counted, never raised into serving.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from fraud_detection_tpu.obs.metrics import MetricsRegistry
+from fraud_detection_tpu.utils import get_logger
+from fraud_detection_tpu.utils.atomicio import (atomic_write_json,
+                                                atomic_write_text)
+
+log = get_logger("obs.export")
+
+
+def write_metrics(path: str, registry: MetricsRegistry) -> bool:
+    """One atomic metrics publication; format chosen by extension
+    (``.prom``/``.txt`` -> Prometheus text, else JSON)."""
+    if path.endswith((".prom", ".txt")):
+        return atomic_write_text(path, registry.render_prometheus())
+    return atomic_write_json(path, registry.render_json())
+
+
+def start_metrics_writer(path: Optional[str], interval: float,
+                         registry: MetricsRegistry) -> Callable[[], None]:
+    """Periodic ``--metrics-file`` dumper; returns ``finish()`` which
+    stops the thread and writes the FINAL state (call it on every exit
+    path, like the health writer's). No-op when ``path`` is None."""
+    if path is None:
+        return lambda: None
+    writes = registry.counter("metrics_file_writes",
+                              "metrics-file publications")
+
+    def dump() -> None:
+        if write_metrics(path, registry):
+            writes.inc()
+
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval):
+            dump()
+
+    thread = threading.Thread(target=loop, daemon=True,
+                              name="metrics-writer")
+    thread.start()
+
+    def finish() -> None:
+        stop.set()
+        thread.join(timeout=5.0)
+        dump()
+
+    return finish
+
+
+class MetricsServer:
+    """Stdlib HTTP scrape endpoint for one registry (see module doc)."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.registry = registry
+        scrapes = registry.counter("metrics_scrapes", "HTTP scrapes served")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib handler contract
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = outer.registry.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?", 1)[0] == "/metrics.json":
+                    import json as _json
+
+                    body = _json.dumps(outer.registry.render_json()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                scrapes.inc()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="metrics-http")
+        self._thread.start()
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001 — shutdown must never raise
+            pass
+        self._thread.join(timeout=5.0)
+
+
+def start_profile_window(profile_dir: Optional[str], n_batches: int,
+                         batches_fn: Callable[[], int], *,
+                         poll_interval: float = 0.05
+                         ) -> Callable[[], Optional[dict]]:
+    """Capture a ``jax.profiler`` trace of the first ``n_batches``
+    delivered batches (measured through ``batches_fn``, e.g.
+    ``lambda: engine.stats.batches``). Returns ``finish()`` -> a small
+    report dict (or None when disabled/failed); ``finish`` also stops the
+    capture early at shutdown so a short run still leaves a valid trace.
+    Zero-cost no-op when ``profile_dir`` is None."""
+    if profile_dir is None:
+        return lambda: None
+    state = {"stopped": False, "error": None, "batches": 0}
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def _stop_trace() -> None:
+        with lock:
+            if state["stopped"]:
+                return
+            state["stopped"] = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — profiling must never kill serving
+            state["error"] = repr(e)
+
+    try:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+    except Exception as e:  # noqa: BLE001
+        log.warning("profiler trace unavailable: %r", e)
+        return lambda: {"dir": profile_dir, "error": repr(e), "batches": 0}
+
+    def watch() -> None:
+        while not stop.wait(poll_interval):
+            try:
+                n = int(batches_fn())
+            except Exception:  # noqa: BLE001
+                n = 0
+            state["batches"] = n
+            if n >= n_batches:
+                break
+        _stop_trace()
+
+    thread = threading.Thread(target=watch, daemon=True,
+                              name="profile-window")
+    thread.start()
+
+    def finish() -> Optional[dict]:
+        stop.set()
+        thread.join(timeout=5.0)
+        _stop_trace()
+        return {"dir": profile_dir, "target_batches": n_batches,
+                "batches": state["batches"], "error": state["error"]}
+
+    return finish

@@ -154,13 +154,16 @@ class AdmissionController:
             f = self.shed_fraction * self.shed_decay
             self.shed_fraction = f if f > 1e-3 else 0.0
 
-    def admit(self, msgs: List, backlog: Optional[int]
-              ) -> Tuple[List, List[Tuple[object, str]]]:
+    def admit(self, msgs: List, backlog: Optional[int],
+              trace=None) -> Tuple[List, List[Tuple[object, str]]]:
         """Split a polled batch into (kept, [(msg, shed_reason)]).
 
         ``backlog`` is the rows still queued BEHIND this batch at the broker
         (None when the transport can't report it — watermark shedding is
-        then inert and only rate/SLO shedding applies)."""
+        then inert and only rate/SLO shedding applies). ``trace`` is the
+        batch's obs.trace.BatchTrace when tracing is on: every shed row
+        records a correlation-id'd event AT the shed site, so its span
+        chain names the exact admission rule that diverted it."""
         self.last_backlog = backlog
         if not msgs:
             return msgs, []
@@ -176,6 +179,9 @@ class AdmissionController:
             nonlocal keep
             if n_keep < len(keep):
                 shed.extend((m, reason) for m in keep[n_keep:])
+                if trace is not None:
+                    for m in keep[n_keep:]:
+                        trace.shed(m, reason)
                 self.counters[reason] += len(keep) - n_keep
                 keep = keep[:n_keep]
 
@@ -189,6 +195,9 @@ class AdmissionController:
             stale = [m for m in keep if 0.0 < m.timestamp < cutoff]
             if stale:
                 shed.extend((m, SHED_DEADLINE) for m in stale)
+                if trace is not None:
+                    for m in stale:
+                        trace.shed(m, SHED_DEADLINE)
                 self.counters[SHED_DEADLINE] += len(stale)
                 keep = [m for m in keep
                         if not 0.0 < m.timestamp < cutoff]

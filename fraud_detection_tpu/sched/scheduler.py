@@ -193,10 +193,10 @@ class AdaptiveScheduler:
                 return max(int(g), local if local is not None else 0)
         return local
 
-    def admit(self, msgs: List, backlog: Optional[int]
-              ) -> Tuple[List, List[Tuple[object, str]]]:
+    def admit(self, msgs: List, backlog: Optional[int],
+              trace=None) -> Tuple[List, List[Tuple[object, str]]]:
         with self._region:
-            return self.admission.admit(msgs, backlog)
+            return self.admission.admit(msgs, backlog, trace=trace)
 
     def observe_batch(self, n_rows: int, batch_sec: float,
                       row_latencies: Optional[Sequence[float]] = None) -> None:

@@ -101,6 +101,43 @@ class LatencySketch:
             self.sum += total
             self.max = max(self.max, mx)
 
+    def to_wire(self) -> dict:
+        """JSON-safe sparse encoding of the full sketch (non-zero bucket
+        indexes + counts + exact aggregates). ``from_wire`` round-trips it
+        losslessly, which is what lets fleet workers publish sketches on
+        the bus and the coordinator merge them into EXACTLY the sketch a
+        single process would have built (obs/trace.py aggregation)."""
+        with self._lock:
+            idx = np.flatnonzero(self._counts)
+            return {"v": 1,
+                    "idx": idx.tolist(),
+                    "counts": self._counts[idx].tolist(),
+                    "count": self.count,
+                    "sum": self.sum,
+                    "max": self.max}
+
+    @classmethod
+    def from_wire(cls, wire) -> Optional["LatencySketch"]:
+        """Rebuild a sketch from :meth:`to_wire` output; None on any
+        malformed/foreign payload (bus docs cross process boundaries —
+        telemetry ingest must never raise)."""
+        try:
+            if not isinstance(wire, dict) or wire.get("v") != 1:
+                return None
+            sk = cls()
+            idx = np.asarray(wire["idx"], np.int64)
+            counts = np.asarray(wire["counts"], np.int64)
+            if idx.shape != counts.shape or (
+                    idx.size and (idx.min() < 0 or idx.max() >= _N_BUCKETS)):
+                return None
+            sk._counts[idx] = counts
+            sk.count = int(wire["count"])
+            sk.sum = float(wire["sum"])
+            sk.max = float(wire["max"])
+            return sk
+        except (KeyError, TypeError, ValueError):
+            return None
+
     def snapshot(self) -> dict:
         """p50/p95/p99/max/mean in milliseconds + count, one consistent read."""
         with self._lock:

@@ -59,11 +59,19 @@ class AsyncAnnotationLane:
 
     def __init__(self, explain_batch_fn: Callable, producer, topic: str, *,
                  max_queue: int = 1024, max_batch: int = 64,
+                 rowtrace=None,
                  clock: Callable[[], float] = time.perf_counter):
         if max_queue < 1 or max_batch < 1:
             raise ValueError(
                 f"max_queue/max_batch must be >= 1, got {max_queue}/{max_batch}")
         self._clock = clock   # injectable: drain/close deadlines in tests
+        # Optional obs.trace.RowTracer: items may carry a 5th element (the
+        # row's correlation id), and the lane then records an "explain"
+        # span per backend call plus an "annotate" event per row — ok=False
+        # on backend errors AND breaker fast-fails, so a flagged row's
+        # chain shows exactly where its explanation died. Flagged rows are
+        # always-kept by the tracer, so these record directly to the ring.
+        self._rowtrace = rowtrace
         self._fn = explain_batch_fn
         self._producer = producer
         self.topic = topic
@@ -93,7 +101,8 @@ class AsyncAnnotationLane:
         self._thread.start()
 
     def submit(self, items: List[tuple]) -> None:
-        """Enqueue (key, text, label, confidence) rows; never blocks.
+        """Enqueue (key, text, label, confidence[, trace_cid]) rows;
+        never blocks.
 
         Over capacity, the OLDEST queued rows are dropped (and counted) —
         under sustained overload the lane annotates a sliding recent sample.
@@ -132,18 +141,44 @@ class AsyncAnnotationLane:
                               "classification unaffected", len(batch))
 
     def _annotate(self, batch: List[tuple]) -> None:
-        keys, texts, labels, confs = map(list, zip(*batch))
-        analyses = self._fn(texts, labels, confs)
+        # Items are (key, text, label, conf[, cid]) — the correlation id
+        # rides only when the engine traces; normalize for both shapes.
+        batch = [it if len(it) == 5 else (*it, None) for it in batch]
+        keys, texts, labels, confs, cids = map(list, zip(*batch))
+        tr = self._rowtrace
+        t0 = time.perf_counter()
+        try:
+            analyses = self._fn(texts, labels, confs)
+        except Exception as e:
+            if tr is not None:
+                # One failed explain span for the batch + a failed
+                # annotate event per traced row: breaker fast-fails
+                # (BreakerOpenError) land here too, so breaker-tripped
+                # rows keep a complete chain by id.
+                tr.record_span("lane", "explain",
+                               time.perf_counter() - t0, ok=False,
+                               detail=type(e).__name__)
+                for cid in cids:
+                    if cid is not None:
+                        tr.record_event(cid, "annotate", ok=False,
+                                        detail=type(e).__name__)
+            raise
+        if tr is not None:
+            tr.record_span("lane", "explain", time.perf_counter() - t0,
+                           detail=f"rows={len(batch)}")
         if len(analyses) != len(batch):  # mirrors the engine's inline check
             raise ValueError(f"explain_batch_fn returned {len(analyses)} "
                              f"analyses for {len(batch)} rows")
         out = []
-        for key, label, conf, analysis in zip(keys, labels, confs, analyses):
+        out_cids = []
+        for key, label, conf, cid, analysis in zip(keys, labels, confs,
+                                                   cids, analyses):
             if analysis is None:
                 continue
             rec = {"prediction": label, "label": label_name(label),
                    "confidence": round(conf, 6), "analysis": analysis}
             out.append((json.dumps(rec).encode(), key))
+            out_cids.append(cid)
         if out:
             batch_produce = getattr(self._producer, "produce_batch", None)
             if batch_produce is not None:
@@ -170,6 +205,11 @@ class AsyncAnnotationLane:
             # flightcheck: ignore[FC102] — worker-thread-only tally, read-racy by design
             self.annotated = max(self.annotated,
                                  self.produced - int(undelivered))
+            if self._rowtrace is not None:
+                for cid in out_cids:
+                    if cid is not None:
+                        self._rowtrace.record_event(
+                            cid, "annotate", ok=not undelivered)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until the queue is empty and the worker is idle (or

@@ -101,10 +101,14 @@ def _malformed_wire(msg: Message) -> bytes:
 
 
 def _dlq_record(msg: Message, reason: str, error: str,
-                attempts: Optional[int] = None) -> bytes:
+                attempts: Optional[int] = None,
+                trace: Optional[str] = None) -> bytes:
     """Structured dead-letter record (docs/robustness.md schema): why the
     row was diverted plus enough source coordinates to find and replay it.
-    Keyed by the source message's key, so DLQ consumers can join back."""
+    Keyed by the source message's key, so DLQ consumers can join back.
+    ``trace`` is the row's correlation id when tracing is on
+    (docs/observability.md): the record joins back to its span chain by
+    id, not just by source coordinates."""
     rec = {
         "reason": reason,
         "error": error,
@@ -114,6 +118,8 @@ def _dlq_record(msg: Message, reason: str, error: str,
     }
     if attempts is not None:
         rec["attempts"] = attempts
+    if trace is not None:
+        rec["trace"] = trace
     return json.dumps(rec).encode()
 
 
@@ -235,6 +241,7 @@ class StreamingClassifier:
         shadow: Optional[object] = None,
         scheduler: Optional[object] = None,
         async_dispatch: bool = False,
+        rowtrace: Optional[object] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if pipeline_depth < 1:
@@ -290,7 +297,8 @@ class StreamingClassifier:
 
             self._annotation_lane = AsyncAnnotationLane(
                 explain_batch_fn, annotations_producer,
-                annotations_topic or f"{output_topic}-annotations")
+                annotations_topic or f"{output_topic}-annotations",
+                rowtrace=rowtrace)
             self.explain_fn = explain_fn = None
             self.explain_batch_fn = explain_batch_fn = None
         # Optional utils.tracing.Tracer: per-batch "dispatch" / "finish"
@@ -298,6 +306,14 @@ class StreamingClassifier:
         # for profiling beyond StreamStats' aggregate latencies. None = the
         # hot loop pays nothing.
         self.tracer = tracer
+        # Optional obs.trace.RowTracer (docs/observability.md): a
+        # correlation id is minted per polled batch and rides every row to
+        # its terminal — batch stage spans (poll/admit/launch/device/
+        # deliver) plus row events for the interesting minority (shed,
+        # dlq, flag), committed to the tracer's ring at delivery. Share
+        # ONE tracer across a worker's supervised incarnations (like
+        # dlq_attempts) so chains survive restarts. None = zero cost.
+        self._rowtrace = rowtrace
         # Dead-letter routing (docs/robustness.md): when ``dlq_topic`` is
         # set, malformed rows and rows re-delivered more than
         # ``dlq_max_attempts`` times without a successful batch go to the
@@ -409,6 +425,11 @@ class StreamingClassifier:
         thread — admission shares region-guarded scheduler state and the
         poison tracker with the rest of the drive loop."""
         t0 = time.perf_counter()
+        # Correlation id minted at poll (docs/observability.md): this
+        # batch's trace context, handed through _Prep/_InFlight to every
+        # later leg — admission below records shed row events into it.
+        bt = (self._rowtrace.batch_begin(len(msgs))
+              if self._rowtrace is not None else None)
         # Offsets cover the ORIGINAL batch — rows screened out below are
         # handled (their DLQ record ships with this batch) and must commit.
         offsets: dict = {}
@@ -425,14 +446,15 @@ class StreamingClassifier:
             # rides THIS batch's delivery/commit (exactly like poison/
             # malformed DLQ records), so key-set accounting stays exact.
             keep, shed_rows = self._sched.admit(
-                msgs, self._sched.backlog_of(self.consumer))
+                msgs, self._sched.backlog_of(self.consumer), trace=bt)
             if shed_rows:
                 dead, dead_reasons = [], {}
                 for m, reason in shed_rows:
                     dead.append((_dlq_record(
                         m, reason,
                         "shed by admission control (docs/scheduling.md); "
-                        "replay from the DLQ record's source coordinates"),
+                        "replay from the DLQ record's source coordinates",
+                        trace=(bt.row_cid(m) if bt is not None else None)),
                         m.key))
                     dead_reasons[reason] = dead_reasons.get(reason, 0) + 1
                 shed_n = len(shed_rows)
@@ -440,9 +462,13 @@ class StreamingClassifier:
         if self._dlq_attempts is not None:
             if dead is None:
                 dead, dead_reasons = [], {}
-            msgs = self._screen_poison(msgs, dead, dead_reasons)
+            msgs = self._screen_poison(msgs, dead, dead_reasons, bt)
+        prep_time = time.perf_counter() - t0
+        if bt is not None:
+            bt.add("admit", prep_time,
+                   detail=f"kept={len(msgs)} shed={shed_n}")
         return _Prep(msgs, offsets, dead, dead_reasons, shed_n,
-                     time.perf_counter() - t0)
+                     prep_time, bt)
 
     def _launch(self, prep: "_Prep") -> "_InFlight":
         """Featurize + device dispatch for a prepared batch; does NOT block
@@ -468,6 +494,12 @@ class StreamingClassifier:
                        if valid_idx else None)
             inflight = _InFlight(msgs, texts, valid_idx, pending, offsets,
                                  time.perf_counter() - t0)
+        inflight.trace = prep.trace
+        if prep.trace is not None:
+            # The featurize+upload+launch leg, measured before prep time
+            # folds in (this may run on the lane thread — the trace is
+            # handed off with the batch, strictly FIFO, never shared).
+            prep.trace.add("launch", inflight.dispatch_time)
         inflight.dispatch_time += prep.prep_time
         if prep.dead:
             inflight.dead = prep.dead
@@ -484,7 +516,8 @@ class StreamingClassifier:
         return inflight
 
     def _screen_poison(self, msgs: List[Message], dead: List[tuple],
-                       dead_reasons: dict) -> List[Message]:
+                       dead_reasons: dict,
+                       bt: Optional[object] = None) -> List[Message]:
         """Count this delivery against each row and divert rows whose count
         exceeded ``dlq_max_attempts`` — a row that keeps being re-delivered
         is one whose batch keeps dying (crash/flush-fail replays), and
@@ -504,7 +537,9 @@ class StreamingClassifier:
                     m, "max_attempts_exceeded",
                     f"re-delivered {n} times without a successful batch "
                     f"(dlq_max_attempts={self.dlq_max_attempts})",
-                    attempts=n), m.key))
+                    attempts=n,
+                    trace=(bt.dlq(m, "max_attempts_exceeded")
+                           if bt is not None else None)), m.key))
                 dead_reasons["max_attempts_exceeded"] = (
                     dead_reasons.get("max_attempts_exceeded", 0) + 1)
             else:
@@ -552,7 +587,17 @@ class StreamingClassifier:
         flush, commit that batch's offsets. Returns messages handled."""
         t1 = time.perf_counter()
         msgs, texts = inflight.msgs, inflight.texts
-        preds = inflight.pending.resolve() if inflight.pending is not None else None
+        bt = inflight.trace
+        if inflight.pending is None:
+            preds = None
+        elif bt is not None:
+            with bt.span("device"):
+                preds = inflight.pending.resolve()
+        else:
+            preds = inflight.pending.resolve()
+
+        if bt is not None and preds is not None:
+            self._trace_flags(inflight, preds)
 
         if preds is not None and self._annotation_lane is not None:
             self._submit_annotations(inflight, preds)
@@ -661,6 +706,7 @@ class StreamingClassifier:
         flag_idx = flagged.tolist()
         flag_labels = labels[flagged].tolist()
         flag_confs = confs[flagged].tolist()
+        bt = inflight.trace
         items = []
         if inflight.raw:
             # Predictions are positional over ALL rows; malformed rows hold
@@ -671,14 +717,44 @@ class StreamingClassifier:
                     continue
                 text = self._annotation_text(inflight, i)
                 if text is not None:
-                    items.append((inflight.msgs[i].key, text, label, conf))
+                    items.append((inflight.msgs[i].key, text, label, conf,
+                                  bt.row_cid(inflight.msgs[i])
+                                  if bt is not None else None))
         else:
             for j, label, conf in zip(flag_idx, flag_labels, flag_confs):
                 i = inflight.valid_idx[j]
                 items.append((inflight.msgs[i].key, inflight.texts[i],
-                              label, conf))
+                              label, conf,
+                              bt.row_cid(inflight.msgs[i])
+                              if bt is not None else None))
         if items:
             self._annotation_lane.submit(items)
+
+    def _trace_flags(self, inflight: "_InFlight", preds) -> None:
+        """Row events for this batch's flagged (non-benign) rows: flagged
+        rows are ALWAYS kept by the tracer (head sampling only throttles
+        clean traffic), and the event carries the row's correlation id so
+        its whole poll->terminal chain is retrievable. Batched host
+        conversion, like every per-row loop on this path (FC203)."""
+        bt = inflight.trace
+        labels = np.asarray(preds.labels)
+        flagged = np.flatnonzero(labels != 0)
+        if flagged.size == 0:
+            return
+        if not inflight.raw:
+            idxs = [inflight.valid_idx[j] for j in flagged.tolist()]
+        elif len(inflight.valid_idx) == len(inflight.msgs):
+            idxs = flagged.tolist()     # all valid: the common case
+        else:
+            # Predictions are positional over ALL rows; malformed rows
+            # hold padding garbage — keep valid ones only.
+            valid = frozenset(inflight.valid_idx)
+            idxs = [i for i in flagged.tolist() if i in valid]
+        # Compact batched record (one lock, one ring entry): int pairs
+        # only — cid strings materialize at read time, never here.
+        msgs = inflight.msgs
+        bt.events_rows("flag", [(m.partition, m.offset)
+                                for m in map(msgs.__getitem__, idxs)])
 
     def _submit_shadow(self, inflight: "_InFlight", preds) -> None:
         """Offer this batch's valid rows + primary results to the shadow
@@ -711,8 +787,11 @@ class StreamingClassifier:
         never advance past a lost DLQ record either)."""
         if inflight.dead is None:
             inflight.dead, inflight.dead_reasons = [], {}
-        inflight.dead.append((_dlq_record(msg, reason, error, attempts),
-                              msg.key))
+        bt = inflight.trace
+        inflight.dead.append((_dlq_record(
+            msg, reason, error, attempts,
+            trace=(bt.dlq(msg, reason) if bt is not None else None)),
+            msg.key))
         inflight.dead_reasons[reason] = inflight.dead_reasons.get(reason, 0) + 1
 
     def _annotation_text(self, inflight: "_InFlight", i: int) -> Optional[str]:
@@ -794,6 +873,10 @@ class StreamingClassifier:
                         if breaker is not None and hasattr(breaker, "snapshot")
                         else None),
             "model": model,
+            # Row-tracing accounting (obs/trace.py): span begun/ended
+            # counters, ring depth/drops, per-stage latency quantiles.
+            "trace": (self._rowtrace.snapshot()
+                      if self._rowtrace is not None else None),
         }
 
     def _device_block(self) -> dict:
@@ -835,6 +918,17 @@ class StreamingClassifier:
         leaves the lane up so repeated runs share it."""
         lane = self._annotation_lane
         return lane.close(timeout) if lane is not None else True
+
+    def _abort_traces(self, batches, reason: str) -> None:
+        """Close the traces of batches being discarded (crash / flush-fail
+        replay paths): every minted batch reaches a terminal, so the
+        tracer's begun==ended and traced==closed accounting stays exact
+        even when the batches themselves are abandoned. Accepts _Prep and
+        _InFlight alike; abort is idempotent."""
+        if self._rowtrace is None:
+            return
+        for b in batches:
+            self._rowtrace.abort(b.trace, reason)
 
     def _native_frames(self) -> bool:
         """Native output-frame assembly available? (cached after first ask)"""
@@ -892,6 +986,8 @@ class StreamingClassifier:
     def _deliver(self, inflight: "_InFlight", wires: List[tuple],
                  t1: float) -> int:
         msgs = inflight.msgs
+        bt = inflight.trace
+        t_del = time.perf_counter() if bt is not None else 0.0
         produce_batch = getattr(self.producer, "produce_batch", None)
         if produce_batch is not None:
             produce_batch(self.output_topic, wires)
@@ -922,6 +1018,13 @@ class StreamingClassifier:
             self._flush_fail_streak += 1
             self._flush_failed = True
             self._running = False
+            if bt is not None:
+                # The batch will be replayed: close the deliver leg as
+                # failed and keep the whole trace (aborted batches are
+                # interesting by definition).
+                bt.add("deliver", time.perf_counter() - t_del, ok=False,
+                       detail=f"undelivered={undelivered}")
+                self._rowtrace.abort(bt, "flush_failed")
             return 0
         self._flush_fail_streak = 0
         try:
@@ -981,6 +1084,12 @@ class StreamingClassifier:
         if self.tracer is not None:
             self.tracer.record("dispatch", inflight.dispatch_time)
             self.tracer.record("finish", finish_dt)
+        if bt is not None:
+            # Terminal: the deliver leg closes and the batch's spans
+            # commit to the ring (kept when sampled or interesting).
+            bt.add("deliver", time.perf_counter() - t_del,
+                   detail=f"rows={len(wires)}")
+            self._rowtrace.commit(bt)
         return len(msgs) + inflight.dead_screened
 
     def process_batch(self, msgs: List[Message]) -> int:
@@ -1088,6 +1197,7 @@ class StreamingClassifier:
             # batches below: committing their (later) offsets would orphan the
             # interrupted batch's outputs. Leaving them uncommitted means a
             # restart replays them — at-least-once, as documented.
+            self._abort_traces(in_flight, "engine_abort")
             in_flight.clear()
             raise
         finally:
@@ -1097,6 +1207,7 @@ class StreamingClassifier:
             # failed batch's outputs.
             while in_flight and not self._flush_failed:
                 self._finish(in_flight.popleft())
+            self._abort_traces(in_flight, "discarded_after_flush_failure")
             self._inflight_depth = 0
             # The loop can exit via break with the flag still set; clear it
             # so health() reports a finished engine as not running.
@@ -1119,6 +1230,8 @@ class StreamingClassifier:
         lane = DispatchLane(self._launch, depth=self.pipeline_depth)
         self._lane = lane
         pending: "deque[_Prep]" = deque()   # submitted, not yet delivered
+        discarded: list = []                # abandoned batches (traces close
+                                            # after the lane thread joins)
         try:
             while self._running:
                 budget = self.batch_size
@@ -1161,7 +1274,10 @@ class StreamingClassifier:
         except BaseException:
             # Same abort contract as the sync loop: never finish newer
             # batches past an interrupted/failed one — leave them
-            # uncommitted for the restart to replay.
+            # uncommitted for the restart to replay. Their traces close
+            # below, AFTER lane.stop() joins the worker (the lane may
+            # still be appending spans to these batches' traces here).
+            discarded.extend(pending)
             pending.clear()
             raise
         finally:
@@ -1171,6 +1287,8 @@ class StreamingClassifier:
                     pending.popleft()
             finally:
                 lane.stop()
+                discarded.extend(pending)
+                self._abort_traces(discarded, "engine_abort")
                 self._lane_stats = lane.stats()
                 self._max_inflight = max(self._max_inflight,
                                          lane.max_inflight)
@@ -1192,6 +1310,7 @@ class _Prep:
     dead_reasons: Optional[dict]
     shed_n: int
     prep_time: float            # driver seconds spent preparing
+    trace: Optional[object] = None  # obs.trace.BatchTrace (tracing on)
 
     @property
     def n_rows(self) -> int:
@@ -1221,6 +1340,7 @@ class _InFlight:
     dead_screened: int = 0      # dead rows NOT in msgs (poison screen + shed)
     shed_n: int = 0             # of dead_screened, rows shed by admission
     recv_wall: float = 0.0      # wall-clock poll receipt (latency fallback)
+    trace: Optional[object] = None  # obs.trace.BatchTrace (tracing on)
 
 
 def run_supervised(make_engine: Callable[[], StreamingClassifier], *,

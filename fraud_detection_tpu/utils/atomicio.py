@@ -1,0 +1,62 @@
+"""Atomic file publication: tmp + ``os.replace``, torn-read proof.
+
+One helper shared by every periodic state dumper in the tree — serve's
+``--health-file`` and ``--metrics-file`` writers, the fleet bus files, the
+fleet health file — instead of four hand-rolled copies of the tmp+replace
+dance. Factoring them out also fixed a latent torn-read window the copies
+shared: they all used the FIXED temp name ``<path>.tmp``, so two writers
+publishing the same path (two serve processes pointed at one health file,
+or a fleet worker racing a stale twin after a botched restart) could
+interleave — writer A opens the tmp, writer B truncates and starts over,
+A renames B's half-written bytes into place, and the "atomic" file is torn
+after all. The temp name here is unique per process AND per call
+(pid + monotonic counter), so concurrent writers can only ever rename a
+fully-written file; last rename wins, which is the documented
+last-write-wins semantics of every one of these files.
+
+Failures are swallowed by contract (returning False) — state dumping is
+observability and must never kill serving — and the orphaned temp file is
+best-effort unlinked so a crashed writer doesn't litter the directory.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from typing import Optional
+
+_seq = itertools.count()
+
+
+def _tmp_name(path: str) -> str:
+    """Unique-per-writer temp path in the target's directory (same
+    filesystem, so the final ``os.replace`` stays atomic)."""
+    return f"{path}.{os.getpid()}.{next(_seq)}.tmp"
+
+
+def atomic_write_text(path: str, text: str) -> bool:
+    """Publish ``text`` at ``path`` atomically; False on any OS failure."""
+    tmp = _tmp_name(path)
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def atomic_write_json(path: str, obj, *, indent: Optional[int] = 2) -> bool:
+    """Publish ``obj`` as JSON at ``path`` atomically; False on failure
+    (OS errors AND unserializable objects — same never-kill-serving
+    contract as the health writers this replaces)."""
+    try:
+        text = json.dumps(obj, indent=indent)
+    except (TypeError, ValueError):
+        return False
+    return atomic_write_text(path, text)

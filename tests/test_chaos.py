@@ -59,7 +59,7 @@ def _mixed_plan(seed, max_faults=60):
 
 
 def _run_chaos(pipeline, plan, n=150, *, dlq_topic=None, dlq_attempts=None,
-               max_restarts=300, group="chaos"):
+               max_restarts=300, group="chaos", rowtrace=None):
     broker = InProcessBroker(num_partitions=3)
     _feed(broker, n)
     producers = []
@@ -71,7 +71,8 @@ def _run_chaos(pipeline, plan, n=150, *, dlq_topic=None, dlq_attempts=None,
         return StreamingClassifier(pipeline, cons, prod, "out",
                                    batch_size=32, max_wait=0.01,
                                    dlq_topic=dlq_topic,
-                                   dlq_attempts=dlq_attempts)
+                                   dlq_attempts=dlq_attempts,
+                                   rowtrace=rowtrace)
 
     stats = run_supervised(make_engine, max_restarts=max_restarts,
                            backoff=0.0, idle_timeout=0.2,
@@ -283,6 +284,36 @@ def test_dlq_chaos_corruption_lands_in_dlq(pipeline):
     assert all(r["reason"] == "malformed" for r in recs)
     assert all(r["original"].startswith("\x00chaos:") for r in recs)
     _assert_delivery_invariants(broker, 100, group="corrupt")
+
+
+def test_dlq_records_carry_trace_ids_under_chaos(pipeline):
+    """Key-set accounting extended to correlation ids (ISSUE 10): with
+    tracing on, every DLQ record minted across a whole supervised chaos
+    run carries the originating row's trace id, the id encodes the same
+    source coordinates the record does, and it joins back to a recorded
+    poll->terminal span chain. Span accounting stays exact (begun ==
+    ended) through every injected abort path."""
+    from fraud_detection_tpu.obs import RowTracer
+
+    plan = FaultPlan(seed=11, corrupt_rate=0.5, flush_fail_rate=0.05,
+                     commit_fence_rate=0.05, max_faults=20,
+                     sleep=lambda s: None)
+    tr = RowTracer(worker="w0", sample=1.0, seed=0, capacity=65536)
+    broker, stats, _ = _run_chaos(pipeline, plan, n=100, dlq_topic="out-dlq",
+                                  dlq_attempts={}, group="trace",
+                                  rowtrace=tr)
+    recs = [json.loads(m.value) for m in broker.messages("out-dlq")]
+    assert stats.dead_lettered > 0 and recs
+    for rec in recs:
+        cid = rec["trace"]
+        assert cid.split(":")[1:] == [str(rec["source"]["partition"]),
+                                      str(rec["source"]["offset"])]
+        stages = [s.stage for s in tr.chain(cid)]
+        assert "dlq" in stages and "poll" in stages and "deliver" in stages
+    snap = tr.snapshot()
+    assert snap["spans_begun"] == snap["spans_ended"]
+    assert snap["batches_traced"] == snap["batches_closed"]
+    _assert_delivery_invariants(broker, 100, group="trace")
 
 
 # ----------------------------------------------------------------------
@@ -537,7 +568,7 @@ def test_health_snapshot_fields_and_monotonic_ages(pipeline):
                        "consecutive_flush_failures", "processed",
                        "malformed", "dead_lettered", "shed",
                        "row_latency_ms", "device", "sched", "dlq",
-                       "annotations", "breaker", "model"}
+                       "annotations", "breaker", "model", "trace"}
     assert h1["shed"] == 0 and h1["sched"] is None   # no scheduler attached
     assert h1["model"] is None          # plain pipeline: no lifecycle block
     assert h1["running"] is False

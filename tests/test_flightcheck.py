@@ -789,7 +789,8 @@ def test_submit_annotations_vectorized_types():
         pending=None, offsets={}, dispatch_time=0.0, raw=False)
     engine._submit_annotations(inflight, _Preds())
     assert lane.items is not None and len(lane.items) == 2
-    for key, text, label, conf in lane.items:
+    for key, text, label, conf, cid in lane.items:
         assert type(label) is int, type(label)
         assert type(conf) is float, type(conf)
+        assert cid is None          # no tracer attached: cids ride as None
     assert [it[0] for it in lane.items] == [b"k1", b"k2"]

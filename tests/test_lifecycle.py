@@ -381,6 +381,7 @@ ENGINE_HEALTH_SCHEMA = {
     "annotations": (type(None), dict),
     "breaker": (type(None), dict),
     "model": (type(None), dict),
+    "trace": (type(None), dict),
 }
 
 DEVICE_BLOCK_SCHEMA = {
