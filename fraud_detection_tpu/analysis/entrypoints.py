@@ -596,6 +596,10 @@ HOT_PATHS: FrozenSet[str] = frozenset({
     "stream/engine.py::StreamingClassifier._dispatch",
     "stream/engine.py::StreamingClassifier._prepare",
     "stream/engine.py::StreamingClassifier._launch",
+    # Device-side featurization (ISSUE 11): the byte-tensor dispatch runs
+    # per micro-batch on the lane thread — a stray host sync or unwarmed
+    # shape here costs every batch, same as the engine legs above.
+    "models/pipeline.py::ServingPipeline._dispatch_bytes",
     "stream/engine.py::StreamingClassifier._dispatch_raw_json",
     "stream/engine.py::StreamingClassifier._finish",
     "stream/engine.py::StreamingClassifier._deliver",

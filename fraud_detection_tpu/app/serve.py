@@ -68,7 +68,8 @@ def start_health_writer(path, interval, current_engines, fault_plan=None):
     return finish
 
 
-def build_pipeline(spec: str, batch_size: int, int8: bool = False):
+def build_pipeline(spec: str, batch_size: int, int8: bool = False,
+                   featurize_device=False, featurize_width=None):
     from fraud_detection_tpu.models.pipeline import ServingPipeline
 
     if spec.startswith("spark:"):
@@ -79,15 +80,18 @@ def build_pipeline(spec: str, batch_size: int, int8: bool = False):
     elif spec == "synthetic":
         from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
 
-        return synthetic_demo_pipeline(batch_size, int8=int8)
+        pipe = synthetic_demo_pipeline(batch_size, int8=int8)
     else:
         pipe = ServingPipeline.from_checkpoint(spec, batch_size=batch_size)
-    if int8:
-        # Rebuild with the int8 scoring variant (docs/serving.md): the
-        # quantized weights derive from the loaded model, so this is a
-        # constructor flag, not a second artifact.
+    if int8 or featurize_device:
+        # Rebuild with the scoring-variant flags (docs/serving.md): int8
+        # quantization and device-side featurization both derive from the
+        # loaded model/featurizer, so they are constructor flags, not
+        # second artifacts.
         pipe = ServingPipeline(pipe.featurizer, pipe.model,
-                               batch_size=batch_size, int8=True)
+                               batch_size=batch_size, int8=int8,
+                               featurize_device=featurize_device,
+                               featurize_width=featurize_width)
     return pipe
 
 
@@ -142,6 +146,21 @@ def main(argv=None) -> int:
                          "only): quantized weights, exact int32 "
                          "accumulation, fp32-parity pinned by tests "
                          "(docs/serving.md)")
+    ap.add_argument("--featurize-device", action="store_true",
+                    help="device-side featurization (ops/featurize_kernel."
+                         "py): ship raw UTF-8 bytes and run tokenize/"
+                         "murmur-hash/TF counting inside the scoring "
+                         "program — the host featurize leg disappears. "
+                         "Requires a TPU backend; elsewhere the probe "
+                         "falls back to host featurization honestly and "
+                         "health()['device']['featurize_path'] says which "
+                         "path ran (docs/serving.md)")
+    ap.add_argument("--featurize-width", type=int, default=None,
+                    metavar="BYTES",
+                    help="fixed byte width of the --featurize-device "
+                         "staging tensor (default 2048); longer rows "
+                         "truncate at a codepoint boundary and count in "
+                         "health()['device']['truncated_rows']")
     ap.add_argument("--batch-deadline-ms", type=float, default=None,
                     help="adaptive scheduler: ship a partial micro-batch "
                          "this many ms after its first row instead of "
@@ -330,6 +349,14 @@ def main(argv=None) -> int:
         # scoring variants across swaps.
         raise SystemExit("--int8 is not supported with --registry yet "
                          "(hot-swap candidates would load fp32)")
+    if args.featurize_device and args.registry:
+        # Same reasoning as --int8: the watcher rebuilds candidates without
+        # the flag, which would silently flip the featurize path at swap.
+        raise SystemExit("--featurize-device is not supported with "
+                         "--registry yet (hot-swap candidates would load "
+                         "host-featurizing)")
+    if args.featurize_width is not None and not args.featurize_device:
+        raise SystemExit("--featurize-width needs --featurize-device")
     if args.pipeline_depth < 1:
         # Fail fast: inside --supervise this would read as a transient
         # incarnation failure and burn restarts on a pure config error.
@@ -503,6 +530,14 @@ def main(argv=None) -> int:
     shadow = None
     lifecycle = None
     model_desc = args.model
+    # Device-side featurization: True asks for the compiled Pallas path
+    # (refused off-TPU with an honest host fallback recorded in health);
+    # FRAUD_TPU_FEATURIZE_INTERPRET=1 forces interpreter mode so CLI e2e
+    # tests and parity demos can exercise the kernel on CPU containers.
+    featurize_device = False
+    if args.featurize_device:
+        featurize_device = ("interpret" if os.environ.get(
+            "FRAUD_TPU_FEATURIZE_INTERPRET") == "1" else True)
     if args.registry is not None:
         from fraud_detection_tpu.registry import (HotSwapPipeline,
                                                   LifecycleController,
@@ -522,7 +557,9 @@ def main(argv=None) -> int:
             shadow = ShadowScorer(max_queue=args.shadow_queue,
                                   sample=args.shadow_sample)
     else:
-        pipe = build_pipeline(args.model, args.batch_size, int8=args.int8)
+        pipe = build_pipeline(args.model, args.batch_size, int8=args.int8,
+                              featurize_device=featurize_device,
+                              featurize_width=args.featurize_width)
 
     if args.mesh:
         # Mesh data-parallel scoring: shard micro-batches over every local
@@ -537,6 +574,17 @@ def main(argv=None) -> int:
         pipe = MeshServingPipeline.from_pipeline(
             pipe, per_chip_batch=max(1, args.batch_size // max(1, dp)))
         model_desc = f"{model_desc} (mesh x{pipe.data_parallel or 1})"
+
+    if featurize_device:
+        # Say which featurize path actually runs — silent fallback would
+        # defeat the flag's point (health carries the same field).
+        reason = getattr(pipe, "featurize_unavailable_reason", None)
+        path = getattr(pipe, "device_stats", None)
+        path = path.featurize_path if path is not None else "host"
+        model_desc = f"{model_desc} (featurize={path})"
+        if reason is not None:
+            print(f"--featurize-device unavailable, serving host featurize: "
+                  f"{reason}", file=sys.stderr)
 
     sched_ladder_costs = None
     if sched_config is not None:

@@ -1,9 +1,12 @@
 from fraud_detection_tpu.featurize.text import clean_text, tokenize, load_default_stopwords, StopWordFilter
 from fraud_detection_tpu.featurize.hashing import murmur3_x86_32, spark_hash_bucket, HashingTF
 from fraud_detection_tpu.featurize.tfidf import HashingTfIdfFeaturizer, VocabTfIdfFeaturizer, EncodedBatch, tfidf_dense
+from fraud_detection_tpu.featurize.device import (
+    DeviceFeaturizer, DeviceFeaturizeUnavailable, pack_bytes, pack_staged)
 
 __all__ = [
     "clean_text", "tokenize", "load_default_stopwords", "StopWordFilter",
     "murmur3_x86_32", "spark_hash_bucket", "HashingTF",
     "HashingTfIdfFeaturizer", "VocabTfIdfFeaturizer", "EncodedBatch", "tfidf_dense",
+    "DeviceFeaturizer", "DeviceFeaturizeUnavailable", "pack_bytes", "pack_staged",
 ]

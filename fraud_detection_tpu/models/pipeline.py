@@ -79,6 +79,14 @@ def _pack_encoded(enc) -> Optional[np.ndarray]:
     return np.stack([ids, counts.view(np.int16)], axis=1)
 
 
+def unpack_packed_host(packed: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host inverse of ``_pack_encoded``: (B, 2, L) int16 -> (int16 ids,
+    uint16 counts). The device featurize path's parity surface
+    (featurize/device.py) round-trips through this."""
+    packed = np.asarray(packed)
+    return packed[:, 0, :], packed[:, 1, :].view(np.uint16)
+
+
 class DeviceStats:
     """Per-pipeline device-path counters (the ``device`` block of engine
     health): host->device crossings, donation hits, and what is pinned
@@ -86,7 +94,9 @@ class DeviceStats:
     from health pollers by design (a monitoring sample, like StreamStats)."""
 
     __slots__ = ("uploads", "upload_bytes", "chunks", "donated",
-                 "pinned_bytes", "pins", "int8", "mesh_devices", "_rungs")
+                 "pinned_bytes", "pins", "int8", "mesh_devices", "_rungs",
+                 "featurize_path", "feat_bytes_in", "feat_rows",
+                 "truncated_rows")
 
     def __init__(self, int8: bool = False, mesh_devices: int = 0):
         self.uploads = 0        # host->device transfer events
@@ -102,6 +112,16 @@ class DeviceStats:
         # shows which per-chip rungs are compiled BEFORE traffic arrives.
         self.mesh_devices = mesh_devices
         self._rungs: set = set()
+        # Device-side featurization (ops/featurize_kernel.py): which path
+        # featurize actually RUNS ("host" = the classic C++/Python leg,
+        # "pallas" = compiled kernel, "interpret" = interpreter mode), raw
+        # bytes shipped instead of packed ids+counts, and rows whose UTF-8
+        # exceeded the byte width (truncated at a codepoint boundary —
+        # counted, never silent).
+        self.featurize_path = "host"
+        self.feat_bytes_in = 0
+        self.feat_rows = 0
+        self.truncated_rows = 0
 
     def record_chunk(self, nbytes: int, transfers: int = 1,
                      rows: Optional[int] = None) -> None:
@@ -110,6 +130,13 @@ class DeviceStats:
         self.upload_bytes += nbytes
         if rows:
             self._rungs.add(rows)   # set.add is atomic; snapshot copies
+
+    def record_featurize(self, nbytes: int, rows: int, truncated: int) -> None:
+        """One device-featurized chunk: raw bytes in, rows covered, rows
+        byte-truncated (single-writer, like record_chunk)."""
+        self.feat_bytes_in += nbytes
+        self.feat_rows += rows
+        self.truncated_rows += truncated
 
     def per_chip_rungs(self) -> list:
         """Distinct padded row counts dispatched, PER CHIP on the data
@@ -131,6 +158,10 @@ class DeviceStats:
             "int8": self.int8,
             "mesh_devices": self.mesh_devices,
             "per_chip_rungs": self.per_chip_rungs(),
+            "featurize_path": self.featurize_path,
+            "bytes_in_per_row": (round(self.feat_bytes_in / self.feat_rows, 1)
+                                 if self.feat_rows else None),
+            "truncated_rows": self.truncated_rows,
         }
 
 
@@ -174,7 +205,9 @@ class ServingPipeline:
     def __init__(self, featurizer: HashingTfIdfFeaturizer,
                  model: "LogisticRegression | TreeEnsemble",
                  fold_idf: bool = True, batch_size: int = 256, mesh=None,
-                 int8: bool = False):
+                 int8: bool = False, featurize_device=False,
+                 featurize_width: Optional[int] = None,
+                 featurize_tokens: Optional[int] = None):
         self.featurizer = featurizer
         self.batch_size = batch_size
         self.mesh = mesh  # data-parallel serving: rows sharded on "data"
@@ -213,6 +246,32 @@ class ServingPipeline:
         else:
             dp = 0
         self.device_stats = DeviceStats(int8=self.int8, mesh_devices=dp)
+        # Device-side featurization (ops/featurize_kernel.py + featurize/
+        # device.py): the host ships a fixed-width raw-byte tensor and ONE
+        # jitted program runs tokenize/murmur-hash/count/pack + scoring —
+        # the featurize leg leaves the host CPU entirely. ``featurize_device``
+        # accepts False, True (compiled Pallas; on a non-TPU backend the
+        # build REFUSES and the pipeline honestly keeps the host path —
+        # ``DeviceStats.featurize_path`` says which ran) or "interpret"
+        # (force interpreter mode: parity tests and benches off-TPU).
+        self._dev_feat = None
+        self.featurize_unavailable_reason: Optional[str] = None
+        if featurize_device:
+            from fraud_detection_tpu.featurize.device import (
+                DeviceFeaturizeUnavailable, DeviceFeaturizer)
+
+            try:
+                self._dev_feat = DeviceFeaturizer(
+                    featurizer,
+                    **({"width": featurize_width}
+                       if featurize_width is not None else {}),
+                    **({"tokens": featurize_tokens}
+                       if featurize_tokens is not None else {}),
+                    interpret=(True if featurize_device == "interpret"
+                               else None))
+                self.device_stats.featurize_path = self._dev_feat.path
+            except DeviceFeaturizeUnavailable as e:
+                self.featurize_unavailable_reason = str(e)
         # Donate per-batch staging buffers into the scoring program when the
         # platform consumes them (probed once; False on CPU).
         self._donate = donation_effective()
@@ -313,6 +372,12 @@ class ServingPipeline:
         ``(marshalled char*[] array, chunk_len)`` for native frame assembly
         (``featurize/native.py build_frames``), or None when any chunk's
         context is unavailable."""
+        if self._dev_feat is not None:
+            # Device-side featurization owns the hot path: the engine's
+            # slow path decodes JSON and predict_async ships raw bytes —
+            # the native host tokenize/hash pass this method fronts is the
+            # very work the kernel deleted.
+            return None
         encode_json = getattr(self.featurizer, "encode_json", None)
         if encode_json is None:
             return None
@@ -380,6 +445,10 @@ class ServingPipeline:
             arrs.append(self._tree_idf)
         if self._q8 is not None:
             arrs.extend(self._q8)
+        if self._dev_feat is not None:
+            # The stop table is a model-side constant of the device
+            # featurize program: uploaded once, pinned with the weights.
+            arrs.append(self._dev_feat.stop_table())
         jax.block_until_ready(arrs)
         ds.pinned_bytes = int(sum(a.size * a.dtype.itemsize for a in arrs))
         ds.pins += 1
@@ -467,6 +536,47 @@ class ServingPipeline:
             copy_async()  # start the device->host fetch behind the dispatch
         return p
 
+    def _dispatch_bytes(self, texts: Sequence[str], rows: int,
+                        tree_binary: bool) -> object:
+        """Device-featurized dispatch for one chunk: pack raw UTF-8 bytes
+        (the host's entire featurize leg — a memcpy), upload the ONE
+        staging tensor, and launch the fused featurize+score program. The
+        byte tensor is donated where the platform consumes donations, like
+        every other staging buffer."""
+        dev = self._dev_feat
+        staged, truncated = dev.pack(texts, batch_size=rows)
+        ds = self.device_stats
+        ds.record_featurize(staged.nbytes, len(texts), truncated)
+        ds.record_chunk(staged.nbytes, transfers=1, rows=rows)
+        if self.mesh is None:
+            staged_dev = jnp.asarray(staged)
+        else:
+            from fraud_detection_tpu.parallel.mesh import shard_rows
+
+            staged_dev = shard_rows(staged, self.mesh)
+        stop_tbl = dev.stop_table()
+        if self._fused_model is None:
+            if self._tree_idf is None:
+                self._tree_idf = self.featurizer.idf_array()
+            fn = (_tree_prob_bytes_donating if self._donate
+                  else _tree_prob_bytes_plain)
+            p = fn(self.model, stop_tbl, staged_dev, self._tree_idf,
+                   tree_binary, spec=dev.spec)
+        elif self._q8 is not None:
+            fn = (_prob_bytes_q8_donating if self._donate
+                  else _prob_bytes_q8_plain)
+            p = fn(self._q8[0], self._q8[1], self._fused_model.intercept,
+                   stop_tbl, staged_dev, spec=dev.spec)
+        else:
+            fn = _prob_bytes_donating if self._donate else _prob_bytes_plain
+            p = fn(self._fused_model, stop_tbl, staged_dev, spec=dev.spec)
+        if self._donate:
+            ds.donated += 1
+        copy_async = getattr(p, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()  # start the device->host fetch behind the dispatch
+        return p
+
     def predict_async(self, texts: Sequence[str]) -> "PendingPrediction":
         """Featurize + dispatch device scoring WITHOUT blocking on results.
 
@@ -488,6 +598,16 @@ class ServingPipeline:
         for start in range(0, len(texts), self.batch_size):
             chunk = list(texts[start : start + self.batch_size])
             n = len(chunk)
+            if self._dev_feat is not None:
+                # Device-side featurization: raw bytes are the crossing;
+                # tokenize/hash/count run inside the scoring program.
+                parts.append((self._dispatch_bytes(chunk, self._pad_rows(n),
+                                                   tree_binary), n))
+                if self._fused_model is not None:
+                    threshold = self._fused_model.threshold
+                else:
+                    argmax = not tree_binary
+                continue
             enc = self.featurizer.encode(chunk, batch_size=self._pad_rows(n))
             if self._fused_model is not None:
                 parts.append((self._dispatch_fused(enc), n))
@@ -544,11 +664,65 @@ def _tree_prob_packed(ensemble: TreeEnsemble, packed, idf, binary: bool,
     return fn(ensemble, packed, idf, binary)
 
 
+# ---------------------------------------------------------------------------
+# Device-side featurization scoring entries (ops/featurize_kernel.py): the
+# staging buffer is the raw-byte tensor itself — featurize (Pallas scan +
+# count/pack) and scoring fuse into ONE jitted program per model family, so
+# bytes -> probability never touches the host in between. Each entry has a
+# donating twin for the byte tensor (argument 2 throughout), same policy as
+# the packed entries above.
+# ---------------------------------------------------------------------------
+
+
+def _prob_bytes_impl(model: LogisticRegression, stop_tbl, staged, *, spec):
+    from fraud_detection_tpu.ops.featurize_kernel import featurize_bytes
+
+    packed, _ = featurize_bytes(staged, stop_tbl, spec=spec)
+    ids, counts = linear_mod.unpack_rows(packed)
+    gathered = model.weights[ids]
+    m = jnp.sum(gathered * counts, axis=-1) + model.intercept
+    return jax.nn.sigmoid(m)
+
+
+_prob_bytes_plain = jax.jit(_prob_bytes_impl, static_argnames=("spec",))
+_prob_bytes_donating = jax.jit(_prob_bytes_impl, static_argnames=("spec",),
+                               donate_argnums=(2,))
+
+
+def _prob_bytes_q8_impl(w_q, scales, intercept, stop_tbl, staged, *, spec):
+    from fraud_detection_tpu.ops.featurize_kernel import featurize_bytes
+
+    packed, _ = featurize_bytes(staged, stop_tbl, spec=spec)
+    return linear_mod._prob_packed_q8_impl(w_q, scales, intercept, packed)
+
+
+_prob_bytes_q8_plain = jax.jit(_prob_bytes_q8_impl, static_argnames=("spec",))
+_prob_bytes_q8_donating = jax.jit(_prob_bytes_q8_impl,
+                                  static_argnames=("spec",),
+                                  donate_argnums=(4,))
+
+
+def _tree_prob_bytes_impl(ensemble: TreeEnsemble, stop_tbl, staged, idf,
+                          binary: bool, *, spec):
+    from fraud_detection_tpu.ops.featurize_kernel import featurize_bytes
+
+    packed, _ = featurize_bytes(staged, stop_tbl, spec=spec)
+    return _tree_prob_packed_impl(ensemble, packed, idf, binary)
+
+
+_tree_prob_bytes_plain = jax.jit(_tree_prob_bytes_impl,
+                                 static_argnames=("binary", "spec"))
+_tree_prob_bytes_donating = jax.jit(_tree_prob_bytes_impl,
+                                    static_argnames=("binary", "spec"),
+                                    donate_argnums=(2,))
+
+
 def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 7,
                             num_features: int = 10000,
                             model: str = "lr",
                             corpus_kwargs: dict | None = None,
-                            mesh=None, int8: bool = False) -> ServingPipeline:
+                            mesh=None, int8: bool = False,
+                            featurize_device=False) -> ServingPipeline:
     """Train a quick model on the synthetic corpus — the shared demo/bench
     fallback pipeline (one recipe, used by bench.py and app/serve.py).
     ``model``: "lr" (default) | "dt" | "rf" | "xgb". ``corpus_kwargs`` is
@@ -575,4 +749,4 @@ def synthetic_demo_pipeline(batch_size: int = 256, *, n: int = 800, seed: int = 
     else:
         raise ValueError(f"unknown demo model {model!r}")
     return ServingPipeline(feat, clf, batch_size=batch_size, mesh=mesh,
-                           int8=int8)
+                           int8=int8, featurize_device=featurize_device)

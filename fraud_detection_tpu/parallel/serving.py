@@ -55,7 +55,9 @@ class MeshServingPipeline(ServingPipeline):
     (``mesh=None`` — the fall-back-byte-identically contract)."""
 
     def __init__(self, featurizer, model, *, per_chip_batch: int = 256,
-                 mesh=None, fold_idf: bool = True, int8: bool = False):
+                 mesh=None, fold_idf: bool = True, int8: bool = False,
+                 featurize_device=False,
+                 featurize_width=None, featurize_tokens=None):
         if per_chip_batch < 1:
             raise ValueError(
                 f"per_chip_batch must be >= 1, got {per_chip_batch}")
@@ -64,9 +66,16 @@ class MeshServingPipeline(ServingPipeline):
         dp = int(dict(mesh.shape).get(DATA_AXIS, 1))
         self.data_parallel = dp
         self.per_chip_batch = per_chip_batch
+        # Device-side featurization shards with scoring: the raw-byte
+        # staging tensor row-shards over the same data axis (shard_rows in
+        # _dispatch_bytes), and _pad_rows below keeps every rung
+        # dp-divisible so each chip featurizes rung/dp rows.
         super().__init__(featurizer, model, fold_idf=fold_idf,
                          batch_size=per_chip_batch * dp,
-                         mesh=mesh if dp > 1 else None, int8=int8)
+                         mesh=mesh if dp > 1 else None, int8=int8,
+                         featurize_device=featurize_device,
+                         featurize_width=featurize_width,
+                         featurize_tokens=featurize_tokens)
         # The 1-device fallback drops the mesh (exact single-device path)
         # but the health block still says "mesh lane, 1 chip" rather than
         # the plain pipeline's 0 — observers can tell the lane apart.
@@ -87,6 +96,15 @@ class MeshServingPipeline(ServingPipeline):
                       mesh=None) -> "MeshServingPipeline":
         """Mesh twin of an existing pipeline (same featurizer + model —
         the bench's parity comparisons build both from one artifact)."""
+        dev = pipe._dev_feat
+        feat_kwargs = {}
+        if dev is not None:
+            feat_kwargs = {
+                "featurize_device": ("interpret" if dev.spec.interpret
+                                     else True),
+                "featurize_width": dev.width,
+                "featurize_tokens": dev.tokens,
+            }
         return cls(pipe.featurizer, pipe.model,
                    per_chip_batch=per_chip_batch or pipe.batch_size,
-                   mesh=mesh, int8=pipe.int8)
+                   mesh=mesh, int8=pipe.int8, **feat_kwargs)

@@ -171,7 +171,11 @@ def prewarm_ladder(pipeline, buckets: Sequence[int],
 class DispatchLane:
     """Double-buffered async dispatch: ONE background thread runs the
     engine's featurize + upload + device-launch leg (``launch_fn``) for
-    batch N+1 while the driver thread resolves / delivers batch N.
+    batch N+1 while the driver thread resolves / delivers batch N. With a
+    device-featurizing pipeline (models/pipeline.py ``featurize_device``)
+    the lane's leg is just decode + byte-pack + ONE raw-byte upload —
+    tokenize/hash/count ride the device program, so the boundary this lane
+    moves off the driver is down to a memcpy.
 
     The consume->score handoff today serializes the finish leg (device
     wait, frame assembly, produce, flush, commit) against the NEXT batch's

@@ -476,12 +476,16 @@ class StreamingClassifier:
         worker thread (``async_dispatch``) — it touches no driver-owned
         state beyond the documented monotonic fast-path latches.
 
-        The featurize leg is multi-core on both paths: the raw-JSON encode
-        shards inside one C++ call (native/fast_featurize.cpp run_sharded)
-        and the text fallback shards across the Python thread pool
-        (featurize/parallel.py via ``pipeline.predict_async``) — so the host
-        leg that overlaps the device wait is itself parallel, not one
-        GIL-bound thread."""
+        The featurize leg is multi-core on both host paths: the raw-JSON
+        encode shards inside one C++ call (native/fast_featurize.cpp
+        run_sharded) and the text fallback shards across the Python thread
+        pool (featurize/parallel.py via ``pipeline.predict_async``) — so
+        the host leg that overlaps the device wait is itself parallel, not
+        one GIL-bound thread. With a device-featurizing pipeline
+        (``featurize_device`` — models/pipeline.py) the leg shrinks
+        further: this lane ships RAW UTF-8 BYTES (decode + memcpy) and
+        tokenize/hash/count run inside the scoring program, so the only
+        host work left here is JSON decode + byte packing."""
         t0 = time.perf_counter()
         msgs, offsets = prep.msgs, prep.offsets
         inflight = None
@@ -910,6 +914,13 @@ class StreamingClassifier:
             # worker's health proves its rungs compiled before traffic.
             "mesh_devices": snap.get("mesh_devices"),
             "per_chip_rungs": snap.get("per_chip_rungs"),
+            # Device-side featurization (ops/featurize_kernel.py): which
+            # path featurize ran ("host" / "pallas" / "interpret" — the
+            # probe falls back honestly on CPU containers), raw bytes
+            # shipped per row, and rows truncated at the byte width.
+            "featurize_path": snap.get("featurize_path"),
+            "bytes_in_per_row": snap.get("bytes_in_per_row"),
+            "truncated_rows": snap.get("truncated_rows"),
         }
 
     def close_annotations(self, timeout: float = 30.0) -> bool:

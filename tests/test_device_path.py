@@ -187,11 +187,13 @@ def _donation_literals(module, expected: dict) -> None:
 
 
 def test_serving_donating_twins_pin_their_donate_argnums(pipeline):
-    # Three donating twins: packed fp32, packed int8, packed tree.
+    # Donating twins: packed fp32 + packed int8 (linear), packed tree +
+    # the donation probe, and the three byte-tensor featurize+score twins
+    # (fp32/tree donate arg 2, int8 donates arg 4 — the staging tensor).
     from fraud_detection_tpu.models import pipeline as pipeline_mod
 
     _donation_literals(linear_mod, {(1,): 1, (3,): 1})
-    _donation_literals(pipeline_mod, {(1,): 1, (0,): 1})  # tree twin + probe
+    _donation_literals(pipeline_mod, {(1,): 1, (0,): 1, (2,): 2, (4,): 1})
     if donation_effective():
         # Where the platform consumes donations, the lowering must say so.
         import jax.numpy as jnp

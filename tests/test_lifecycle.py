@@ -399,6 +399,9 @@ DEVICE_BLOCK_SCHEMA = {
     "int8": (type(None), bool),
     "mesh_devices": (type(None), int),       # 0/None: single-device path
     "per_chip_rungs": (type(None), list),
+    "featurize_path": (type(None), str),     # host | pallas | interpret
+    "bytes_in_per_row": (type(None), int, float),
+    "truncated_rows": (type(None), int),
 }
 
 MODEL_BLOCK_SCHEMA = {
