@@ -153,6 +153,11 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
         "capacity_est_per_s": sweep.get("capacity_est_per_s"),
         "max_load_meeting_target_p99_per_s": sweep.get(
             "max_load_meeting_target_p99_per_s"),
+        # Game-day verdicts (ISSUE 12, docs/scenarios.md): one ok bit per
+        # named scenario so an SLO regression diffs in the trend file.
+        "scenarios": ({name: s.get("ok") for name, s in
+                       ((line.get("scenarios") or {}).get("scenarios")
+                        or {}).items()} or None),
         # Fleet scaling trend (ISSUE 8): worker count, per-worker vs
         # aggregate rate, and the globally-coordinated shed count.
         "fleet": ({
@@ -963,6 +968,40 @@ def fleet_bench(pipe, texts, batch_size: int, n_msgs: int) -> dict:
         }
     else:
         out["mesh"] = {"skipped": "single_device"}
+    return out
+
+
+def scenario_bench(pipe) -> dict:
+    """Game-day scenario verdicts (docs/scenarios.md): named catalog
+    scenarios — a flash crowd against admission control, the flagship
+    campaign-spike + worker-kill + hot-swap fleet game day, and a
+    full-vocabulary chaos storm — run warp-paced against the in-process
+    stack, each gated by its SLO assertions. The committed evidence is
+    the machine-readable verdict per scenario (ok + per-gate bits), so a
+    regression in any declared SLO diffs in the artifact and the trend
+    file instead of only failing a soak somewhere."""
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    seed = int(os.environ.get("BENCH_SCENARIO_SEED", "11"))
+    scale = float(os.environ.get("BENCH_SCENARIO_SCALE", "0.5"))
+    names = [n for n in os.environ.get(
+        "BENCH_SCENARIO_LIST",
+        "flash_crowd,campaign_kill_swap,chaos_storm").split(",") if n]
+    out = {"seed": seed, "scale": scale, "scenarios": {}}
+    for name in names:
+        gd = get_scenario(name, seed, scale=scale)
+        t0 = time.perf_counter()
+        result = run_gameday(gd, pipeline=pipe)
+        ev = result.evidence
+        out["scenarios"][name] = {
+            "ok": result.ok,
+            "mode": result.mode,
+            "rows": ev.get("planned"),
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "verdicts": {v.name: bool(v.ok or v.skipped)
+                         for v in result.report.verdicts},
+        }
+    out["pass"] = all(s["ok"] for s in out["scenarios"].values())
     return out
 
 
@@ -1827,6 +1866,15 @@ def main() -> int:
             lambda scratch: fleet_bench(pipe_or_raise(), texts, batch_size,
                                         n_msgs),
             fraction=0.4)
+
+    if os.environ.get("BENCH_SCENARIOS", "1") != "0":
+        # Game-day SLO verdicts (docs/scenarios.md): the named scenario
+        # catalog as committed regression evidence — flash crowd,
+        # campaign+kill+swap, chaos storm, each judged by its gates.
+        harness.section(
+            "scenarios",
+            lambda scratch: scenario_bench(pipe_or_raise()),
+            fraction=0.35)
 
     # Offered-load sweep (bench.py --load-sweep, default-on so the committed
     # artifact carries the latency-vs-throughput trajectory, not just one

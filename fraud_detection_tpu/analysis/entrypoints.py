@@ -71,6 +71,10 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     ("obs/export.py", "loop"),
     ("obs/export.py", "serve_forever"),
     ("obs/export.py", "watch"),
+    # Scenario harness (docs/scenarios.md): the single scenario-feeder
+    # thread walking a seeded traffic timeline (produces rows to the
+    # broker, fires scripted TimelineActions like hot swaps).
+    ("scenarios/traffic.py", "self._run"),
 })
 
 
@@ -151,6 +155,12 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
     EntryPoint("profile-window", "obs/export.py", "watch", None,
                "polls a batches counter and stops the jax profiler trace "
                "once; all mutation behind the window's own lock"),
+    EntryPoint("scenario-feeder", "scenarios/traffic.py",
+               "TrafficFeeder._run", None,
+               "single feeder by construction (one thread per start(), "
+               "never respawned); counters under _lock, the error field "
+               "is a documented write-once latch read after join(), and "
+               "broker appends go through the broker's own lock"),
 )
 
 
@@ -252,6 +262,13 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
         any_thread=("stop", "fleet_health"),
         fleet_monitor=("_monitor_loop", "_write_health_file"),
         fleet_worker=("_worker_main",)),
+    # Scenario feeder (docs/scenarios.md): _run/_fire execute on the one
+    # feeder thread; stats/fed/alive are the cross-thread surface
+    # (counters under _lock; the error field is a write-once latch read
+    # after join()).
+    "scenarios/traffic.py::TrafficFeeder": _spec(
+        any_thread=("stats", "fed", "alive", "join"),
+        scenario_feeder=("_run", "_fire")),
 }
 
 

@@ -95,6 +95,46 @@ def build_pipeline(spec: str, batch_size: int, int8: bool = False,
     return pipe
 
 
+def _judge_scenario(scenario, events, feeder, broker, args, out,
+                    tracers) -> dict:
+    """Evaluate a --scenario run's SLO gates from the serve-side evidence
+    (broker key multisets + the exit stats/health). Scope is "serve":
+    fleet-only gates (worker kills, hot swaps) report skipped — the full
+    game-day runner owns those (docs/scenarios.md)."""
+    from fraud_detection_tpu.scenarios import evaluate
+
+    health = out.get("health") or {}
+    dlq_topic = ((args.dlq_topic or f"{args.output_topic}-dlq")
+                 if args.dlq else None)
+    stats = {k: v for k, v in out.items() if isinstance(v, (int, float))}
+    evidence = {
+        "planned": len(events),
+        "fed": feeder.fed,
+        "fed_keys": [e.key.decode() for e in events],
+        "out_keys": [m.key.decode()
+                     for m in broker.messages(args.output_topic)
+                     if m.key is not None],
+        "dlq_keys": ([m.key.decode() for m in broker.messages(dlq_topic)
+                      if m.key is not None] if dlq_topic else []),
+        "stats": stats,
+        "health": health,
+        "sched": health.get("sched"),
+        "breaker": health.get("breaker"),
+        "chaos": out.get("chaos"),
+        "traces": [t.snapshot() for t in tracers.values()],
+        "tracing": bool(args.trace),
+        "feeder": feeder.stats(),
+        "errors": ([f"feeder: {feeder.error!r}"]
+                   if feeder.error is not None else []),
+    }
+    evidence["shed_fraction"] = round(
+        stats.get("shed", 0) / max(1, len(events)), 4)
+    report = evaluate(scenario.slos, evidence, scope="serve")
+    return {"name": scenario.name, "seed": scenario.seed, "ok": report.ok,
+            "fed": feeder.fed, "planned": len(events),
+            "verdicts": [v.as_dict() for v in report.verdicts]}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default=None,
@@ -294,6 +334,31 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-sample", type=float, default=0.05,
                     help="fraction of CLEAN batches whose spans are kept "
                          "(--trace; interesting batches are always kept)")
+    ap.add_argument("--trace-record", default=None, metavar="FILE",
+                    help="record this run for replay: tracing runs in "
+                         "record mode (sample forced to 1.0 + a per-batch "
+                         "row census) and the SpanRing dumps to FILE as "
+                         "JSONL at exit via the atomic writer; replay with "
+                         "python -m fraud_detection_tpu.scenarios.replay "
+                         "(docs/scenarios.md). Implies --trace; single "
+                         "worker only")
+    ap.add_argument("--scenario", default=None, metavar="NAME[:seed]",
+                    help="drive a named scenario's seeded traffic against "
+                         "this live serve run instead of the uniform "
+                         "--demo preload, then gate on the scenario's "
+                         "SLOs (exit 4 on violation; scenario catalog: "
+                         "python -m fraud_detection_tpu.scenarios.gameday "
+                         "--list). Engine config still comes from the "
+                         "serve flags; fleet-only gates (worker kills, "
+                         "hot swaps) report as skipped — run the full "
+                         "game day via the gameday CLI. Needs --demo")
+    ap.add_argument("--scenario-scale", type=float, default=1.0,
+                    help="traffic-rate multiplier for --scenario (CI "
+                         "smokes run < 1)")
+    ap.add_argument("--scenario-time-scale", type=float, default=1.0,
+                    help="timeline pacing for --scenario: 1 = the "
+                         "scenario's real-time curve (default), 0 = warp "
+                         "(feed as fast as the engine drains)")
     ap.add_argument("--profile-dir", default=None, metavar="DIR",
                     help="capture jax.profiler traces: one around "
                          "prewarm/ladder measurement, one over the first "
@@ -454,6 +519,39 @@ def main(argv=None) -> int:
     if not 0.0 <= args.trace_sample <= 1.0:
         raise SystemExit(
             f"--trace-sample must be in [0, 1], got {args.trace_sample}")
+    if args.trace_record is not None:
+        # Record mode: full sampling + the per-batch row census, one ring
+        # (docs/scenarios.md "Recording a run").
+        if args.workers > 1 or args.fleet > 0:
+            raise SystemExit("--trace-record supports a single worker "
+                             "only (one recording = one worker's ring)")
+        args.trace = True
+    scenario = None
+    if args.scenario is not None:
+        if not args.demo:
+            raise SystemExit("--scenario needs --demo N (traffic is fed "
+                             "into the in-process broker; N is ignored — "
+                             "the scenario defines the rows)")
+        if args.workers > 1 or args.fleet > 0:
+            raise SystemExit("--scenario drives a single serve worker; "
+                             "run multi-worker scenarios via "
+                             "python -m fraud_detection_tpu.scenarios."
+                             "gameday")
+        if args.scenario_scale <= 0:
+            raise SystemExit(f"--scenario-scale must be > 0, "
+                             f"got {args.scenario_scale}")
+        if args.scenario_time_scale < 0:
+            raise SystemExit(f"--scenario-time-scale must be >= 0, "
+                             f"got {args.scenario_time_scale}")
+        from fraud_detection_tpu.scenarios import (get_scenario,
+                                                   parse_scenario_ref)
+
+        try:
+            name, scenario_seed = parse_scenario_ref(args.scenario)
+            scenario = get_scenario(name, scenario_seed,
+                                    scale=args.scenario_scale)
+        except (KeyError, ValueError) as e:
+            raise SystemExit(f"bad --scenario: {e}")
     if args.profile_batches < 1:
         raise SystemExit(
             f"--profile-batches must be >= 1, got {args.profile_batches}")
@@ -622,21 +720,45 @@ def main(argv=None) -> int:
         make_producer = KafkaProducer
         max_messages, idle = args.max_messages, None
     elif args.demo > 0:
-        from fraud_detection_tpu.data import generate_corpus
-
         broker = InProcessBroker(num_partitions=args.partitions)
-        feeder = broker.producer()
-        corpus = generate_corpus(n=min(args.demo, 2000), seed=123)
-        for i in range(args.demo):
-            d = corpus[i % len(corpus)]
-            feeder.produce(args.input_topic,
-                           json.dumps({"text": d.text, "id": i}).encode(),
-                           key=str(i).encode())
+        if scenario is not None:
+            # Scenario traffic (docs/scenarios.md): the seeded timeline
+            # feeds the broker LIVE from the scenario-feeder thread while
+            # the engine serves — shaped curves and campaign waves instead
+            # of a uniform preload. Chaos (--chaos) composes on top.
+            from fraud_detection_tpu.scenarios import (ScenarioClock,
+                                                       TrafficFeeder,
+                                                       compose)
+
+            scenario_clock = ScenarioClock(
+                scenario.seed, time_scale=args.scenario_time_scale)
+            scenario_events = compose(scenario.traffic, scenario_clock)
+            scenario_feeder = TrafficFeeder(
+                broker.producer(), args.input_topic, scenario_events,
+                scenario_clock)
+            scenario_feeder.start()
+            max_messages = args.max_messages
+            gaps = [b - a for a, b in zip(
+                [e.t for e in scenario_events],
+                [e.t for e in scenario_events][1:])]
+            idle = max(1.0, 2.0 * args.scenario_time_scale
+                       * max(gaps, default=0.0))
+        else:
+            from fraud_detection_tpu.data import generate_corpus
+
+            feeder = broker.producer()
+            corpus = generate_corpus(n=min(args.demo, 2000), seed=123)
+            for i in range(args.demo):
+                d = corpus[i % len(corpus)]
+                feeder.produce(args.input_topic,
+                               json.dumps({"text": d.text, "id": i}).encode(),
+                               key=str(i).encode())
+            max_messages = (args.max_messages
+                            if args.max_messages is not None else args.demo)
+            idle = 1.0
         make_clients = lambda: (broker.consumer([args.input_topic], "serve-demo"),
                                 broker.producer())
         make_producer = broker.producer
-        max_messages = args.max_messages if args.max_messages is not None else args.demo
-        idle = 1.0
     else:
         raise SystemExit("choose --kafka or --demo N (no broker specified)")
 
@@ -705,8 +827,15 @@ def main(argv=None) -> int:
 
         tr = trace_per_worker.get(worker)
         if tr is None:
+            record = args.trace_record is not None
             tr = trace_per_worker[worker] = RowTracer(
-                worker=f"w{worker}", sample=args.trace_sample)
+                worker=f"w{worker}",
+                # Record mode: keep everything (sample 1.0 + row census)
+                # in a ring sized for a whole demo run, so the dumped
+                # recording is complete and exactly replayable.
+                sample=1.0 if record else args.trace_sample,
+                capacity=65536 if record else 4096,
+                record_rows=record)
         return tr
 
     if args.fleet > 0:
@@ -1054,11 +1183,35 @@ def main(argv=None) -> int:
         out["profile"] = profile
     finish_metrics()
     finish_health()
+    if args.trace_record is not None and trace_per_worker:
+        # Atomic JSONL dump of the ring at exit (scenarios/record.py):
+        # the run is now a replayable regression input.
+        from fraud_detection_tpu.scenarios import dump_tracer
+
+        header = dump_tracer(trace_per_worker[0], args.trace_record,
+                             now=time.time())
+        out["trace_record"] = {"path": args.trace_record,
+                               "spans": header["spans"],
+                               "complete": header["complete"]}
+    scenario_failed = False
+    if scenario is not None:
+        scenario_feeder.join(timeout=120.0)
+        out["scenario"] = _judge_scenario(
+            scenario, scenario_events, scenario_feeder, broker, args, out,
+            trace_per_worker)
+        scenario_failed = not out["scenario"]["ok"]
+        if scenario_failed:
+            print(f"scenario {scenario.name!r} FAILED its SLO gates "
+                  f"(exit 4): "
+                  f"{[v['name'] for v in out['scenario']['verdicts'] if not v['ok'] and not v['skipped']]}",
+                  file=sys.stderr, flush=True)
     print(json.dumps(out))
     if args.demo:
         n_out = broker.topic_size(args.output_topic)
         print(f"classified messages on {args.output_topic}: {n_out}")
-    return 3 if gave_up is not None else 0
+    if gave_up is not None:
+        return 3
+    return 4 if scenario_failed else 0
 
 
 if __name__ == "__main__":

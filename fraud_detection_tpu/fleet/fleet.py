@@ -99,6 +99,7 @@ class Fleet:
                    sched_config=None,
                    dlq_topic: Optional[str] = None,
                    death_plan=None,
+                   fault_plan=None,
                    bus_dir: Optional[str] = None,
                    lease_ttl: float = 5.0,
                    heartbeat_interval: float = 0.05,
@@ -110,7 +111,15 @@ class Fleet:
         """A fleet over an InProcessBroker: assigned consumers with the
         coordinator's commit fence, group-lag drain signal, one shared
         scoring pipeline, and (with ``sched_config``) a per-worker adaptive
-        scheduler shedding against the fleet's global backlog watermark."""
+        scheduler shedding against the fleet's global backlog watermark.
+
+        ``fault_plan`` (stream/faults.py FaultPlan, e.g. from the scenario
+        harness — docs/scenarios.md) wraps every worker's transport in the
+        chaos layer. Only NON-LETHAL fault kinds belong here (duplicates,
+        corruption, latency spikes, commit fences, lossy flushes): a poll
+        transport error or flush crash raises out of the worker thread and
+        counts as a worker error — scripted whole-worker deaths are
+        ``death_plan``'s job."""
         from fraud_detection_tpu.stream.engine import StreamingClassifier
 
         fleet_holder: dict = {}
@@ -118,10 +127,15 @@ class Fleet:
 
         def make_consumer(lease):
             coordinator = fleet_holder["fleet"].coordinator
-            return broker.assigned_consumer(
+            consumer = broker.assigned_consumer(
                 lease.partitions, group_id,
                 fence=lambda pairs, wid=lease.worker_id:
                     coordinator.fence_lost(wid, pairs))
+            # Chaos wraps INSIDE the fleet's poll-path wrapper, so the
+            # death plan / heartbeat hooks still fire even when a poll's
+            # result is chaos-mangled.
+            return (fault_plan.consumer(consumer)
+                    if fault_plan is not None else consumer)
 
         def make_engine(consumer, worker_id):
             scheduler = None
@@ -139,8 +153,11 @@ class Fleet:
                         lambda b=bus: (b.fleet_view() or {}).get(
                             "backlog_per_worker"))
                     schedulers[worker_id] = scheduler
+            producer = broker.producer()
+            if fault_plan is not None:
+                producer = fault_plan.producer(producer)
             return StreamingClassifier(
-                pipeline, consumer, broker.producer(), output_topic,
+                pipeline, consumer, producer, output_topic,
                 batch_size=batch_size, max_wait=max_wait,
                 pipeline_depth=pipeline_depth,
                 async_dispatch=async_dispatch,

@@ -65,6 +65,9 @@ EVENT_DLQ = "dlq"            # row dead-lettered (malformed/poison)
 EVENT_FLAG = "flag"          # row classified non-benign
 EVENT_ANNOTATE = "annotate"  # row's annotation produced (or failed)
 EVENT_ABORT = "abort"        # batch abandoned (crash/flush-fail replay)
+EVENT_ROW = "row"            # row delivered (record mode only: the full
+                             # per-batch row census a trace RECORDING needs
+                             # for exact replay — scenarios/record.py)
 
 
 class Span(NamedTuple):
@@ -284,11 +287,24 @@ class RowTracer:
 
     def __init__(self, *, worker: str = "w0", capacity: int = 4096,
                  sample: float = 1.0, seed: Optional[int] = None,
+                 record_rows: bool = False,
                  wall: Callable[[], float] = time.time):
         if not 0.0 <= sample <= 1.0:
             raise ValueError(f"sample must be in [0, 1], got {sample}")
+        if record_rows and sample < 1.0:
+            # A recording exists to replay the run's EXACT row set;
+            # head-sampling away clean batches would silently hole it.
+            raise ValueError(
+                f"record_rows needs sample=1.0 (got {sample}): a sampled "
+                "recording cannot reproduce the run's row set")
         self.worker = worker
         self.sample = sample
+        # Record mode (scenarios/record.py): the engine adds one compact
+        # EVENT_ROW block per delivered batch carrying EVERY row's source
+        # coordinates — the census a recorded trace needs for exact
+        # replay. Off (the default), clean rows stay un-enumerated and
+        # only the interesting minority gets row events.
+        self.record_rows = bool(record_rows)
         self.ring = SpanRing(capacity)
         self._rng = random.Random(seed)
         self._wall = wall

@@ -1,0 +1,50 @@
+"""Scenario harness: seeded traffic, recorded-trace replay, game-day SLO
+gates (docs/scenarios.md).
+
+The chaos layer injects faults; this package injects *realistic traffic*
+and judges the system against declared SLOs:
+
+* scenarios/clock.py — one seeded timeline (virtual time + per-component
+  seed derivation) that traffic, ``FaultPlan``, and ``WorkerDeathPlan``
+  all compose on;
+* scenarios/traffic.py — bit-reproducible generators (steady, diurnal,
+  flash crowd, fraud-campaign waves, hot-key skew) and the single
+  scenario-feeder thread;
+* scenarios/record.py / replay.py — serve ``--trace-record`` recordings
+  (the SpanRing as JSONL) replayed with original or warped timing,
+  reproducing the original run's row key set exactly;
+* scenarios/slo.py — declarative pass/fail gates (zero-loss/zero-dup
+  multiset accounting, latency bounds, breaker/shed behavior) evaluated
+  from run evidence;
+* scenarios/gameday.py — scripted multi-failure scenarios as data, a
+  named catalog, and the CLI gate (exit nonzero on violation) that the
+  bench ``scenarios`` section and the CI ``scenario-smoke`` job run.
+"""
+
+from fraud_detection_tpu.scenarios.clock import ScenarioClock, derive_seed
+from fraud_detection_tpu.scenarios.gameday import (CATALOG, ChaosSpec,
+                                                   GameDay, GameDayResult,
+                                                   KillSpec, get_scenario,
+                                                   parse_scenario_ref,
+                                                   run_gameday)
+from fraud_detection_tpu.scenarios.record import (dump_tracer,
+                                                  load_recording,
+                                                  render_recording)
+from fraud_detection_tpu.scenarios.replay import run_replay
+from fraud_detection_tpu.scenarios.slo import (SloReport, SloSpec, evaluate,
+                                               parse_slo)
+from fraud_detection_tpu.scenarios.traffic import (CampaignWave, DiurnalLoad,
+                                                   FlashCrowd, SteadyLoad,
+                                                   TimelineAction,
+                                                   TrafficEvent,
+                                                   TrafficFeeder, TrafficSpec,
+                                                   compose, generate)
+
+__all__ = [
+    "CATALOG", "CampaignWave", "ChaosSpec", "DiurnalLoad", "FlashCrowd",
+    "GameDay", "GameDayResult", "KillSpec", "ScenarioClock", "SloReport",
+    "SloSpec", "SteadyLoad", "TimelineAction", "TrafficEvent",
+    "TrafficFeeder", "TrafficSpec", "compose", "derive_seed", "dump_tracer",
+    "evaluate", "generate", "get_scenario", "load_recording", "parse_slo",
+    "parse_scenario_ref", "render_recording", "run_gameday", "run_replay",
+]

@@ -1,0 +1,689 @@
+"""Game days: scripted multi-failure scenarios as data, with SLO gates.
+
+A :class:`GameDay` declares everything about a run — seeded traffic specs
+(scenarios/traffic.py), broker faults (:class:`ChaosSpec` →
+stream/faults.py ``FaultPlan``), whole-worker deaths (:class:`KillSpec` →
+``WorkerDeathPlan``), a scripted hot swap, scheduler/DLQ config — plus the
+pass/fail :class:`~fraud_detection_tpu.scenarios.slo.SloSpec` gates judged
+from the run's evidence. :func:`run_gameday` executes it against a real
+in-process serving stack and returns a :class:`GameDayResult` whose ``ok``
+bit is the game day's verdict. Every seeded component derives its stream
+from the ONE scenario seed through the :class:`ScenarioClock`, so a game
+day is reproducible end to end: same seed ⇒ same traffic bytes, same fault
+schedule, same death draws, same timeline.
+
+Two runner modes, chosen by the declaration:
+
+* **fleet** (``workers >= 2`` or a kill spec): ``Fleet.in_process`` —
+  partition-owning workers under the lease coordinator, tracing on, the
+  seeded death plan armed, traffic fed live by the scenario-feeder thread.
+  Chaos here is restricted to NON-LETHAL faults (duplicates, corruption,
+  latency, commit fences, lossy flushes): a poll transport error or flush
+  crash is an unhandled worker death in the fleet, which is the KILL
+  spec's job to script, not the fault plan's.
+* **single-engine** (otherwise): one supervised engine
+  (``run_supervised``), where the FULL fault vocabulary applies (the
+  supervisor is the recovery mechanism under test), and where the explain
+  breaker can be exercised: ``breaker_threshold`` wires a deterministic
+  dead explain backend (:class:`FlakyExplainBackend`) behind the PR 1
+  circuit breaker, so a campaign wave's flagged burst trips it while
+  classification keeps flowing.
+
+The named catalog (:data:`CATALOG`) is the regression surface: the bench
+``scenarios`` section and the CI ``scenario-smoke`` job run catalog
+entries and commit the verdicts; ``serve --scenario NAME[:seed]`` drives
+one against a live serve run. CLI::
+
+    python -m fraud_detection_tpu.scenarios.gameday --name campaign_kill_swap
+    python -m fraud_detection_tpu.scenarios.gameday --list
+
+exits 0 on a passing verdict, 1 on any failed SLO — the exit code IS the
+game-day gate (the CI smoke also verifies a deliberately broken SLO fails
+nonzero, so the gate provably gates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from fraud_detection_tpu.scenarios.clock import ScenarioClock
+from fraud_detection_tpu.scenarios.slo import (SloReport, SloSpec, evaluate,
+                                               parse_slo)
+from fraud_detection_tpu.scenarios.traffic import (CampaignWave, DiurnalLoad,
+                                                   FlashCrowd, SteadyLoad,
+                                                   TimelineAction,
+                                                   TrafficFeeder, TrafficSpec,
+                                                   compose)
+
+INPUT_TOPIC = "scenario-in"
+OUTPUT_TOPIC = "scenario-out"
+DLQ_TOPIC = "scenario-dlq"
+
+
+class FlakyExplainBackend:
+    """A deterministically DEAD explain backend: every call raises, like
+    an LLM endpoint mid-outage. Wrapped in the circuit breaker it turns a
+    campaign wave's flagged burst into the breaker-trip scenario — the
+    gate asserts the breaker opened AND classification never stopped."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def _fail(self):
+        self.calls += 1
+        raise ConnectionError(
+            "scenario: explain backend down (scripted outage)")
+
+    def chat(self, messages, **kwargs) -> str:
+        self._fail()
+
+    def generate(self, prompt: str, **kwargs) -> str:
+        self._fail()
+
+
+@dataclass(frozen=True)
+class KillSpec:
+    """Seeded whole-worker deaths (stream/faults.py WorkerDeathPlan);
+    the seed derives from the scenario clock."""
+
+    kills: int = 1
+    modes: Tuple[str, ...] = ("graceful", "crash")
+    min_polls: int = 2
+    max_polls: int = 8
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """Seeded broker-fault rates (stream/faults.py FaultPlan). The
+    lethal kinds (poll errors, flush crashes) are single-engine only —
+    GameDay validation enforces it (see module docstring)."""
+
+    poll_error_rate: float = 0.0
+    latency_spike_rate: float = 0.0
+    duplicate_rate: float = 0.0
+    corrupt_rate: float = 0.0
+    flush_fail_rate: float = 0.0
+    flush_crash_rate: float = 0.0
+    commit_fence_rate: float = 0.0
+    max_faults: int = 40
+
+    @property
+    def lethal(self) -> bool:
+        return self.poll_error_rate > 0 or self.flush_crash_rate > 0
+
+
+@dataclass(frozen=True)
+class GameDay:
+    """One scripted scenario, declared as data (see module docstring)."""
+
+    name: str
+    description: str
+    traffic: Tuple[TrafficSpec, ...]
+    slos: Tuple[SloSpec, ...]
+    seed: int = 0
+    partitions: int = 4
+    workers: int = 1
+    batch_size: int = 256
+    max_wait: float = 0.02
+    sched: Optional[object] = None        # sched.SchedulerConfig
+    dlq: bool = False
+    kills: Optional[KillSpec] = None
+    chaos: Optional[ChaosSpec] = None
+    hot_swap_at: Optional[float] = None   # virtual seconds
+    breaker_threshold: Optional[int] = None
+    lease_ttl: float = 1.0
+    supervise: int = 25
+    idle_timeout: float = 1.0
+
+    def __post_init__(self):
+        if not self.traffic:
+            raise ValueError(f"game day {self.name!r} declares no traffic")
+        if self.workers < 1:
+            raise ValueError(f"workers must be >= 1, got {self.workers}")
+        if self.fleet_mode:
+            if self.breaker_threshold is not None:
+                raise ValueError(
+                    f"game day {self.name!r}: the explain breaker lane is "
+                    "single-engine only (the fleet does not wire explain)")
+            if self.chaos is not None and self.chaos.lethal:
+                raise ValueError(
+                    f"game day {self.name!r}: poll errors / flush crashes "
+                    "kill fleet workers outright — script worker deaths "
+                    "with KillSpec instead")
+        elif self.kills is not None:
+            raise ValueError(
+                f"game day {self.name!r}: worker kills need the fleet "
+                "runner (workers >= 2)")
+
+    @property
+    def fleet_mode(self) -> bool:
+        return self.workers >= 2
+
+    def duration_s(self) -> float:
+        return max(s.at_s + s.duration_s for s in self.traffic)
+
+
+@dataclass
+class GameDayResult:
+    scenario: str
+    seed: int
+    mode: str
+    report: SloReport
+    evidence: dict              # summary evidence (key lists reduced)
+    wall_s: float
+
+    @property
+    def ok(self) -> bool:
+        return self.report.ok
+
+    def as_dict(self) -> dict:
+        return {"scenario": self.scenario, "seed": self.seed,
+                "mode": self.mode, "ok": self.ok,
+                "wall_s": round(self.wall_s, 3),
+                "slo": self.report.as_dict(), "evidence": self.evidence}
+
+    def table(self) -> str:
+        head = (f"game day {self.scenario!r} (seed {self.seed}, "
+                f"{self.mode}): {'PASS' if self.ok else 'FAIL'} "
+                f"in {self.wall_s:.1f}s")
+        return head + "\n" + self.report.table()
+
+
+def _default_pipeline(batch_size: int, seed: int = 7):
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    # Separable corpus: scenario rows are drawn from the same families,
+    # so flagged-row lanes (breaker, annotation) see real pressure.
+    return synthetic_demo_pipeline(
+        batch_size=batch_size, n=300, seed=seed, num_features=2048,
+        corpus_kwargs=dict(hard_fraction=0.0, label_noise=0.0))
+
+
+def _fault_plan(gd: GameDay, clock: ScenarioClock):
+    if gd.chaos is None:
+        return None
+    from fraud_detection_tpu.stream.faults import FaultPlan
+
+    c = gd.chaos
+    return FaultPlan(
+        seed=clock.derive_seed("faults"),
+        poll_error_rate=c.poll_error_rate,
+        latency_spike_rate=c.latency_spike_rate,
+        latency_spike_sec=0.001,
+        duplicate_rate=c.duplicate_rate, corrupt_rate=c.corrupt_rate,
+        flush_fail_rate=c.flush_fail_rate,
+        flush_crash_rate=c.flush_crash_rate,
+        commit_fence_rate=c.commit_fence_rate, max_faults=c.max_faults,
+        sleep=((lambda s: None) if clock.time_scale == 0.0 else time.sleep))
+
+
+def _swap_setup(gd: GameDay, pipeline, clock: ScenarioClock,
+                actions: List[TimelineAction]):
+    """Wrap the pipeline for the scripted hot swap and append the swap
+    action: a v2 candidate (freshly trained, pre-built off-timeline so the
+    timeline only pays the swap itself) lands mid-scenario through the
+    zero-downtime RCU path every worker scores through."""
+    if gd.hot_swap_at is None:
+        return pipeline, None
+    from fraud_detection_tpu.registry.hotswap import HotSwapPipeline
+
+    hot = HotSwapPipeline(pipeline, version=1)
+    candidate = _default_pipeline(gd.batch_size,
+                                  seed=clock.derive_seed("candidate") % 9973)
+    actions.append(TimelineAction(
+        gd.hot_swap_at, "hot_swap_v2",
+        lambda: hot.swap(candidate, version=2)))
+    return hot, hot
+
+
+def _wait_for_feed(feeder: TrafficFeeder, n: int, timeout: float = 30.0):
+    """Block until the feeder has produced ``n`` rows (or finished/died):
+    workers idle-exit on an empty topic, so traffic must visibly exist
+    before the serving side starts its idle clock."""
+    deadline = time.monotonic() + timeout
+    target = min(n, len(feeder.events))
+    while time.monotonic() < deadline:
+        if feeder.fed >= target or feeder.error is not None:
+            return
+        if not feeder.alive():
+            return
+        time.sleep(0.005)
+
+
+def run_gameday(gd: GameDay, *, pipeline=None, time_scale: float = 0.0,
+                extra_slos: Sequence[SloSpec] = ()) -> GameDayResult:
+    """Execute a game day and judge its SLOs (see module docstring)."""
+    from fraud_detection_tpu.stream import InProcessBroker
+
+    clock = ScenarioClock(gd.seed, time_scale=time_scale)
+    events = compose(gd.traffic, clock)
+    if not events:
+        raise ValueError(f"game day {gd.name!r} generated zero rows")
+    actions: List[TimelineAction] = []
+    if pipeline is None:
+        pipeline = _default_pipeline(gd.batch_size)
+    serving, hot = _swap_setup(gd, pipeline, clock, actions)
+    broker = InProcessBroker(num_partitions=gd.partitions)
+    feeder = TrafficFeeder(broker.producer(), INPUT_TOPIC, events, clock,
+                           actions=actions)
+    plan = _fault_plan(gd, clock)
+
+    t0 = time.perf_counter()
+    if gd.fleet_mode:
+        evidence = _run_fleet(gd, serving, broker, feeder, plan, clock)
+    else:
+        evidence = _run_single(gd, serving, broker, feeder, plan, clock)
+    wall = time.perf_counter() - t0
+
+    evidence.update({
+        "scenario": gd.name, "seed": gd.seed,
+        "mode": "fleet" if gd.fleet_mode else "single",
+        "planned": len(events),
+        "fed": feeder.fed,
+        "feeder": feeder.stats(),
+        "fed_keys": [e.key.decode() for e in events],
+        "out_keys": [m.key.decode() for m in broker.messages(OUTPUT_TOPIC)
+                     if m.key is not None],
+        "dlq_keys": [m.key.decode() for m in broker.messages(DLQ_TOPIC)
+                     if m.key is not None],
+        "swaps": hot.swaps if hot is not None else 0,
+        "chaos": plan.report() if plan is not None else None,
+        "wall_s": round(wall, 3),
+    })
+    evidence["shed_fraction"] = round(
+        (evidence.get("stats") or {}).get("shed", 0)
+        / max(1, len(events)), 4)
+    if feeder.error is not None:
+        evidence.setdefault("errors", []).append(
+            f"feeder: {feeder.error!r}")
+
+    report = evaluate(tuple(gd.slos) + tuple(extra_slos), evidence,
+                      scope="gameday")
+    # Verdict-line summary: the full evidence fed the gates above; the
+    # committed line keeps counts and the interesting blocks, not the key
+    # lists or whole health trees.
+    summary = {k: v for k, v in evidence.items()
+               if k not in ("fed_keys", "out_keys", "dlq_keys", "health",
+                            "stage_latency_ms", "traces")}
+    summary["out_rows"] = len(evidence["out_keys"])
+    summary["dlq_rows"] = len(evidence["dlq_keys"])
+    summary["traces"] = [
+        {k: t.get(k) for k in ("worker", "spans_open", "batches_traced",
+                               "batches_closed", "ring_dropped")}
+        for t in evidence.get("traces") or []]
+    return GameDayResult(gd.name, gd.seed,
+                         "fleet" if gd.fleet_mode else "single",
+                         report, summary, wall)
+
+
+def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
+               plan, clock: ScenarioClock) -> dict:
+    from fraud_detection_tpu.fleet import Fleet
+    from fraud_detection_tpu.stream.faults import WorkerDeathPlan
+
+    death_plan = None
+    if gd.kills is not None:
+        k = gd.kills
+        death_plan = WorkerDeathPlan(
+            seed=clock.derive_seed("deaths"), kills=k.kills,
+            min_polls=k.min_polls, max_polls=k.max_polls, modes=k.modes)
+    dlq_topic = DLQ_TOPIC if (gd.dlq or (
+        gd.sched is not None and gd.sched.shed_policy != "none")) else None
+    fleet = Fleet.in_process(
+        broker, serving, INPUT_TOPIC, OUTPUT_TOPIC, gd.workers,
+        batch_size=gd.batch_size, max_wait=gd.max_wait,
+        sched_config=gd.sched, dlq_topic=dlq_topic,
+        death_plan=death_plan, lease_ttl=gd.lease_ttl,
+        heartbeat_interval=0.02, tick_interval=0.02,
+        fault_plan=plan, trace=True, trace_sample=1.0)
+    feeder.start()
+    _wait_for_feed(feeder, n=min(64, len(feeder.events)))
+    # Workers self-drain once input is idle AND the group's committed lag
+    # clears; the idle window must outlast the timeline's longest paced gap.
+    gaps = [b - a for a, b in zip([e.t for e in feeder.events],
+                                  [e.t for e in feeder.events][1:])]
+    idle = max(gd.idle_timeout,
+               2.0 * clock.time_scale * max(gaps, default=0.0))
+    out = fleet.run(idle_timeout=idle, join_timeout=300.0)
+    feeder.join(timeout=120.0)
+    return {
+        "stats": {k: v for k, v in out.items()
+                  if not isinstance(v, (dict, list))},
+        "workers": out["workers"],
+        "per_worker_processed": out["per_worker_processed"],
+        "incarnations": out["incarnations"],
+        "rebalances": out["rebalances"],
+        "lease_expirations": out["lease_expirations"],
+        "deaths": len(out["deaths"]),
+        "death_plan": out.get("death_plan"),
+        "errors": list(out["errors"]),
+        "stage_latency_ms": out.get("stage_latency_ms"),
+        "traces": [t.snapshot() for t in fleet.tracers.values()],
+    }
+
+
+def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
+                plan, clock: ScenarioClock) -> dict:
+    from fraud_detection_tpu.obs.trace import RowTracer
+    from fraud_detection_tpu.stream.engine import (StreamingClassifier,
+                                                   run_supervised)
+
+    tracer = RowTracer(worker="gd0", sample=1.0, capacity=65536)
+    scheduler = None
+    if gd.sched is not None:
+        from fraud_detection_tpu.sched import AdaptiveScheduler
+
+        scheduler = AdaptiveScheduler(gd.sched, gd.batch_size)
+    dlq_topic = (DLQ_TOPIC if (gd.dlq or plan is not None
+                               or (scheduler is not None and scheduler.sheds))
+                 else None)
+    breaker = None
+    hook = None
+    if gd.breaker_threshold is not None:
+        from fraud_detection_tpu.explain import (CircuitBreakerBackend,
+                                                 make_stream_explain_hook)
+
+        breaker = CircuitBreakerBackend(
+            FlakyExplainBackend(), failure_threshold=gd.breaker_threshold,
+            probe_interval=600.0)
+        hook = make_stream_explain_hook(breaker, max_tokens=32)
+
+    dlq_attempts: dict = {}
+    engines: list = []
+
+    def make_engine():
+        consumer = broker.consumer([INPUT_TOPIC], "gameday")
+        producer = broker.producer()
+        if plan is not None:
+            consumer, producer = plan.consumer(consumer), plan.producer(producer)
+        engine = StreamingClassifier(
+            serving, consumer, producer, OUTPUT_TOPIC,
+            batch_size=gd.batch_size, max_wait=gd.max_wait,
+            explain_batch_fn=hook, breaker=breaker,
+            dlq_topic=dlq_topic, dlq_attempts=dlq_attempts,
+            scheduler=scheduler, rowtrace=tracer)
+        engines.append(engine)
+        return engine
+
+    feeder.start()
+    _wait_for_feed(feeder, n=min(64, len(feeder.events)))
+    gaps = [b - a for a, b in zip([e.t for e in feeder.events],
+                                  [e.t for e in feeder.events][1:])]
+    idle = max(gd.idle_timeout,
+               2.0 * clock.time_scale * max(gaps, default=0.0))
+    backoff_rng = random.Random(clock.derive_seed("backoff"))
+    sleep = ((lambda s: time.sleep(min(s, 0.01)))
+             if clock.time_scale == 0.0 else time.sleep)
+    from fraud_detection_tpu.stream.engine import StreamStats, _merge_stats
+
+    total = StreamStats()
+    errors: List[str] = []
+    # The supervisor exits when input goes idle; re-enter while the feeder
+    # is still producing (paced timelines have real gaps) or committed lag
+    # remains — bounded rounds so a wedged run still terminates.
+    for _ in range(5):
+        try:
+            stats = run_supervised(make_engine, max_restarts=gd.supervise,
+                                   idle_timeout=idle, sleep=sleep,
+                                   rng=backoff_rng)
+            _merge_stats(total, stats)
+            total.restarts += stats.restarts
+        except Exception as e:  # noqa: BLE001 — verdict-level failure
+            errors.append(repr(e))
+            stats = getattr(e, "supervisor_stats", None)
+            if stats is not None:
+                _merge_stats(total, stats)
+            break
+        if (not feeder.alive()
+                and broker.group_lag("gameday", [INPUT_TOPIC]) <= 0):
+            break
+    feeder.join(timeout=120.0)
+    health = engines[-1].health() if engines else {}
+    return {
+        "stats": total.as_dict(),
+        "health": health,
+        "sched": scheduler.snapshot() if scheduler is not None else None,
+        "breaker": breaker.snapshot() if breaker is not None else None,
+        "flaky_backend_calls": (breaker.inner.calls
+                                if breaker is not None else None),
+        "traces": [tracer.snapshot()],
+        "errors": errors,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the named catalog (bench `scenarios` section, CI scenario-smoke,
+# serve --scenario, docs/scenarios.md)
+# ---------------------------------------------------------------------------
+
+def _sched_config(**kw):
+    from fraud_detection_tpu.sched import SchedulerConfig
+
+    # Cost-aware measurement is a perf-bench concern; the harness keeps
+    # the fixed ladder so no scenario pays a rung-timing phase.
+    return SchedulerConfig(cost_aware=False, **kw)
+
+
+def _flash_crowd(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="flash_crowd",
+        description="A 20x flash-crowd ramp against admission control: "
+                    "the watermark + AIMD shed must bite on the ramp and "
+                    "every shed row must land as an accounted DLQ record.",
+        seed=seed,
+        traffic=(FlashCrowd(name="crowd", duration_s=3.5, scam_fraction=0.2,
+                            base_rate=120 * scale, peak_rate=2400 * scale,
+                            ramp_at_s=0.6, ramp_s=0.5, hold_s=1.2,
+                            decay_s=0.5),),
+        # Watermark-led shedding: the p99 target is generous because warp
+        # mode (time_scale 0) lands the whole spike in an instant — a
+        # tight target would CoDel-deadline-shed nearly every row on age
+        # alone and the verdict would measure the clock, not the ramp.
+        sched=_sched_config(max_queue=800, shed_policy="adaptive",
+                            target_p99_ms=4000.0),
+        dlq=True,
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            SloSpec("admission_shed_bit", path="stats.shed", op=">=",
+                    limit=1),
+            SloSpec("shed_budget", path="shed_fraction", op="<=",
+                    limit=0.9),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+def _campaign_breaker(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="campaign_breaker",
+        description="A correlated fraud-campaign wave with the explain "
+                    "backend down: the circuit breaker must open and "
+                    "classification must keep flowing, every row "
+                    "accounted.",
+        seed=seed,
+        traffic=(
+            SteadyLoad(name="baseline", rate=150 * scale, duration_s=3.0,
+                       scam_fraction=0.1),
+            CampaignWave(name="campaign", at_s=0.8, duration_s=2.2,
+                         wave_rate=600 * scale, waves=2, wave_s=0.5,
+                         gap_s=0.6),
+        ),
+        breaker_threshold=3,
+        dlq=True,
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            SloSpec("breaker_tripped", path="breaker.opens", op=">=",
+                    limit=1),
+            SloSpec("breaker_fast_fails", path="breaker.fast_fails",
+                    op=">=", limit=1),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+def _campaign_kill_swap(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="campaign_kill_swap",
+        description="THE game day: a fraud-campaign spike while a seeded "
+                    "worker kill rebalances the fleet while a v2 model "
+                    "hot-swaps in — zero-loss/zero-dup accounting must "
+                    "hold through all three at once.",
+        seed=seed,
+        workers=2,
+        partitions=4,
+        kills=KillSpec(kills=1, modes=("graceful", "crash"), min_polls=2,
+                       max_polls=6),
+        hot_swap_at=1.2,
+        lease_ttl=0.8,
+        traffic=(
+            SteadyLoad(name="baseline", rate=200 * scale, duration_s=3.0,
+                       scam_fraction=0.15),
+            CampaignWave(name="campaign", at_s=0.6, duration_s=2.4,
+                         wave_rate=700 * scale, waves=2, wave_s=0.6,
+                         gap_s=0.5),
+        ),
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            SloSpec("worker_killed", path="deaths", op="==", limit=1,
+                    scope="gameday"),
+            SloSpec("hot_swap_landed", path="swaps", op=">=", limit=1,
+                    scope="gameday"),
+            SloSpec("p99_batch_s", path="stats.p99_batch_latency_sec",
+                    op="<=", limit=30.0),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+def _chaos_storm(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="chaos_storm",
+        description="Full-vocabulary broker chaos (transport errors, "
+                    "lossy flushes, fences, duplicates, corruption) under "
+                    "a campaign: the supervisor must converge with zero "
+                    "LOST rows (at-least-once duplicates are the "
+                    "documented semantics).",
+        seed=seed,
+        supervise=40,
+        chaos=ChaosSpec(poll_error_rate=0.05, latency_spike_rate=0.04,
+                        duplicate_rate=0.05, corrupt_rate=0.03,
+                        flush_fail_rate=0.05, flush_crash_rate=0.04,
+                        commit_fence_rate=0.04, max_faults=40),
+        dlq=True,
+        traffic=(
+            SteadyLoad(name="baseline", rate=180 * scale, duration_s=3.0,
+                       scam_fraction=0.2),
+            CampaignWave(name="campaign", at_s=1.0, duration_s=1.8,
+                         wave_rate=500 * scale, waves=1, wave_s=0.8,
+                         gap_s=0.4),
+        ),
+        slos=(
+            SloSpec("zero_loss", kind="zero_loss"),
+            SloSpec("chaos_bit", path="chaos.total", op=">=", limit=1,
+                    scope="gameday"),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+def _diurnal_hotkey(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="diurnal_hotkey",
+        description="A diurnal tide with heavy hot-key/regional skew and "
+                    "no faults: the clean-path control arm — exact "
+                    "accounting and bounded batch latency under a "
+                    "realistic, partition-skewed curve.",
+        seed=seed,
+        traffic=(DiurnalLoad(name="tide", duration_s=4.0,
+                             base_rate=80 * scale, peak_rate=400 * scale,
+                             period_s=4.0, scam_fraction=0.25,
+                             hot_fraction=0.5, hot_keys=3),),
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            SloSpec("p99_batch_s", path="stats.p99_batch_latency_sec",
+                    op="<=", limit=30.0),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+CATALOG: dict = {
+    "flash_crowd": _flash_crowd,
+    "campaign_breaker": _campaign_breaker,
+    "campaign_kill_swap": _campaign_kill_swap,
+    "chaos_storm": _chaos_storm,
+    "diurnal_hotkey": _diurnal_hotkey,
+}
+
+
+def get_scenario(name: str, seed: int = 0, *, scale: float = 1.0) -> GameDay:
+    """Look up a catalog scenario; ``scale`` multiplies every traffic
+    rate (CI/bench run scale < 1 for speed, soaks scale > 1)."""
+    factory = CATALOG.get(name)
+    if factory is None:
+        raise KeyError(
+            f"unknown scenario {name!r}; catalog: {sorted(CATALOG)}")
+    if scale <= 0:
+        raise ValueError(f"scale must be > 0, got {scale}")
+    return factory(seed, scale)
+
+
+def parse_scenario_ref(ref: str) -> Tuple[str, int]:
+    """``NAME[:seed]`` → (name, seed) — the serve --scenario syntax."""
+    name, _, seed_raw = ref.partition(":")
+    if not seed_raw:
+        return name, 0
+    try:
+        return name, int(seed_raw)
+    except ValueError:
+        raise ValueError(f"bad scenario ref {ref!r}: seed must be an int")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Run a named game-day scenario against an in-process "
+                    "serving stack and gate on its SLOs "
+                    "(docs/scenarios.md). Exit 0 = verdict PASS, "
+                    "1 = an SLO failed.")
+    ap.add_argument("--name", default=None,
+                    help=f"catalog scenario ({', '.join(sorted(CATALOG))})")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="traffic-rate multiplier (CI smokes run < 1)")
+    ap.add_argument("--time-scale", type=float, default=0.0,
+                    help="0 = warp (default), 1 = real-time pacing")
+    ap.add_argument("--slo", action="append", default=[], metavar="EXPR",
+                    help="extra gate, e.g. 'stats.p99_batch_latency_sec"
+                         "<=0.5' or a builtin name; repeatable")
+    ap.add_argument("--json", action="store_true",
+                    help="print only the machine-readable verdict line")
+    ap.add_argument("--list", action="store_true",
+                    help="list catalog scenarios and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name in sorted(CATALOG):
+            gd = CATALOG[name](0, 1.0)
+            print(f"{name:22s} {gd.description}")
+        return 0
+    if args.name is None:
+        ap.error("--name is required (or --list)")
+    try:
+        extra = tuple(parse_slo(e) for e in args.slo)
+        gd = get_scenario(args.name, args.seed, scale=args.scale)
+    except (KeyError, ValueError) as e:
+        raise SystemExit(str(e))
+    result = run_gameday(gd, time_scale=args.time_scale, extra_slos=extra)
+    if not args.json:
+        print(result.table())
+    print(json.dumps(result.as_dict()))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1096,6 +1096,13 @@ class StreamingClassifier:
             self.tracer.record("dispatch", inflight.dispatch_time)
             self.tracer.record("finish", finish_dt)
         if bt is not None:
+            if msgs and getattr(self._rowtrace, "record_rows", False):
+                # Record mode (scenarios/record.py): one compact block per
+                # batch carrying every delivered row's source coordinates —
+                # the census an exact replay needs. Same one-entry cost
+                # shape as the flag block; off unless a recording is live.
+                bt.events_rows("row", [(m.partition, m.offset)
+                                       for m in msgs])
             # Terminal: the deliver leg closes and the batch's spans
             # commit to the ring (kept when sampled or interesting).
             bt.add("deliver", time.perf_counter() - t_del,

@@ -1,0 +1,38 @@
+"""flightcheck fixture: a scenario-feeder-shaped driver with the drift
+modes the scenario registrations exist to prevent (never imported).
+
+``RogueScenario`` spawns a feeder thread the entry-point registry doesn't
+know (FC103), and ``FeedBoard`` lets its feeder-thread walk write the
+shared fed counter without the lock its cross-thread stats surface uses
+(FC102) — the drift mode for a grown scenarios/ tree: a new timeline
+driver lands without its concurrency contract being registered/guarded.
+"""
+
+import threading
+
+
+class RogueScenario:
+    def _feeder_main(self):
+        pass
+
+    def launch(self):
+        t = threading.Thread(target=self._feeder_main, daemon=True)
+        t.start()
+        return t
+
+
+class FeedBoard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.fed = 0
+
+    def stats(self):
+        with self._lock:
+            return {"fed": self.fed}
+
+    def _walk(self):
+        self.fed = self.fed + 1     # VIOLATION: shared, no lock
+
+    def _walk_guarded(self):
+        with self._lock:
+            self.fed = self.fed + 1

@@ -1,0 +1,557 @@
+"""Scenario harness (fraud_detection_tpu/scenarios/, docs/scenarios.md).
+
+Pins the subsystem's defining contracts:
+
+* seeded determinism: same seed ⇒ byte-identical generated traffic and
+  event timeline (payloads, keys, virtual times), across compose order;
+  different seed ⇒ different bytes; game-day death schedules reproduce;
+* generator shapes: flash-crowd ramp/hold/decay, campaign-wave windows,
+  hot-key skew concentration;
+* the flash-crowd satellite: an AIMD shed-and-recover pin against the
+  AdmissionController, and an engine-level flash-crowd drain with EXACT
+  DLQ key-set accounting (every input row classified or dead-lettered
+  exactly once, shed counters consistent);
+* trace recording/replay: a recorded run replays to the exact original
+  row key set; incomplete recordings are refused; record mode refuses
+  partial sampling;
+* game days: the flagship campaign+kill+swap scenario passes with
+  zero-loss/zero-dup accounting, a deliberately broken SLO fails the CLI
+  nonzero (the CI gate's contract), SLO parsing/evaluation semantics;
+* serve CLI: --scenario drives a live run and emits the verdict block;
+  --trace-record dumps a complete recording that replays exactly;
+  config-conflict refusals;
+* flightcheck: the scenario-feeder thread is registered end to end and
+  the fx_scenario fixture's violations are caught (FC103/FC102).
+"""
+
+import json
+import os
+
+import pytest
+
+from fraud_detection_tpu.scenarios import (CampaignWave, FlashCrowd,
+                                           ScenarioClock, SloSpec,
+                                           SteadyLoad, TimelineAction,
+                                           TrafficFeeder, compose, evaluate,
+                                           generate, get_scenario, parse_slo,
+                                           run_gameday, run_replay)
+from fraud_detection_tpu.scenarios.clock import derive_seed
+from fraud_detection_tpu.scenarios.record import (dump_tracer,
+                                                  load_recording,
+                                                  recording_rows)
+from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+
+pytestmark = pytest.mark.scenario
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    return synthetic_demo_pipeline(batch_size=128, n=300, seed=3,
+                                   num_features=1024,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
+
+
+# ---------------------------------------------------------------------------
+# clock + determinism
+# ---------------------------------------------------------------------------
+
+def test_seed_derivation_stable_and_independent():
+    # sha256-derived: stable across instances/processes (NOT hash()).
+    assert derive_seed(7, "faults") == derive_seed(7, "faults")
+    assert derive_seed(7, "faults") != derive_seed(7, "deaths")
+    assert derive_seed(7, "faults") != derive_seed(8, "faults")
+    c = ScenarioClock(7)
+    assert c.rng("a").random() == ScenarioClock(7).rng("a").random()
+    assert c.rng("a").random() != c.rng("b").random()
+
+
+def test_clock_warp_advances_without_sleeping():
+    calls = []
+    c = ScenarioClock(0, time_scale=0.0, sleep=calls.append)
+    c.start()
+    c.advance_to(100.0)
+    assert c.now() == 100.0 and calls == []
+    c.advance_to(50.0)          # never goes backwards
+    assert c.now() == 100.0
+
+
+def test_clock_paced_sleeps_scaled():
+    slept = []
+    wall = [0.0]
+    c = ScenarioClock(0, time_scale=0.5, sleep=slept.append,
+                      wall=lambda: wall[0])
+    c.start()
+    c.advance_to(2.0)           # 2 virtual s * 0.5 = 1.0 wall s
+    assert slept == [pytest.approx(1.0)]
+
+
+def test_traffic_same_seed_byte_identical():
+    spec = FlashCrowd(name="crowd", duration_s=1.5, base_rate=100,
+                      peak_rate=800, scam_fraction=0.3)
+    a = generate(spec, 42)
+    b = generate(spec, 42)
+    assert a == b and len(a) > 100
+    assert generate(spec, 43) != a
+    # times non-decreasing, payloads parse, ids unique
+    assert [e.t for e in a] == sorted(e.t for e in a)
+    payloads = [json.loads(e.value) for e in a]
+    assert all("text" in p and p["id"].startswith("crowd-") for p in payloads)
+    assert len({p["id"] for p in payloads}) == len(a)
+
+
+def test_compose_specs_draw_independently():
+    """Adding a second spec never perturbs the first spec's rows, and the
+    merged timeline is time-ordered."""
+    base = SteadyLoad(name="base", rate=80, duration_s=1.0)
+    wave = CampaignWave(name="wave", at_s=0.3, duration_s=0.7,
+                        wave_rate=300, waves=1, wave_s=0.4, gap_s=0.2)
+    alone = compose([base], ScenarioClock(5))
+    together = compose([base, wave], ScenarioClock(5))
+    assert [e for e in together if e.key.startswith(b"base-")
+            or json.loads(e.value)["scenario"] == "base"]
+    base_rows = [e for e in together
+                 if json.loads(e.value)["scenario"] == "base"]
+    assert base_rows == alone
+    assert [e.t for e in together] == sorted(e.t for e in together)
+    with pytest.raises(ValueError):
+        compose([base, SteadyLoad(name="base", rate=1, duration_s=1.0)],
+                ScenarioClock(5))
+
+
+def test_flash_crowd_rate_shape():
+    s = FlashCrowd(base_rate=10, peak_rate=100, ramp_at_s=1.0, ramp_s=1.0,
+                   hold_s=2.0, decay_s=1.0, duration_s=6.0)
+    assert s.rate_at(0.5) == 10
+    assert s.rate_at(1.5) == pytest.approx(55.0)
+    assert s.rate_at(2.5) == 100
+    assert s.rate_at(5.5) == 10
+
+
+def test_campaign_wave_windows_and_skew():
+    s = CampaignWave(name="c", wave_rate=100, waves=2, wave_s=0.5,
+                     gap_s=1.0, duration_s=3.0, hot_fraction=1.0,
+                     hot_keys=3, scam_fraction=1.0)
+    assert s.rate_at(0.25) == 100       # in wave 1
+    assert s.rate_at(1.0) == 0          # in the gap
+    assert s.rate_at(1.75) == 100       # in wave 2
+    assert s.rate_at(3.0) == 0          # past the last wave
+    events = generate(s, 11)
+    assert events and all(e.kind == "scam" for e in events)
+    assert len({e.key for e in events}) <= 3    # fully hot-keyed
+
+
+def test_feeder_actions_fire_in_timeline_order():
+    broker = InProcessBroker(num_partitions=2)
+    events = generate(SteadyLoad(name="s", rate=100, duration_s=1.0), 3)
+    seen = []
+    actions = [TimelineAction(0.5, "mid", lambda: seen.append("mid")),
+               TimelineAction(99.0, "end", lambda: seen.append("end")),
+               TimelineAction(0.2, "boom", lambda: 1 / 0)]
+    feeder = TrafficFeeder(broker.producer(), "in", events,
+                           ScenarioClock(0), actions=actions)
+    feeder.run_inline()
+    assert feeder.error is None
+    stats = feeder.stats()
+    assert stats["fed"] == len(events) == broker.topic_size("in")
+    assert stats["actions_run"] == ["mid", "end"]
+    assert seen == ["mid", "end"]
+    assert stats["action_errors"] and stats["action_errors"][0][0] == "boom"
+
+
+# ---------------------------------------------------------------------------
+# flash crowd vs admission control (the satellite)
+# ---------------------------------------------------------------------------
+
+def test_admission_aimd_sheds_and_recovers():
+    """AIMD pin: the shed fraction climbs while p99 is over target and
+    decays back to zero once latency recovers."""
+    from fraud_detection_tpu.sched.admission import AdmissionController
+
+    class FakeSlo:
+        target_p99_ms = 100.0
+        over = True
+
+        def over_target(self):
+            return self.over
+
+    class Row:
+        timestamp = 0.0     # no broker timestamp: deadline shed exempt
+
+    slo = FakeSlo()
+    ctl = AdmissionController("adaptive", slo=slo)
+    batch = [Row() for _ in range(100)]
+    fractions = []
+    for _ in range(6):
+        ctl.admit(list(batch), None)
+        fractions.append(ctl.shed_fraction)
+    assert fractions[-1] > fractions[0] > 0.0       # climbs under pressure
+    assert ctl.counters["shed_slo"] > 0
+    slo.over = False
+    for _ in range(40):
+        ctl.admit(list(batch), None)
+    assert ctl.shed_fraction == 0.0                 # fully recovered
+    kept, shed = ctl.admit(list(batch), None)
+    assert len(kept) == 100 and shed == []
+
+
+def test_flash_crowd_engine_shed_exact_dlq_accounting(pipeline):
+    """The engine-level satellite: a warp flash crowd against the
+    adaptive admission controller — rows shed, and classified + DLQ keys
+    account for every input row exactly once (multiset)."""
+    from fraud_detection_tpu.sched import AdaptiveScheduler, SchedulerConfig
+
+    clock = ScenarioClock(9)
+    events = compose([FlashCrowd(name="crowd", duration_s=2.0,
+                                 base_rate=80, peak_rate=1500,
+                                 ramp_at_s=0.3, ramp_s=0.4, hold_s=0.8,
+                                 decay_s=0.3, scam_fraction=0.2)], clock)
+    broker = InProcessBroker(num_partitions=3)
+    sched = AdaptiveScheduler(
+        SchedulerConfig(max_queue=200, shed_policy="adaptive",
+                        target_p99_ms=4000.0, cost_aware=False), 128)
+    engine = StreamingClassifier(
+        pipeline, broker.consumer(["in"], "fc"), broker.producer(), "out",
+        batch_size=128, max_wait=0.02, scheduler=sched, dlq_topic="dlq")
+    feeder = TrafficFeeder(broker.producer(), "in", events, clock)
+    feeder.start()
+    stats = engine.run(idle_timeout=1.0)
+    feeder.join(timeout=60.0)
+    engine.consumer.close()
+    assert feeder.error is None and feeder.fed == len(events)
+    assert stats.shed > 0, "the flash crowd never tripped admission"
+    fed = sorted(e.key for e in events)
+    accounted = sorted([m.key for m in broker.messages("out")]
+                       + [m.key for m in broker.messages("dlq")])
+    assert accounted == fed, (
+        f"lost={len(set(fed) - set(accounted))} "
+        f"extra={len(accounted) - len(fed)}")
+    # shed counters and DLQ records agree
+    snap = sched.snapshot()["admission"]
+    assert sum(snap["shed"].values()) == stats.shed
+    reasons = {json.loads(m.value)["reason"]
+               for m in broker.messages("dlq")}
+    assert reasons <= {"shed_queue_full", "shed_rate_limit", "shed_slo",
+                       "shed_deadline"}
+
+
+# ---------------------------------------------------------------------------
+# trace recording + replay
+# ---------------------------------------------------------------------------
+
+def _recorded_run(pipeline, tmp_path, n_rate=400, record_rows=True):
+    from fraud_detection_tpu.obs import RowTracer
+
+    clock = ScenarioClock(13)
+    events = compose([SteadyLoad(name="rec", rate=n_rate, duration_s=1.0,
+                                 scam_fraction=0.4)], clock)
+    broker = InProcessBroker(num_partitions=3)
+    tracer = RowTracer(worker="w0", sample=1.0, capacity=8192,
+                       record_rows=record_rows)
+    engine = StreamingClassifier(
+        pipeline, broker.consumer(["in"], "rec"), broker.producer(), "out",
+        batch_size=128, max_wait=0.02, rowtrace=tracer)
+    feeder = TrafficFeeder(broker.producer(), "in", events, clock)
+    feeder.run_inline()
+    engine.run(max_messages=len(events), idle_timeout=2.0)
+    engine.consumer.close()
+    path = str(tmp_path / "rec.jsonl")
+    header = dump_tracer(tracer, path)
+    return path, header, len(events)
+
+
+def test_record_mode_requires_full_sampling():
+    from fraud_detection_tpu.obs import RowTracer
+
+    with pytest.raises(ValueError, match="record_rows"):
+        RowTracer(record_rows=True, sample=0.5)
+
+
+def test_recording_roundtrip_reproduces_key_set(pipeline, tmp_path):
+    """The acceptance pin: replaying a recorded trace reproduces the
+    original run's row key set EXACTLY."""
+    path, header, n = _recorded_run(pipeline, tmp_path)
+    assert header["complete"] is True and header["spans"] > n
+    loaded_header, spans = load_recording(path)
+    assert loaded_header["worker"] == "w0"
+    coords = recording_rows(spans)
+    assert len(coords) == n         # every fed row in the census
+    report = run_replay(path, pipeline)
+    assert report["keys_exact"] is True
+    assert report["missing"] == 0 and report["duplicated_or_extra"] == 0
+    assert report["rows"] == n and report["fed"] == n
+
+
+def test_incomplete_recording_refused(pipeline, tmp_path):
+    path, header, n = _recorded_run(pipeline, tmp_path,
+                                    record_rows=False)
+    assert header["complete"] is False
+    with pytest.raises(ValueError, match="complete"):
+        run_replay(path, pipeline)
+    # force replays the surviving subset (flagged rows only here)
+    report = run_replay(path, pipeline, force=True)
+    assert report["rows"] < n
+
+
+def test_replay_cli_exit_codes(pipeline, tmp_path, capsys):
+    from fraud_detection_tpu.scenarios import replay as replay_cli
+
+    path, _, _ = _recorded_run(pipeline, tmp_path)
+    assert replay_cli.main([path]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["keys_exact"] is True
+
+
+def test_load_recording_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"format": "something_else"}\n')
+    with pytest.raises(ValueError, match="not a fraud_tpu_trace"):
+        load_recording(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# SLO gates
+# ---------------------------------------------------------------------------
+
+def test_parse_slo_expressions():
+    s = parse_slo("stats.p99_batch_latency_sec<=0.5")
+    assert (s.path, s.op, s.limit) == ("stats.p99_batch_latency_sec",
+                                       "<=", 0.5)
+    assert parse_slo("deaths==1").limit == 1
+    assert parse_slo("breaker.state==open").limit == "open"
+    assert parse_slo("exact_accounting").kind == "exact_accounting"
+    with pytest.raises(ValueError):
+        parse_slo("not an expression")
+
+
+def test_evaluate_builtins_and_metrics():
+    evidence = {
+        "fed_keys": ["a", "b", "b", "c"],
+        "out_keys": ["a", "b", "b"],
+        "dlq_keys": ["c", "c"],            # c duplicated
+        "stats": {"shed": 3},
+        "traces": [{"worker": "w0", "spans_open": 0,
+                    "batches_traced": 2, "batches_closed": 2}],
+    }
+    report = evaluate([
+        SloSpec("loss", kind="zero_loss"),
+        SloSpec("dup", kind="zero_dup"),
+        SloSpec("spans", kind="spans_exact"),
+        SloSpec("shed_ok", path="stats.shed", op="<=", limit=5),
+        SloSpec("missing_path", path="stats.nope", op="<=", limit=5),
+        SloSpec("fleet_only", path="deaths", op="==", limit=1,
+                scope="gameday"),
+    ], evidence, scope="serve")
+    by = {v.name: v for v in report.verdicts}
+    assert by["loss"].ok and not by["dup"].ok
+    assert by["spans"].ok and by["shed_ok"].ok
+    assert not by["missing_path"].ok            # absent evidence FAILS
+    assert by["fleet_only"].skipped             # out-of-scope skips
+    assert not report.ok
+    assert "FAIL" in report.table() and "SKIP" in report.table()
+
+
+def test_spans_exact_skips_only_when_tracing_declared_off():
+    spec = [SloSpec("spans", kind="spans_exact")]
+    assert evaluate(spec, {"traces": [], "tracing": False}).verdicts[0].skipped
+    v = evaluate(spec, {"traces": []}).verdicts[0]
+    assert not v.ok and not v.skipped
+
+
+# ---------------------------------------------------------------------------
+# game days
+# ---------------------------------------------------------------------------
+
+def test_gameday_campaign_kill_swap_flagship(pipeline):
+    """The acceptance pin: campaign spike + seeded worker kill + hot swap
+    completes with zero-loss/zero-dup accounting and a machine-readable
+    PASS verdict."""
+    gd = get_scenario("campaign_kill_swap", 11, scale=0.4)
+    result = run_gameday(gd, pipeline=pipeline)
+    assert result.ok, result.table()
+    by = {v.name: v for v in result.report.verdicts}
+    assert by["exact_accounting"].ok
+    assert result.evidence["deaths"] == 1
+    assert result.evidence["swaps"] >= 1
+    d = result.as_dict()
+    assert d["ok"] is True and d["slo"]["verdicts"]
+
+
+def test_gameday_same_seed_same_timeline(pipeline):
+    """Seeded-determinism pin for the composed timeline: same seed ⇒ same
+    planned traffic AND the same death-plan schedule."""
+    a = run_gameday(get_scenario("campaign_kill_swap", 21, scale=0.3),
+                    pipeline=pipeline)
+    b = run_gameday(get_scenario("campaign_kill_swap", 21, scale=0.3),
+                    pipeline=pipeline)
+    assert a.evidence["planned"] == b.evidence["planned"]
+    assert a.evidence["death_plan"] == b.evidence["death_plan"]
+    c = run_gameday(get_scenario("campaign_kill_swap", 22, scale=0.3),
+                    pipeline=pipeline)
+    assert (c.evidence["planned"] != a.evidence["planned"]
+            or c.evidence["death_plan"] != a.evidence["death_plan"])
+
+
+def test_gameday_breaker_scenario(pipeline):
+    gd = get_scenario("campaign_breaker", 5, scale=0.3)
+    result = run_gameday(gd, pipeline=pipeline)
+    assert result.ok, result.table()
+    assert result.evidence["breaker"]["opens"] >= 1
+    assert result.evidence["breaker"]["state"] == "open"
+    assert result.evidence["flaky_backend_calls"] >= 1
+
+
+def test_gameday_cli_broken_slo_exits_nonzero(pipeline, capsys, monkeypatch):
+    """The CI gate's contract: a deliberately impossible SLO must drive
+    the CLI exit code nonzero; the same scenario without it passes."""
+    from fraud_detection_tpu.scenarios import gameday as gameday_cli
+
+    monkeypatch.setattr(gameday_cli, "_default_pipeline",
+                        lambda *a, **k: pipeline)
+    ok_rc = gameday_cli.main(["--name", "diurnal_hotkey", "--seed", "3",
+                              "--scale", "0.25", "--json"])
+    assert ok_rc == 0
+    bad_rc = gameday_cli.main(["--name", "diurnal_hotkey", "--seed", "3",
+                               "--scale", "0.25", "--json", "--slo",
+                               "stats.p99_batch_latency_sec<=0.000001"])
+    assert bad_rc == 1
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    verdict = json.loads(lines[-1])
+    assert verdict["ok"] is False
+    failed = [v for v in verdict["slo"]["verdicts"] if not v["ok"]]
+    assert failed and failed[0]["name"].startswith("stats.p99")
+
+
+def test_gameday_validation_refusals():
+    from fraud_detection_tpu.scenarios import ChaosSpec, GameDay, KillSpec
+
+    traffic = (SteadyLoad(name="s", rate=10, duration_s=1.0),)
+    with pytest.raises(ValueError, match="fleet runner"):
+        GameDay(name="x", description="", traffic=traffic, slos=(),
+                workers=1, kills=KillSpec())
+    with pytest.raises(ValueError, match="single-engine"):
+        GameDay(name="x", description="", traffic=traffic, slos=(),
+                workers=2, breaker_threshold=3)
+    with pytest.raises(ValueError, match="KillSpec instead"):
+        GameDay(name="x", description="", traffic=traffic, slos=(),
+                workers=2, chaos=ChaosSpec(poll_error_rate=0.1))
+    with pytest.raises(KeyError):
+        get_scenario("no_such_scenario")
+
+
+# ---------------------------------------------------------------------------
+# serve CLI integration
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_scenario_and_trace_record(tmp_path, capsys):
+    from fraud_detection_tpu.app import serve
+
+    rec = tmp_path / "run.jsonl"
+    rc = serve.main(["--model", "synthetic", "--demo", "1",
+                     "--batch-size", "256",
+                     "--scenario", "diurnal_hotkey:3",
+                     "--scenario-scale", "0.25",
+                     "--scenario-time-scale", "0",
+                     "--trace-record", str(rec)])
+    assert rc == 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    out = json.loads(lines[-1])
+    sc = out["scenario"]
+    assert sc["name"] == "diurnal_hotkey" and sc["seed"] == 3
+    assert sc["ok"] is True and sc["fed"] == sc["planned"] > 0
+    names = {v["name"] for v in sc["verdicts"]}
+    assert {"exact_accounting", "spans_exact"} <= names
+    assert out["trace_record"]["complete"] is True
+    # the recorded live run replays to its exact key set
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    report = run_replay(str(rec), synthetic_demo_pipeline(256))
+    assert report["keys_exact"] is True
+
+
+def test_serve_cli_scenario_slo_failure_exit_code(capsys):
+    """flash_crowd without any shed flags: the admission_shed_bit gate
+    must fail and serve must exit 4 (the SLO-violation code)."""
+    from fraud_detection_tpu.app import serve
+
+    rc = serve.main(["--model", "synthetic", "--demo", "1",
+                     "--batch-size", "256",
+                     "--scenario", "flash_crowd:3",
+                     "--scenario-scale", "0.2",
+                     "--scenario-time-scale", "0"])
+    assert rc == 4
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    sc = json.loads(lines[-1])["scenario"]
+    assert sc["ok"] is False
+    failed = {v["name"] for v in sc["verdicts"] if not v["ok"]
+              and not v["skipped"]}
+    assert "admission_shed_bit" in failed
+
+
+def test_serve_cli_scenario_rejects_bad_combos():
+    from fraud_detection_tpu.app import serve
+
+    with pytest.raises(SystemExit, match="--scenario needs --demo"):
+        serve.main(["--model", "synthetic", "--kafka",
+                    "--scenario", "flash_crowd"])
+    with pytest.raises(SystemExit, match="single serve worker"):
+        serve.main(["--model", "synthetic", "--demo", "100",
+                    "--workers", "2", "--scenario", "flash_crowd"])
+    with pytest.raises(SystemExit, match="bad --scenario"):
+        serve.main(["--model", "synthetic", "--demo", "100",
+                    "--scenario", "no_such_scenario"])
+    with pytest.raises(SystemExit, match="single worker"):
+        serve.main(["--model", "synthetic", "--demo", "100",
+                    "--fleet", "2", "--trace-record", "/tmp/x.jsonl"])
+
+
+# ---------------------------------------------------------------------------
+# flightcheck registration
+# ---------------------------------------------------------------------------
+
+def test_scenario_feeder_registered_with_flightcheck():
+    from fraud_detection_tpu.analysis.entrypoints import (
+        CONCURRENT_CLASSES, THREAD_ENTRY_POINTS, THREAD_SITES)
+
+    assert ("scenarios/traffic.py", "self._run") in THREAD_SITES
+    eps = {(ep.module, ep.qualname): ep for ep in THREAD_ENTRY_POINTS}
+    ep = eps[("scenarios/traffic.py", "TrafficFeeder._run")]
+    assert ep.thread == "scenario-feeder" and ep.why_uncovered
+    spec = CONCURRENT_CLASSES["scenarios/traffic.py::TrafficFeeder"]
+    assert "_run" in spec.workers["scenario_feeder"]
+    assert "stats" in spec.any_thread
+
+
+def test_scenario_fixture_violations_detected():
+    """fx_scenario.py drift modes: an unregistered feeder thread (FC103)
+    and a feeder-thread counter write without the stats lock (FC102)."""
+    from fraud_detection_tpu.analysis import concurrency
+    from fraud_detection_tpu.analysis import threads as threadmap
+    from fraud_detection_tpu.analysis.core import SourceFile
+    from fraud_detection_tpu.analysis.entrypoints import ClassSpec
+
+    fixtures = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "flightcheck_fixtures")
+    pkg = os.path.join(os.path.dirname(fixtures), "..",
+                       "fraud_detection_tpu")
+    sf = SourceFile.load(os.path.join(fixtures, "fx_scenario.py"),
+                         "fx_scenario.py")
+    assert sf is not None
+    spawn = [f for f in threadmap.analyze(
+        [sf], package_root=os.path.abspath(pkg),
+        sites_registry=frozenset(), entry_points=())
+        if "spawn site" in f.message]
+    assert len(spawn) == 1 and "_feeder_main" in spawn[0].message
+    spec = ClassSpec(any_thread=frozenset({"stats"}),
+                     workers={"feeder": frozenset({"_walk",
+                                                   "_walk_guarded"})})
+    fc102 = [f for f in concurrency.analyze(
+        [sf], registry={"fx_scenario.py::FeedBoard": spec})
+        if f.rule == "FC102"]
+    assert len(fc102) == 1 and "_walk" in fc102[0].message
+    assert "_walk_guarded" not in fc102[0].message
