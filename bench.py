@@ -122,6 +122,8 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
     dev = line.get("device") or {}
     fleet = line.get("fleet") or {}
     trace = line.get("trace") or {}
+    slotserve = ((line.get("llm") or {}).get("slotserve")
+                 or line.get("slotserve") or {})
     record = {
         "time": round(time.time(), 1) if now is None else now,
         "metric": line.get("metric"),
@@ -153,6 +155,14 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
         "capacity_est_per_s": sweep.get("capacity_est_per_s"),
         "max_load_meeting_target_p99_per_s": sweep.get(
             "max_load_meeting_target_p99_per_s"),
+        # Slotserve lane (ISSUE 13, docs/explain_serving.md): the
+        # continuous-vs-fixed-batch expl/s ratio and the slot arm's rate.
+        "slotserve": ({
+            "ratio": slotserve.get("ratio"),
+            "slot_expl_per_s": slotserve.get("slot_expl_per_s"),
+            "fixed_expl_per_s": slotserve.get("fixed_expl_per_s"),
+            "occupancy": slotserve.get("occupancy"),
+        } if slotserve.get("ratio") is not None else None),
         # Game-day verdicts (ISSUE 12, docs/scenarios.md): one ok bit per
         # named scenario so an SLO regression diffs in the trend file.
         "scenarios": ({name: s.get("ok") for name, s in
@@ -974,8 +984,9 @@ def fleet_bench(pipe, texts, batch_size: int, n_msgs: int) -> dict:
 def scenario_bench(pipe) -> dict:
     """Game-day scenario verdicts (docs/scenarios.md): named catalog
     scenarios — a flash crowd against admission control, the flagship
-    campaign-spike + worker-kill + hot-swap fleet game day, and a
-    full-vocabulary chaos storm — run warp-paced against the in-process
+    campaign-spike + worker-kill + hot-swap fleet game day, a
+    full-vocabulary chaos storm, and the campaign-wave slotserve explain
+    game day (coverage == 1.0) — run warp-paced against the in-process
     stack, each gated by its SLO assertions. The committed evidence is
     the machine-readable verdict per scenario (ok + per-gate bits), so a
     regression in any declared SLO diffs in the artifact and the trend
@@ -986,7 +997,8 @@ def scenario_bench(pipe) -> dict:
     scale = float(os.environ.get("BENCH_SCENARIO_SCALE", "0.5"))
     names = [n for n in os.environ.get(
         "BENCH_SCENARIO_LIST",
-        "flash_crowd,campaign_kill_swap,chaos_storm").split(",") if n]
+        "flash_crowd,campaign_kill_swap,chaos_storm,"
+        "campaign_explain").split(",") if n]
     out = {"seed": seed, "scale": scale, "scenarios": {}}
     for name in names:
         gd = get_scenario(name, seed, scale=scale)
@@ -1522,6 +1534,14 @@ def llm_bench() -> dict:
                     sum(_emitted(r) for r in np.asarray(out_s)) / sdt, 1),
                 "explanations_per_s": round(Bs / sdt, 2)}
 
+    # Slotserve — continuous-batching vs fixed-batch decode (ISSUE 13,
+    # explain/slotserve/, docs/explain_serving.md). BENCH_SLOTSERVE=0 skips.
+    if os.environ.get("BENCH_SLOTSERVE", "1") != "0":
+        try:
+            line["slotserve"] = _slotserve_bench(model)
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            line["slotserve"] = {"error": repr(e)[:300]}
+
     # int8 weight-only decode (models/llm.py quantize_params): decode is
     # weight-streaming bound, so halving the bytes moves tokens/sec — the
     # raw int8 enters the dot and the per-channel scale multiplies the
@@ -1585,6 +1605,92 @@ def llm_bench() -> dict:
             del model, backend
         line["explain_serve"] = _explain_serve_bench(serve_model)
     return line
+
+
+def _slotserve_bench(lm) -> dict:
+    """Continuous-batching slot lane vs fixed-batch decode on the SAME
+    model and the SAME arrival sequence (ISSUE 13 acceptance evidence).
+
+    The workload is the serving shape: flagged-row groups of seeded varied
+    sizes arrive batch by batch (an engine's per-micro-batch flagged
+    counts). The FIXED arm pays the production fixed-batch path per
+    arrival — ``generate_tokens_batch``'s power-of-two bucket padding plus
+    the all-rows barrier (wall tracks the SLOWEST row per batch). The SLOT
+    arm admits every row into the pool as it arrives (iteration-boundary
+    admission, per-slot retirement, fused decode windows) — wall tracks
+    the MEAN emission length at pool width. ``ratio`` is the committed
+    batching-efficiency headline (CI bench-smoke asserts >= 1.5 when the
+    leg lands), and ``admitted == completed + dropped`` is asserted here,
+    not just reported. Both arms are warmed through every compile bucket
+    before timing."""
+    from fraud_detection_tpu.explain.backends import frame_prompt
+    from fraud_detection_tpu.explain.onpod import OnPodBackend, flatten_chat
+    from fraud_detection_tpu.explain.slotserve import SlotServeService
+
+    slots = int(os.environ.get("BENCH_SLOT_SLOTS", "16"))
+    max_tokens = int(os.environ.get("BENCH_SLOT_TOKENS", "48"))
+    n_batches = int(os.environ.get("BENCH_SLOT_BATCHES", "6"))
+    window = int(os.environ.get("BENCH_SLOT_WINDOW", "8"))
+    rng = np.random.default_rng(11)
+    sizes = [int(rng.integers(5, 36)) for _ in range(n_batches)]
+
+    def mk(n, base):
+        return [f"Analyze dialogue {base + i}: the caller claims to be "
+                "the bank fraud department and demands immediate gift "
+                "card payment. " + "Customer hesitates repeatedly. "
+                * int(rng.integers(0, 4)) for i in range(n)]
+
+    batches, b0 = [], 0
+    for n in sizes:
+        batches.append(mk(n, b0))
+        b0 += n
+    total = sum(sizes)
+
+    backend = OnPodBackend.from_model(lm)
+    svc = SlotServeService(lm, slots=slots, max_new_tokens=max_tokens,
+                           prompt_width=448, decode_window=window,
+                           prefill_per_iter=4, max_queue=4096,
+                           wait_timeout=1200.0)
+    try:
+        for b in batches:       # warm: every fixed-arm (B, Tp) bucket
+            backend.generate_batch(b, temperature=0.0,
+                                   max_tokens=max_tokens)
+        svc.generate_batch(batches[0], temperature=0.0,
+                           max_tokens=max_tokens)   # warm: slot programs
+
+        t0 = time.perf_counter()
+        for b in batches:
+            backend.generate_batch(b, temperature=0.0,
+                                   max_tokens=max_tokens)
+        fixed_dt = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        reqs = [svc.submit(flatten_chat(frame_prompt(p)),
+                           max_tokens=max_tokens, temperature=0.0)
+                for b in batches for p in b]
+        for r in reqs:
+            r.wait(1200.0)
+        slot_dt = time.perf_counter() - t0
+        snap = svc.snapshot()
+    finally:
+        svc.close()
+    # The honest-accounting invariant, asserted in the artifact's face
+    # (counters include the warm rows; the invariant covers them too).
+    assert snap["admitted"] == snap["completed"] + snap["dropped"], snap
+    return {
+        "slots": slots, "rows": total, "max_tokens": max_tokens,
+        "decode_window": window, "arrival_batches": sizes,
+        "fixed_expl_per_s": round(total / fixed_dt, 2),
+        "slot_expl_per_s": round(total / slot_dt, 2),
+        "ratio": round(fixed_dt / slot_dt, 2),
+        "occupancy": snap["occupancy"],
+        "admit_to_first_token_ms": snap["admit_to_first_token_ms"],
+        "latency_ms": snap["latency_ms"],
+        "admitted": snap["admitted"],
+        "completed": snap["completed"],
+        "dropped": snap["dropped"],
+        "kv_bytes": snap["kv_bytes"],
+    }
 
 
 def _explain_serve_bench(lm) -> dict:
@@ -1902,6 +2008,22 @@ def main() -> int:
     want_llm = os.environ.get("BENCH_LLM")
     if model == "lr" and (want_llm == "1" or (want_llm is None and _on_tpu())):
         harness.section("llm", lambda scratch: llm_bench(), fraction=0.9)
+    elif model == "lr" and os.environ.get("BENCH_SLOTSERVE", "1") != "0":
+        # Slotserve ratio evidence WITHOUT the llm section (ISSUE 13): the
+        # slot programs are plain jitted XLA over short prompts — no
+        # interpret-mode flash kernel in play — so the continuous-vs-fixed
+        # batching-efficiency ratio is honest and fast on CPU containers.
+        # Runs the SAME leg the llm section embeds, at the demo scale.
+        def slotserve_section(scratch):
+            from fraud_detection_tpu.models import llm as llm_mod
+
+            lm = llm_mod.LanguageModel.init_random(
+                llm_mod.TransformerConfig(d_model=256, n_layers=4,
+                                          n_heads=8, d_ff=1024,
+                                          max_seq=4096), seed=0)
+            return _slotserve_bench(lm)
+
+        harness.section("slotserve", slotserve_section, fraction=0.5)
 
     # The shared host's contention windows can span the whole initial
     # best-of-N; the training/LLM sections above took minutes, so a final

@@ -75,6 +75,9 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     # thread walking a seeded traffic timeline (produces rows to the
     # broker, fires scripted TimelineActions like hot swaps).
     ("scenarios/traffic.py", "self._run"),
+    # Slotserve explain lane (docs/explain_serving.md): ONE worker owning
+    # the slot pool's decoder — admissions, decode windows, retirement.
+    ("explain/slotserve/service.py", "self._run"),
 })
 
 
@@ -161,6 +164,13 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
                "never respawned); counters under _lock, the error field "
                "is a documented write-once latch read after join(), and "
                "broker appends go through the broker's own lock"),
+    EntryPoint("slotserve-lane", "explain/slotserve/service.py",
+               "SlotServeService._run", None,
+               "single worker by construction (one thread started in "
+               "__init__, never respawned); queue/counters under _cv, "
+               "slot-state arrays and the SlotDecoder are worker-only by "
+               "the class's role map, waiters block on per-request "
+               "events"),
 )
 
 
@@ -269,6 +279,16 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     "scenarios/traffic.py::TrafficFeeder": _spec(
         any_thread=("stats", "fed", "alive", "join"),
         scenario_feeder=("_run", "_fire")),
+    # Slotserve lane (docs/explain_serving.md): _run (and the iteration
+    # methods it reaches) executes on the one slotserve-lane worker; the
+    # submit/backend surfaces and snapshot/drain/close are the
+    # cross-thread API — queue/counters under _cv, slot-state arrays
+    # worker-only, request resolution via per-request events.
+    "explain/slotserve/service.py::SlotServeService": _spec(
+        any_thread=("submit", "chat", "generate", "generate_batch",
+                    "explain_rows", "snapshot", "drain", "close",
+                    "set_rowtrace"),
+        slotserve_lane=("_run",)),
 }
 
 
@@ -314,6 +334,8 @@ OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
     "fleet/fleet.py::Fleet.coordinator": ("FleetCoordinator",),
     "fleet/fleet.py::Fleet.bus": ("FleetBus",),
     "fleet/coordinator.py::FleetCoordinator.bus": ("FleetBus",),
+    # Slotserve lane: the service drives its decoder from the lane thread.
+    "explain/slotserve/service.py::SlotServeService._decoder": ("SlotDecoder",),
 }
 
 #: Protocol/ABC name -> concrete in-tree implementations the call-graph
@@ -601,6 +623,72 @@ FLEET_PROTOCOL_VOCABULARY: Tuple[str, ...] = (
 
 #: Package-relative path prefixes FC501 scans for vocabulary call sites.
 FLEET_PROTOCOL_SCOPE: Tuple[str, ...] = ("fleet/",)
+
+
+# ---------------------------------------------------------------------------
+# Decode-slot lifecycle (explain/slotserve/, docs/explain_serving.md): the
+# continuous-batching lane's per-slot protocol, verified by the same
+# FC501-FC503 machinery as the fleet choreography. A slot cycles
+# free → prefill → decode → drain → free; the safety shapes are (a)
+# admissions land at the iteration boundary BEFORE the decode window (free
+# slots never idle through a window while requests queue), and (b) a
+# finished row is fully resolved (_complete) BEFORE its slot returns to the
+# free pool (_release) — slot reuse can never leak an unresolved row.
+# ---------------------------------------------------------------------------
+
+SLOT_PROTOCOLS: Tuple[RoleSpec, ...] = (
+    RoleSpec("Slot", "explain/slotserve/service.py::SlotServeService",
+             ("free", "prefill", "decode", "drain"), "free", (
+        # Iteration boundary: queued requests admit into free slots and
+        # prefill (the decoder writes the prompt's k/v into the slot).
+        _t("admit", "free", "prefill",
+           ("explain/slotserve/service.py::SlotServeService._admit_pending",),
+           ("_decoder.prefill",)),
+        # The admitted row joins the decode set (first token emitted).
+        _t("first_token", "prefill", "decode",
+           ("explain/slotserve/service.py::SlotServeService._admit_pending",),
+           ("_emit",)),
+        # One fused decode window advances every busy slot.
+        _t("step", "decode", "decode",
+           ("explain/slotserve/service.py::SlotServeService._decode_step",),
+           ("_decoder.step",)),
+        # EOS/budget: the row leaves the decode set and drains.
+        _t("finish", "decode", "drain",
+           ("explain/slotserve/service.py::SlotServeService._retire_done",),
+           ("_complete",)),
+        # Resolution done: the slot returns to the free pool.
+        _t("free", "drain", "free",
+           ("explain/slotserve/service.py::SlotServeService._retire_done",),
+           ("_release",)),
+    )),
+)
+
+SLOT_BARRIER_OBLIGATIONS: Tuple[BarrierObligation, ...] = (
+    BarrierObligation(
+        "admission-before-decode",
+        "explain/slotserve/service.py::SlotServeService._iteration",
+        first="call:_admit_pending", then="call:_decode_step",
+        why="admissions must land at the iteration boundary BEFORE the "
+            "decode window, or free slots idle through a whole window "
+            "while flagged rows queue — the continuous-batching property "
+            "itself"),
+    BarrierObligation(
+        "drain-before-free",
+        "explain/slotserve/service.py::SlotServeService._retire_done",
+        first="call:_complete", then="call:_release",
+        why="a finished row must be fully resolved (text decoded, waiter "
+            "released, trace recorded) BEFORE its slot re-enters the free "
+            "pool — slot reuse must never leak an unresolved row's state"),
+)
+
+#: Call patterns that ARE the slot protocol (FC501 scope below): any call
+#: site in slotserve code matching one must be claimed by a SLOT_PROTOCOLS
+#: transition — new decoder traffic cannot land unmodeled.
+SLOT_PROTOCOL_VOCABULARY: Tuple[str, ...] = (
+    "_decoder.prefill", "_decoder.step",
+)
+
+SLOT_PROTOCOL_SCOPE: Tuple[str, ...] = ("explain/slotserve/",)
 
 
 # ---------------------------------------------------------------------------

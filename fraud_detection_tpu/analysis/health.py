@@ -68,6 +68,10 @@ CONTRACTS: Tuple[Contract, ...] = (
     # "trace" sub-object and the metrics exporter both serve it.
     Contract("obs/trace.py", "RowTracer.snapshot",
              "test_obs.py", "TRACE_BLOCK_SCHEMA"),
+    # Slotserve lane (docs/explain_serving.md): the engine's "explain"
+    # sub-object — slots busy/free, admission accounting, expl/s, p50/p99.
+    Contract("explain/slotserve/service.py", "SlotServeService.snapshot",
+             "test_slotserve.py", "SLOTSERVE_BLOCK_SCHEMA"),
 )
 
 

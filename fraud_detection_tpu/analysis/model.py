@@ -274,24 +274,43 @@ def _check_obligations(obligations, index: Dict[str, ast.AST],
 
 def analyze(files: Sequence, *, protocols=None, obligations=None,
             vocabulary=None, scope=None) -> List[Finding]:
-    """FC501-FC503 over the fleet protocol spec. The keyword overrides feed
-    fixture specs through (tests); defaults come from entrypoints.py."""
+    """FC501-FC503 over the declared protocol specs — the fleet rebalance
+    choreography AND the slotserve decode-slot lifecycle. The keyword
+    overrides feed fixture specs through as ONE group (tests); defaults
+    come from entrypoints.py, with FC501's vocabulary scan scoped
+    per-spec-group so fleet vocabulary never lints slotserve files and
+    vice versa."""
     from fraud_detection_tpu.analysis.entrypoints import (
         FLEET_BARRIER_OBLIGATIONS, FLEET_PROTOCOL_SCOPE,
-        FLEET_PROTOCOL_VOCABULARY, FLEET_PROTOCOLS)
+        FLEET_PROTOCOL_VOCABULARY, FLEET_PROTOCOLS,
+        SLOT_BARRIER_OBLIGATIONS, SLOT_PROTOCOL_SCOPE,
+        SLOT_PROTOCOL_VOCABULARY, SLOT_PROTOCOLS)
 
-    protocols = FLEET_PROTOCOLS if protocols is None else protocols
-    obligations = (FLEET_BARRIER_OBLIGATIONS if obligations is None
-                   else obligations)
-    vocabulary = (FLEET_PROTOCOL_VOCABULARY if vocabulary is None
-                  else vocabulary)
-    scope = FLEET_PROTOCOL_SCOPE if scope is None else scope
+    if (protocols is None and obligations is None and vocabulary is None
+            and scope is None):
+        groups = [(FLEET_PROTOCOLS, FLEET_PROTOCOL_VOCABULARY,
+                   FLEET_PROTOCOL_SCOPE),
+                  (SLOT_PROTOCOLS, SLOT_PROTOCOL_VOCABULARY,
+                   SLOT_PROTOCOL_SCOPE)]
+        all_protocols = FLEET_PROTOCOLS + SLOT_PROTOCOLS
+        all_obligations = FLEET_BARRIER_OBLIGATIONS + SLOT_BARRIER_OBLIGATIONS
+    else:
+        protocols = FLEET_PROTOCOLS if protocols is None else protocols
+        obligations = (FLEET_BARRIER_OBLIGATIONS if obligations is None
+                       else obligations)
+        vocabulary = (FLEET_PROTOCOL_VOCABULARY if vocabulary is None
+                      else vocabulary)
+        scope = FLEET_PROTOCOL_SCOPE if scope is None else scope
+        groups = [(protocols, vocabulary, scope)]
+        all_protocols = protocols
+        all_obligations = obligations
 
     index = _method_index(files)
     have_file = {sf.relpath for sf in files}
     findings: List[Finding] = []
-    findings += _check_code_claimed(protocols, vocabulary, scope, files,
-                                    index)
-    findings += _check_spec_reachable(protocols, index, have_file)
-    findings += _check_obligations(obligations, index, have_file)
+    for g_protocols, g_vocabulary, g_scope in groups:
+        findings += _check_code_claimed(g_protocols, g_vocabulary, g_scope,
+                                        files, index)
+    findings += _check_spec_reachable(all_protocols, index, have_file)
+    findings += _check_obligations(all_obligations, index, have_file)
     return findings

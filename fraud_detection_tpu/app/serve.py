@@ -277,6 +277,17 @@ def main(argv=None) -> int:
                          "backend)")
     ap.add_argument("--explain-tokens", type=int, default=128,
                     help="max new tokens per analysis (--explain)")
+    ap.add_argument("--explain-slots", type=int, metavar="N", default=0,
+                    help="serve explanations through the slot-based "
+                         "continuous-batching lane with N decode slots "
+                         "over one persistent KV cache (0 = off; needs an "
+                         "onpod-family --explain backend; implies "
+                         "--explain-async — docs/explain_serving.md). "
+                         "Every flagged row is explained or accounted, "
+                         "and health() gains the 'explain' block")
+    ap.add_argument("--explain-queue", type=int, default=1024,
+                    help="slotserve admission-queue bound (--explain-slots; "
+                         "overflow drops OLDEST with honest accounting)")
     ap.add_argument("--explain-async", action="store_true",
                     help="annotate flagged rows in the background onto "
                          "--annotations-topic instead of inline: "
@@ -430,6 +441,21 @@ def main(argv=None) -> int:
         raise SystemExit(f"--workers must be >= 1, got {args.workers}")
     if args.explain_tokens < 1:
         raise SystemExit(f"--explain-tokens must be >= 1, got {args.explain_tokens}")
+    if args.explain_slots < 0:
+        raise SystemExit(
+            f"--explain-slots must be >= 0, got {args.explain_slots}")
+    if args.explain_queue < 1:
+        raise SystemExit(
+            f"--explain-queue must be >= 1, got {args.explain_queue}")
+    if args.explain_slots > 0:
+        if not args.explain.startswith("onpod"):
+            raise SystemExit(
+                "--explain-slots needs an onpod-family --explain backend "
+                "(onpod:<dir>, onpod-int8:<dir>, or onpod-demo) — the slot "
+                "lane serves a models/llm.py model from this pod")
+        # The slot lane IS the async configuration: classification never
+        # waits for decode, annotations ride the side topic.
+        args.explain_async = True
     if args.explain_async and args.explain == "off":
         raise SystemExit("--explain-async needs an --explain backend")
     if args.annotations_topic is not None and not args.explain_async:
@@ -565,6 +591,7 @@ def main(argv=None) -> int:
 
     explain_hook = None
     breaker = None
+    explain_service = None
     if args.explain != "off":
         from fraud_detection_tpu.explain import make_stream_explain_hook
         from fraud_detection_tpu.utils.config import LLMConfig
@@ -582,12 +609,25 @@ def main(argv=None) -> int:
         temp = (llm_cfg.temperature
                 if args.explain == "deepseek" or "LLM_TEMPERATURE" in os.environ
                 else 0.0)
+        slot_lm = None     # the models/llm.py model --explain-slots serves
         if args.explain == "canned":
             from fraud_detection_tpu.explain import CannedBackend
 
             backend = CannedBackend(responses=[
                 "(offline analysis stub — run --explain onpod:<dir> or "
                 "--explain deepseek for a real model)"])
+        elif args.explain == "onpod-demo":
+            # Tiny random-init on-pod model: the smoke/demo backend for the
+            # slot lane and CLI e2e tests — real decode path, no checkpoint
+            # download, analyses are noise (it says so in the name).
+            from fraud_detection_tpu.explain import OnPodBackend
+            from fraud_detection_tpu.models.llm import (LanguageModel,
+                                                        TransformerConfig)
+
+            slot_lm = LanguageModel.init_random(
+                TransformerConfig(d_model=128, n_layers=2, n_heads=8,
+                                  d_ff=256, max_seq=2048), seed=0)
+            backend = OnPodBackend.from_model(slot_lm)
         elif args.explain.startswith(("onpod:", "onpod-int8:")):
             from fraud_detection_tpu.explain import OnPodBackend
 
@@ -598,8 +638,15 @@ def main(argv=None) -> int:
                 raise SystemExit(
                     f"--explain {spec}: checkpoint dir {ckpt!r} not found")
             try:
-                backend = OnPodBackend.from_hf_checkpoint(
-                    ckpt, int8=spec == "onpod-int8")
+                from fraud_detection_tpu.checkpoint.hf_convert import (
+                    load_hf_checkpoint)
+
+                # Loaded as the model (not just a backend) so the slot
+                # lane can serve the SAME params; OnPodBackend binds it
+                # exactly like from_hf_checkpoint did.
+                slot_lm = load_hf_checkpoint(ckpt, max_seq=4096,
+                                             int8=spec == "onpod-int8")
+                backend = OnPodBackend.from_model(slot_lm)
             except (OSError, ValueError, KeyError, NotImplementedError) as e:
                 # A dir without config.json/safetensors/tokenizer is a config
                 # error, not a crash — under --supervise a raw traceback
@@ -611,6 +658,19 @@ def main(argv=None) -> int:
             backend = llm_cfg.make_backend()
         else:
             raise SystemExit(f"unknown --explain spec {args.explain!r}")
+        if args.explain_slots > 0:
+            # Slot-based continuous batching (docs/explain_serving.md): the
+            # service REPLACES the fixed-batch backend — same LLMBackend
+            # surface, so the breaker below wraps it unchanged.
+            from fraud_detection_tpu.explain.slotserve import SlotServeService
+
+            try:
+                backend = explain_service = SlotServeService(
+                    slot_lm, slots=args.explain_slots,
+                    max_queue=args.explain_queue,
+                    max_new_tokens=args.explain_tokens)
+            except ValueError as e:
+                raise SystemExit(f"--explain-slots: {e}")
         if args.breaker > 0:
             # Breaker wraps the backend BEFORE the hook is built, so every
             # call path (inline hook, async lane) shares one breaker and a
@@ -621,8 +681,18 @@ def main(argv=None) -> int:
             backend = breaker = CircuitBreakerBackend(
                 backend, failure_threshold=args.breaker,
                 probe_interval=args.breaker_probe)
-        explain_hook = make_stream_explain_hook(
-            backend, temperature=temp, max_tokens=args.explain_tokens)
+        if explain_service is not None:
+            # The slot hook passes trace cids through the lane and turns
+            # backend failures into accounted markers (every flagged row
+            # explained or accounted — the slot lane's invariant).
+            from fraud_detection_tpu.explain.slotserve import (
+                make_slot_explain_hook)
+
+            explain_hook = make_slot_explain_hook(
+                backend, temperature=temp, max_tokens=args.explain_tokens)
+        else:
+            explain_hook = make_stream_explain_hook(
+                backend, temperature=temp, max_tokens=args.explain_tokens)
 
     registry = None
     shadow = None
@@ -838,6 +908,13 @@ def main(argv=None) -> int:
                 record_rows=record)
         return tr
 
+    if explain_service is not None and args.trace and args.workers == 1:
+        # Completed explanations land per-row "explain" spans (slot id +
+        # admit wait) on the single worker's chains. Multi-worker runs keep
+        # lane-level spans only: one service serves every worker, and a
+        # row's span must not land on another worker's tracer.
+        explain_service.set_rowtrace(rowtrace_for(0))
+
     if args.fleet > 0:
         # Fleet serving lane (docs/fleet.md): N partition-owning workers
         # under the lease coordinator, health on the fleet bus, shedding on
@@ -875,7 +952,7 @@ def main(argv=None) -> int:
     # a slow leak under --kafka --supervise N; ADVICE round 5), so their
     # contribution to the exit stats lives here instead.
     annotations_harvested = {"submitted": 0, "annotated": 0, "dropped": 0,
-                             "backend_errors": 0}
+                             "drop_records": 0, "backend_errors": 0}
     sched_per_worker: dict = {}
 
     def make_engine(replacing=None, worker=0):
@@ -931,6 +1008,7 @@ def main(argv=None) -> int:
                                 dlq_max_attempts=args.dlq_max_attempts,
                                 dlq_attempts=dlq_attempts,
                                 breaker=breaker,
+                                explain_service=explain_service,
                                 shadow=shadow,
                                 scheduler=scheduler,
                                 async_dispatch=args.async_dispatch,
@@ -941,7 +1019,9 @@ def main(argv=None) -> int:
     def finish_annotations():
         """Drain every LIVE engine's async lane; aggregated counters for
         the stats JSON include the already-harvested replaced incarnations
-        (None when running inline)."""
+        (None when running inline). The slotserve service (if any) closes
+        AFTER the lanes drained — lane workers block inside explain_rows,
+        so lane-drained implies slot-lane idle."""
         if not args.explain_async:
             return None
         agg = dict(annotations_harvested)
@@ -950,6 +1030,8 @@ def main(argv=None) -> int:
             s = e.annotation_stats() or {}
             for k in agg:
                 agg[k] += s.get(k, 0)
+        if explain_service is not None:
+            explain_service.close(timeout=30.0)
         return agg
 
     watch_stop = None
@@ -1106,6 +1188,10 @@ def main(argv=None) -> int:
         annotations = finish_annotations()
         if annotations is not None:
             merged["annotations"] = annotations
+        if explain_service is not None:
+            # Post-drain snapshot: the in-run health captures above may
+            # predate the final lane drain.
+            merged["explain"] = explain_service.snapshot()
         lifecycle_out = finish_lifecycle()
         if lifecycle_out is not None:
             merged["lifecycle"] = lifecycle_out
@@ -1175,6 +1261,9 @@ def main(argv=None) -> int:
     annotations = finish_annotations()
     if annotations is not None:
         out["annotations"] = annotations
+    if explain_service is not None:
+        # Post-drain snapshot (the health block above may predate it).
+        out["explain"] = explain_service.snapshot()
     lifecycle_out = finish_lifecycle()
     if lifecycle_out is not None:
         out["lifecycle"] = lifecycle_out

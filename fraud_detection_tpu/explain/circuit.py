@@ -86,6 +86,11 @@ class CircuitBreakerBackend:
             # Instance attribute: hasattr/getattr probes on the wrapper then
             # match the inner backend's capabilities exactly.
             self.generate_batch = self._generate_batch
+        if hasattr(inner, "explain_rows"):
+            # Slotserve's row-level surface (explain/slotserve/service.py):
+            # forwarded under the same breaker so a dead slot lane
+            # fast-fails instead of stalling the annotation worker.
+            self.explain_rows = self._explain_rows
 
     # ------------------------------------------------------------------
     # state machine
@@ -171,6 +176,10 @@ class CircuitBreakerBackend:
 
     def _generate_batch(self, prompts, **kwargs):
         return self._call(self.inner.generate_batch, prompts, **kwargs)
+
+    def _explain_rows(self, texts, labels, confs, **kwargs):
+        return self._call(self.inner.explain_rows, texts, labels, confs,
+                          **kwargs)
 
     # ------------------------------------------------------------------
     # observability

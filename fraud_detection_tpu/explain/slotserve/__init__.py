@@ -1,0 +1,23 @@
+"""Slotserve — slot-based continuous-batching on-pod explanation service.
+
+One persistent KV pool of decode slots; newly flagged rows admit into free
+slots at iteration boundaries (no fixed-batch barrier), rows retire
+per-slot at EOS, and every flagged row is explained or accounted
+(docs/explain_serving.md).
+"""
+
+from fraud_detection_tpu.explain.slotserve.decode import SlotDecoder
+from fraud_detection_tpu.explain.slotserve.service import (
+    DROPPED_MARKER,
+    UNAVAILABLE_MARKER,
+    SlotServeService,
+    make_slot_explain_hook,
+)
+
+__all__ = [
+    "SlotDecoder",
+    "SlotServeService",
+    "make_slot_explain_hook",
+    "DROPPED_MARKER",
+    "UNAVAILABLE_MARKER",
+]

@@ -1,0 +1,125 @@
+"""Host-side driver of the pooled-slot decode programs (models/llm.py).
+
+One :class:`SlotDecoder` owns ONE persistent KV pool — ``(slots, S, Hkv, d)``
+per layer, allocated once — and the two jitted entries that touch it:
+``slot_prefill`` (admit one prompt into a free slot at an iteration
+boundary) and ``slot_decode_step`` (advance every busy slot one token).
+Compile count is bounded by construction: exactly one decode program for
+the pool, plus one prefill program per prompt bucket (prompt lengths round
+up to ``prompt_bucket`` multiples — the same padding-ladder idea
+sched/batcher.py applies to scoring shapes).
+
+All slot/queue policy (admission, retirement, accounting) lives in
+:mod:`fraud_detection_tpu.explain.slotserve.service`; this class is the
+thin device seam so the policy layer never touches jax directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from fraud_detection_tpu.models import llm
+
+
+class SlotDecoder:
+    """One slot pool + its device programs. NOT thread-safe — owned by the
+    slot lane's single worker thread (the service's contract)."""
+
+    def __init__(self, lm, slots: int, *, prompt_width: int = 384,
+                 max_new_tokens: int = 128, prompt_bucket: int = 64):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if prompt_bucket < 1:
+            raise ValueError(
+                f"prompt_bucket must be >= 1, got {prompt_bucket}")
+        cfg = lm.cfg
+        # Bucket the width itself so the widest prefill is a ladder rung.
+        width = prompt_bucket * (-(-prompt_width // prompt_bucket))
+        max_len = width + max_new_tokens
+        if max_len > cfg.max_seq:
+            raise ValueError(
+                f"slot cache needs {max_len} positions (prompt_width "
+                f"{width} + max_new_tokens {max_new_tokens}) but "
+                f"cfg.max_seq is {cfg.max_seq}")
+        self.lm = lm
+        self.cfg = cfg
+        self.slots = slots
+        self.prompt_width = width
+        self.prompt_bucket = prompt_bucket
+        self.max_new_tokens = max_new_tokens
+        self.max_len = max_len
+        self.cache = llm.init_cache(cfg, slots, max_len)
+        self.kv_bytes = int(sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in self.cache.values()))
+        self.prefills = 0
+        self.steps = 0
+
+    def encode_prompt(self, prompt: str):
+        """Tokenize + truncate to the slot width (head kept: analysis
+        prompts front-load the instruction). Returns
+        ``(int32 tokens, truncated bool)`` — truncation is counted, never
+        silent (same honesty rule as the byte-featurize width)."""
+        toks = self.lm.tokenizer.encode(prompt)
+        truncated = len(toks) > self.prompt_width
+        return np.asarray(toks[: self.prompt_width], np.int32), truncated
+
+    def decode_text(self, tokens) -> str:
+        return self.lm.tokenizer.decode(np.asarray(tokens, np.int32))
+
+    def prefill(self, slot: int, prompt_tokens: np.ndarray,
+                temperature: float, seed: int) -> int:
+        """Admit one prompt into ``slot``; returns the FIRST sampled token
+        (already part of the row's output)."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(prompt_tokens)
+        bucket = self.prompt_bucket * (-(-n // self.prompt_bucket))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt_tokens
+        tok, self.cache = llm.slot_prefill(
+            self.lm.params, jnp.asarray(padded), jnp.int32(n), self.cfg,
+            self.cache, jnp.int32(slot), jnp.float32(temperature),
+            jax.random.PRNGKey(seed & 0x7FFFFFFF))
+        self.prefills += 1
+        return int(tok)
+
+    def step(self, tokens: np.ndarray, lens: np.ndarray, active: np.ndarray,
+             remaining: np.ndarray, temperatures: np.ndarray, seed: int,
+             steps: int):
+        """One fused decode window (up to ``steps`` iterations) over the
+        whole pool; returns ``(out (B, steps) EOS-padded, new_lens,
+        steps_run, active_row_steps)``. ONE host sync per window — the
+        per-token dispatch amortized ``steps``-wide is what makes
+        iteration-level scheduling pay on dispatch-bound hosts too."""
+        import jax
+        import jax.numpy as jnp
+
+        out, new_lens, steps_run, n_act, self.cache = llm.slot_decode_window(
+            self.lm.params, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(lens, jnp.int32), jnp.asarray(active),
+            jnp.asarray(remaining, jnp.int32),
+            self.cfg, self.cache,
+            jnp.asarray(temperatures, jnp.float32),
+            jax.random.PRNGKey(seed & 0x7FFFFFFF), int(steps))
+        self.steps += 1
+        # np.array, not asarray: the lens copy must be writable (the
+        # service mutates it per-slot on prefill/release).
+        return (np.asarray(out), np.array(new_lens), int(steps_run),
+                int(n_act))
+
+    def warm(self, steps: int, prompt: Optional[str] = None) -> None:
+        """Compile the decode window + the smallest prefill bucket off the
+        serving path (one throwaway row through slot 0)."""
+        toks, _ = self.encode_prompt(prompt or "warm")
+        self.prefill(0, toks, 0.0, 0)
+        lens = np.zeros(self.slots, np.int32)
+        lens[0] = len(toks)
+        active = np.zeros(self.slots, bool)
+        active[0] = True
+        remaining = np.ones(self.slots, np.int32)
+        self.step(np.full(self.slots, self.cfg.EOS, np.int32), lens, active,
+                  remaining, np.zeros(self.slots, np.float32), 0, steps)

@@ -775,6 +775,196 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict[str, ja
 
 
 # ---------------------------------------------------------------------------
+# slot decode (continuous batching: explain/slotserve/)
+#
+# The fixed-batch decode below (`_generate_batch_jit`) runs B prompts behind
+# ONE barrier: every row pays device steps until the SLOWEST row finishes,
+# and a new request waits for the whole batch to drain. These two functions
+# are the iteration-level alternative (Orca, OSDI '22): one PERSISTENT
+# (slots, S, Hkv, d) KV pool where each row owns a slot, a prompt prefills
+# into a free slot at any iteration boundary, and one decode step advances
+# every busy slot — per-slot lengths, per-slot retirement, no barrier. The
+# host-side slot/queue management lives in explain/slotserve/; these are the
+# only device programs it runs (exactly one decode compile for the pool, one
+# prefill compile per prompt bucket).
+# ---------------------------------------------------------------------------
+
+
+def _logits_head(x: jax.Array, params: Params, cfg: TransformerConfig) -> jax.Array:
+    """Output-head logits for (N, D) features — the Q8 per-row-scale move
+    `forward` applies, shared by the slot prefill/decode entries."""
+    head = params["lm_head"] if not cfg.tie_embeddings else params["embed"]
+    if isinstance(head, Q8):
+        return (jnp.einsum("nD,VD->nV", x, head.q.astype(cfg.dtype))
+                .astype(jnp.float32) * head.scale[:, 0])
+    return jnp.einsum("nD,VD->nV", x, head).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def slot_prefill(params: Params, tokens: jax.Array, length: jax.Array,
+                 cfg: TransformerConfig, kv_cache: Dict[str, jax.Array],
+                 slot: jax.Array, temperature: jax.Array,
+                 rng: jax.Array):
+    """Prefill ONE prompt into row ``slot`` of a pooled slot cache.
+
+    ``tokens``: (1, Tp) RIGHT-padded (Tp is the prompt bucket — compile
+    count is bounded by the bucket ladder, and ``slot``/``length`` are
+    traced so admitting into any slot reuses the same program).
+    Padding-region k/v DO land in cache rows [length, Tp) — they are
+    garbage, but every later read masks to [0, len] and decode overwrites
+    them in order, so they are never attended. Returns
+    ``(first_token scalar int32, new_cache)`` — the first sampled token is
+    part of the row's output (same convention as ``_generate_batch_jit``:
+    sample from the prefill logits, then feed tokens back one step at a
+    time)."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    x = _embed_rows(params["embed"], tokens, cfg.dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    act = jax.nn.silu if cfg.activation == "silu" else partial(
+        jax.nn.gelu, approximate=True)
+    new_cache: Dict[str, jax.Array] = {}
+    for l in range(cfg.n_layers):
+        h = rms_norm(x, params[f"l{l}.ln1"], cfg.rms_eps)
+        q = _mm("btD,Dhd->bthd", h, params[f"l{l}.wq"], cfg.dtype)
+        k = _mm("btD,Dhd->bthd", h, params[f"l{l}.wk"], cfg.dtype)
+        v = _mm("btD,Dhd->bthd", h, params[f"l{l}.wv"], cfg.dtype)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        # Write this prompt's k/v into the slot's cache rows. Right-padded
+        # overhang is masked by length everywhere downstream.
+        new_cache[f"l{l}.k"] = jax.lax.dynamic_update_slice(
+            kv_cache[f"l{l}.k"], k, (slot, 0, 0, 0))
+        new_cache[f"l{l}.v"] = jax.lax.dynamic_update_slice(
+            kv_cache[f"l{l}.v"], v, (slot, 0, 0, 0))
+        # Causal attention over the prompt itself (padded queries attend
+        # real+pad keys at or below their position — garbage-but-finite,
+        # and only the length-1 position is ever read).
+        attn = causal_attention(q, k, v, use_flash=False)
+        x = x + _mm("bthd,hdD->btD", attn, params[f"l{l}.wo"], cfg.dtype)
+        h2 = rms_norm(x, params[f"l{l}.ln2"], cfg.rms_eps)
+        gate = act(_mm("btD,DF->btF", h2, params[f"l{l}.w_gate"], cfg.dtype))
+        up = _mm("btD,DF->btF", h2, params[f"l{l}.w_up"], cfg.dtype)
+        x = x + _mm("btF,FD->btD", gate * up, params[f"l{l}.w_down"], cfg.dtype)
+    x = rms_norm(x, params["ln_f"], cfg.rms_eps)
+    # Logits at the LAST REAL position only (length-1; right padding means
+    # it is not at Tp-1) — full (Tp, V) logits would pay T times the head.
+    x_last = jax.lax.dynamic_slice_in_dim(x[0], length - 1, 1, 0)  # (1, D)
+    logits = _logits_head(x_last, params, cfg)                     # (1, V)
+    tok = _sample_token(temperature, logits, rng)
+    return tok[0], new_cache
+
+
+def _slot_step_math(params: Params, cfg: TransformerConfig,
+                    kv_cache: Dict[str, jax.Array], tokens: jax.Array,
+                    lens: jax.Array, temperature: jax.Array,
+                    step_key: jax.Array) -> Tuple[jax.Array, Dict]:
+    """The shared single-step math of the slot pool: feed (B,) tokens,
+    scatter their k/v at per-slot index ``lens[b]``, attend each row over
+    its own prefix [0, lens[b]], sample (B,) next tokens (per-slot
+    temperature: greedy rows argmax, sampled rows draw from
+    (key, row) — a slot's stream never depends on its neighbors)."""
+    B = tokens.shape[0]
+    positions = lens[:, None]                                   # (B, 1)
+    x = _embed_rows(params["embed"], tokens[:, None], cfg.dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    act = jax.nn.silu if cfg.activation == "silu" else partial(
+        jax.nn.gelu, approximate=True)
+    rep = cfg.n_heads // cfg.kv_heads
+    rows = jnp.arange(B)
+    new_cache: Dict[str, jax.Array] = {}
+    for l in range(cfg.n_layers):
+        h = rms_norm(x, params[f"l{l}.ln1"], cfg.rms_eps)
+        q = _mm("btD,Dhd->bthd", h, params[f"l{l}.wq"], cfg.dtype)
+        k = _mm("btD,Dhd->bthd", h, params[f"l{l}.wk"], cfg.dtype)
+        v = _mm("btD,Dhd->bthd", h, params[f"l{l}.wv"], cfg.dtype)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        # Per-slot append: row b writes at its own lens[b] (a scatter —
+        # the whole point of slots is rows sitting at different lengths).
+        ck = kv_cache[f"l{l}.k"].at[rows, lens].set(k[:, 0])
+        cv = kv_cache[f"l{l}.v"].at[rows, lens].set(v[:, 0])
+        new_cache[f"l{l}.k"], new_cache[f"l{l}.v"] = ck, cv
+        S = ck.shape[1]
+        # Row b attends its own prefix [0, lens[b]] (the appended token's
+        # own slot included — never a fully-masked row, so no NaN).
+        valid = (jnp.arange(S)[None, None, :]
+                 <= lens[:, None, None])                        # (B, 1, S)
+        attn = _attend(q, _expand_kv_heads(ck, rep),
+                       _expand_kv_heads(cv, rep), valid)
+        x = x + _mm("bthd,hdD->btD", attn, params[f"l{l}.wo"], cfg.dtype)
+        h2 = rms_norm(x, params[f"l{l}.ln2"], cfg.rms_eps)
+        gate = act(_mm("btD,DF->btF", h2, params[f"l{l}.w_gate"], cfg.dtype))
+        up = _mm("btD,DF->btF", h2, params[f"l{l}.w_up"], cfg.dtype)
+        x = x + _mm("btF,FD->btD", gate * up, params[f"l{l}.w_down"], cfg.dtype)
+    x = rms_norm(x, params["ln_f"], cfg.rms_eps)[:, 0]          # (B, D)
+    logits = _logits_head(x, params, cfg)                       # (B, V)
+    greedy = jnp.argmax(logits, -1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    row_keys = jax.vmap(partial(jax.random.fold_in, step_key))(rows)
+    drawn = jax.vmap(lambda k_, lg: jax.random.categorical(k_, lg, -1))(
+        row_keys, scaled)
+    tok = jnp.where(temperature <= 1e-6, greedy, drawn).astype(jnp.int32)
+    return tok, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"))
+def slot_decode_window(params: Params, tokens: jax.Array, lens: jax.Array,
+                       active: jax.Array, remaining: jax.Array,
+                       cfg: TransformerConfig,
+                       kv_cache: Dict[str, jax.Array],
+                       temperature: jax.Array, rng: jax.Array,
+                       steps: int):
+    """Up to ``steps`` fused decode iterations for the WHOLE slot pool —
+    iteration-level scheduling with the per-token dispatch amortized
+    (multi-step scheduling: admissions land at window boundaries, which
+    is the continuous-batching granularity knob).
+
+    ``tokens``: (B,) last sampled token per slot (written this window);
+    ``lens``: (B,) valid cache length per slot; ``active``: (B,) bool —
+    inactive slots compute garbage into index ``lens[b]`` (free slots
+    keep lens 0) which the next prefill overwrites, and always emit EOS;
+    ``remaining``: (B,) per-slot token budget left. A row that samples
+    EOS or exhausts its budget FREEZES for the rest of the window (emits
+    EOS, writes nothing further) — exactly the `_generate_batch_jit`
+    freeze rule — and the loop exits early once every row froze.
+
+    Returns ``(out (B, steps) EOS-padded, new_lens, steps_run,
+    active_row_steps, new_cache)``; the host appends each row's tokens
+    column-by-column under the same freeze rule, so host and device agree
+    bit-for-bit, and steps_run/active_row_steps feed the occupancy
+    accounting."""
+    B = tokens.shape[0]
+    out0 = jnp.full((B, steps), cfg.EOS, jnp.int32)
+
+    def cond(carry):
+        i, _, _, act, _, _, _, _ = carry
+        return (i < steps) & jnp.any(act)
+
+    def body(carry):
+        i, last, lens_c, act_c, rem, cache, out, n_act = carry
+        tok, cache = _slot_step_math(params, cfg, cache, last, lens_c,
+                                     temperature,
+                                     jax.random.fold_in(rng, i))
+        # Rows active this step wrote their fed token's k/v at lens.
+        lens_c = lens_c + act_c.astype(jnp.int32)
+        n_act = n_act + jnp.sum(act_c.astype(jnp.int32))
+        tok = jnp.where(act_c, tok, jnp.int32(cfg.EOS))
+        out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, i))
+        rem = rem - act_c.astype(jnp.int32)
+        act_c = act_c & (tok != cfg.EOS) & (rem > 0)
+        return i + 1, tok, lens_c, act_c, rem, cache, out, n_act
+
+    carry = (jnp.int32(0), tokens, lens, active, remaining, kv_cache, out0,
+             jnp.int32(0))
+    i, _, new_lens, _, _, new_cache, out, n_act = jax.lax.while_loop(
+        cond, body, carry)
+    return out, new_lens, i, n_act, new_cache
+
+
+# ---------------------------------------------------------------------------
 # generation
 # ---------------------------------------------------------------------------
 

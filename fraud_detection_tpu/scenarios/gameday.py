@@ -64,6 +64,7 @@ from fraud_detection_tpu.scenarios.traffic import (CampaignWave, DiurnalLoad,
 INPUT_TOPIC = "scenario-in"
 OUTPUT_TOPIC = "scenario-out"
 DLQ_TOPIC = "scenario-dlq"
+ANNOTATIONS_TOPIC = "scenario-out-annotations"
 
 
 class FlakyExplainBackend:
@@ -137,6 +138,14 @@ class GameDay:
     chaos: Optional[ChaosSpec] = None
     hot_swap_at: Optional[float] = None   # virtual seconds
     breaker_threshold: Optional[int] = None
+    # Slot-based continuous-batching explain lane (explain/slotserve/,
+    # docs/explain_serving.md): N decode slots serve every flagged row
+    # through the async annotation lane; evidence gains the coverage
+    # accounting the explain_coverage gate judges.
+    explain_slots: Optional[int] = None
+    explain_queue: int = 48               # lane queue bound (small = drops
+                                          # exercised; every drop records)
+    explain_tokens: int = 12
     lease_ttl: float = 1.0
     supervise: int = 25
     idle_timeout: float = 1.0
@@ -151,6 +160,11 @@ class GameDay:
                 raise ValueError(
                     f"game day {self.name!r}: the explain breaker lane is "
                     "single-engine only (the fleet does not wire explain)")
+            if self.explain_slots is not None:
+                raise ValueError(
+                    f"game day {self.name!r}: the slotserve explain lane "
+                    "is single-engine only (the fleet does not wire "
+                    "explain)")
             if self.chaos is not None and self.chaos.lethal:
                 raise ValueError(
                     f"game day {self.name!r}: poll errors / flush crashes "
@@ -160,6 +174,16 @@ class GameDay:
             raise ValueError(
                 f"game day {self.name!r}: worker kills need the fleet "
                 "runner (workers >= 2)")
+        if self.breaker_threshold is not None and self.explain_slots is not None:
+            raise ValueError(
+                f"game day {self.name!r}: breaker_threshold scripts a DEAD "
+                "explain backend; pick it or explain_slots, not both "
+                "(breaker-over-slotserve is pinned at the engine level in "
+                "tests/test_slotserve.py)")
+        if self.explain_slots is not None and self.explain_slots < 1:
+            raise ValueError(
+                f"game day {self.name!r}: explain_slots must be >= 1, "
+                f"got {self.explain_slots}")
 
     @property
     def fleet_mode(self) -> bool:
@@ -385,6 +409,10 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
                  else None)
     breaker = None
     hook = None
+    explain_service = None
+    explain_async = gd.explain_slots is not None
+    annotations_agg = {"submitted": 0, "annotated": 0, "dropped": 0,
+                       "drop_records": 0, "backend_errors": 0}
     if gd.breaker_threshold is not None:
         from fraud_detection_tpu.explain import (CircuitBreakerBackend,
                                                  make_stream_explain_hook)
@@ -393,19 +421,56 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
             FlakyExplainBackend(), failure_threshold=gd.breaker_threshold,
             probe_interval=600.0)
         hook = make_stream_explain_hook(breaker, max_tokens=32)
+    elif explain_async:
+        # Slotserve lane (docs/explain_serving.md): a tiny seeded on-pod
+        # model serves every flagged row through the slot pool behind the
+        # async annotation lane; the lane's SMALL queue (gd.explain_queue)
+        # makes campaign waves exercise drop-OLDEST, and every drop leaves
+        # a structured record — coverage stays exactly 1.0.
+        from fraud_detection_tpu.explain.slotserve import (
+            SlotServeService, make_slot_explain_hook)
+        from fraud_detection_tpu.models.llm import (LanguageModel,
+                                                    TransformerConfig)
+
+        lm = LanguageModel.init_random(
+            TransformerConfig(d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                              max_seq=1024),
+            seed=clock.derive_seed("explain-lm") % (2 ** 31))
+        explain_service = SlotServeService(
+            lm, slots=gd.explain_slots, max_queue=4096,
+            max_new_tokens=gd.explain_tokens, prompt_width=256,
+            rowtrace=tracer)
+        hook = make_slot_explain_hook(explain_service,
+                                      max_tokens=gd.explain_tokens)
 
     dlq_attempts: dict = {}
     engines: list = []
+
+    def harvest_annotations(engine) -> None:
+        engine.close_annotations(timeout=120.0)
+        s = engine.annotation_stats() or {}
+        for k in annotations_agg:
+            annotations_agg[k] += s.get(k, 0)
 
     def make_engine():
         consumer = broker.consumer([INPUT_TOPIC], "gameday")
         producer = broker.producer()
         if plan is not None:
             consumer, producer = plan.consumer(consumer), plan.producer(producer)
+        if engines and explain_async:
+            # One live lane at a time: drain + harvest the replaced
+            # incarnation's counters (serve.py's make_engine contract).
+            harvest_annotations(engines[-1])
         engine = StreamingClassifier(
             serving, consumer, producer, OUTPUT_TOPIC,
             batch_size=gd.batch_size, max_wait=gd.max_wait,
             explain_batch_fn=hook, breaker=breaker,
+            explain_async=explain_async,
+            annotations_producer=(broker.producer() if explain_async
+                                  else None),
+            annotations_topic=ANNOTATIONS_TOPIC,
+            annotations_queue=gd.explain_queue,
+            explain_service=explain_service,
             dlq_topic=dlq_topic, dlq_attempts=dlq_attempts,
             scheduler=scheduler, rowtrace=tracer)
         engines.append(engine)
@@ -444,6 +509,21 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
                 and broker.group_lag("gameday", [INPUT_TOPIC]) <= 0):
             break
     feeder.join(timeout=120.0)
+    annotations = None
+    explain_snap = None
+    coverage = None
+    if explain_async:
+        if engines:
+            harvest_annotations(engines[-1])
+        explain_service.close(timeout=60.0)
+        explain_snap = explain_service.snapshot()
+        annotations = dict(annotations_agg)
+        # THE slot-lane invariant: every flagged row handed to the lane is
+        # explained (annotated) OR accounted by a structured drop record —
+        # a bare drop counter would read as coverage < 1.0 here.
+        coverage = round((annotations["annotated"]
+                          + annotations["drop_records"])
+                         / max(1, annotations["submitted"]), 6)
     health = engines[-1].health() if engines else {}
     return {
         "stats": total.as_dict(),
@@ -452,6 +532,15 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         "breaker": breaker.snapshot() if breaker is not None else None,
         "flaky_backend_calls": (breaker.inner.calls
                                 if breaker is not None else None),
+        "annotations": annotations,
+        "explain": explain_snap,
+        "explain_coverage": coverage,
+        "explain_accounting_exact": (
+            None if explain_snap is None
+            else explain_snap["admitted"] == (explain_snap["completed"]
+                                              + explain_snap["dropped"])),
+        "annotation_rows": (broker.topic_size(ANNOTATIONS_TOPIC)
+                            if explain_async else None),
         "traces": [tracer.snapshot()],
         "errors": errors,
     }
@@ -561,6 +650,43 @@ def _campaign_kill_swap(seed: int, scale: float) -> GameDay:
         ))
 
 
+def _campaign_explain(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="campaign_explain",
+        description="A fraud-campaign wave drives the slotserve "
+                    "continuous-batching explain lane: every flagged row "
+                    "must be explained or leave a structured drop record "
+                    "(explain_coverage == 1.0), slot accounting must be "
+                    "exact, and p99 explain latency bounded.",
+        seed=seed,
+        traffic=(
+            SteadyLoad(name="baseline", rate=100 * scale, duration_s=2.5,
+                       scam_fraction=0.15),
+            CampaignWave(name="campaign", at_s=0.5, duration_s=1.8,
+                         wave_rate=400 * scale, waves=2, wave_s=0.5,
+                         gap_s=0.4),
+        ),
+        explain_slots=8,
+        explain_queue=48,
+        explain_tokens=12,
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            # THE gate this scenario exists for: flagged rows handed to
+            # the lane are annotated OR drop-recorded — never silently
+            # sampled away.
+            SloSpec("explain_coverage", path="explain_coverage", op="==",
+                    limit=1.0),
+            SloSpec("explained_bit", path="annotations.annotated", op=">=",
+                    limit=1),
+            SloSpec("slot_accounting_exact", path="explain_accounting_exact",
+                    op="==", limit=True),
+            SloSpec("explain_p99_ms", path="explain.latency_ms.p99",
+                    op="<=", limit=60000.0),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
 def _chaos_storm(seed: int, scale: float) -> GameDay:
     return GameDay(
         name="chaos_storm",
@@ -616,6 +742,7 @@ def _diurnal_hotkey(seed: int, scale: float) -> GameDay:
 CATALOG: dict = {
     "flash_crowd": _flash_crowd,
     "campaign_breaker": _campaign_breaker,
+    "campaign_explain": _campaign_explain,
     "campaign_kill_swap": _campaign_kill_swap,
     "chaos_storm": _chaos_storm,
     "diurnal_hotkey": _diurnal_hotkey,

@@ -80,11 +80,23 @@ class AsyncAnnotationLane:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
+        # Structured drop records pending emission (built at the drop
+        # site under _cv, produced by the WORKER so they ride the lane's
+        # single-producer delivery accounting): (value_bytes, key, cid).
+        self._drop_backlog: List[tuple] = []
         # Counters guarded by _cv's lock (submitted/dropped mutate under it);
         # annotated/errors are worker-thread-only writes, read-racy by design
         # (stats snapshots, not invariants).
         self.submitted = 0
         self.dropped = 0
+        # Drop records DELIVERED to the side topic (worker-thread tally,
+        # like ``annotated``): a drop-OLDEST eviction is not a bare
+        # counter — it emits a structured record carrying the row's trace
+        # cid, so under slotserve every flagged row is explained OR
+        # accounted, join-able to ``chain(cid)``. ``dropped`` >
+        # ``drop_records`` only for close()-residual discards (no worker
+        # left to deliver them) or undelivered flushes — both logged.
+        self.drop_records = 0
         self.annotated = 0
         self.backend_errors = 0
         # Records handed to the producer across the lane's lifetime: the
@@ -104,8 +116,13 @@ class AsyncAnnotationLane:
         """Enqueue (key, text, label, confidence[, trace_cid]) rows;
         never blocks.
 
-        Over capacity, the OLDEST queued rows are dropped (and counted) —
-        under sustained overload the lane annotates a sliding recent sample.
+        Over capacity, the OLDEST queued rows are dropped and counted —
+        under sustained overload the lane annotates a sliding recent
+        sample — and each eviction leaves a STRUCTURED drop record
+        (``{"dropped": true, "reason": "queue_overflow", "trace": cid}``
+        keyed like the source row) for the worker to produce to the side
+        topic: the sampling rate is a recorded, join-able fact per row,
+        not a bare counter.
         """
         if not items:
             return
@@ -114,24 +131,57 @@ class AsyncAnnotationLane:
                 return
             for it in items:
                 if len(self._q) >= self.max_queue:
-                    self._q.popleft()
+                    old = self._q.popleft()
                     self.dropped += 1
+                    self._drop_backlog.append(
+                        self._drop_record(old, "queue_overflow"))
                 self._q.append(it)
             self.submitted += len(items)
             self._idle.clear()
             self._cv.notify()
 
+    @staticmethod
+    def _drop_record(item: tuple, reason: str) -> tuple:
+        """Build one structured drop record from a queued item; returns
+        (value_bytes, key, cid). Schema mirrors the annotation record
+        (docs/robustness.md): same key, ``analysis`` null, ``dropped``
+        true, ``trace`` = the row's correlation id when the engine traces
+        — a DLQ-style accounting record on the annotations topic."""
+        key, _text, label, conf = item[:4]
+        cid = item[4] if len(item) == 5 else None
+        rec = {"prediction": label, "label": label_name(label),
+               "confidence": round(conf, 6), "analysis": None,
+               "dropped": True, "reason": reason}
+        if cid is not None:
+            rec["trace"] = cid
+        return json.dumps(rec).encode(), key, cid
+
     def _run(self) -> None:
         while True:
             with self._cv:
-                while not self._q and not self._closed:
+                while (not self._q and not self._drop_backlog
+                       and not self._closed):
                     self._idle.set()
                     self._cv.wait(timeout=0.2)
-                if not self._q and self._closed:
+                if not self._q and not self._drop_backlog and self._closed:
                     self._idle.set()
                     return
+                drops, self._drop_backlog = self._drop_backlog, []
                 batch = [self._q.popleft()
                          for _ in range(min(len(self._q), self.max_batch))]
+            if drops:
+                # Before the batch: a drop record must not wait behind a
+                # multi-second decode — its row's accounting is already due.
+                try:
+                    self._emit_drops(drops)
+                except Exception:  # noqa: BLE001 — lane must survive anything
+                    # flightcheck: ignore[FC102] — worker-thread-only counter, read-racy by design (see __init__)
+                    self.backend_errors += 1
+                    log.exception("emitting %d drop records failed "
+                                  "(counted in dropped, not drop_records)",
+                                  len(drops))
+            if not batch:
+                continue
             try:
                 self._annotate(batch)
             except Exception:  # noqa: BLE001 — lane must survive anything
@@ -139,6 +189,27 @@ class AsyncAnnotationLane:
                 self.backend_errors += 1
                 log.exception("annotation batch failed (%d rows dropped); "
                               "classification unaffected", len(batch))
+
+    def _emit_drops(self, drops: List[tuple]) -> None:
+        """Produce + flush the pending structured drop records (worker
+        thread, the lane's own producer — same delivery accounting rule as
+        annotation records: produce, then flush, then count delivered)."""
+        for value, key, _cid in drops:
+            self._producer.produce(self.topic, value, key=key)
+        undelivered = int(self._producer.flush() or 0)
+        delivered = len(drops) - min(len(drops), undelivered)
+        # flightcheck: ignore[FC102] — worker-thread-only tally, read-racy by design
+        self.drop_records += delivered
+        if undelivered:
+            log.warning("producer left %d drop records undelivered "
+                        "(dropped counter stays ahead of drop_records)",
+                        undelivered)
+        if self._rowtrace is not None:
+            for _value, _key, cid in drops:
+                if cid is not None:
+                    self._rowtrace.record_event(
+                        cid, "annotate", ok=False,
+                        detail="dropped:queue_overflow")
 
     def _annotate(self, batch: List[tuple]) -> None:
         # Items are (key, text, label, conf[, cid]) — the correlation id
@@ -148,7 +219,13 @@ class AsyncAnnotationLane:
         tr = self._rowtrace
         t0 = time.perf_counter()
         try:
-            analyses = self._fn(texts, labels, confs)
+            if getattr(self._fn, "accepts_cids", False):
+                # Slotserve hooks (explain/slotserve/make_slot_explain_hook)
+                # take the rows' trace cids so each explanation's slot +
+                # latency lands on the row's own chain(cid).
+                analyses = self._fn(texts, labels, confs, cids=cids)
+            else:
+                analyses = self._fn(texts, labels, confs)
         except Exception as e:
             if tr is not None:
                 # One failed explain span for the batch + a failed
@@ -228,8 +305,10 @@ class AsyncAnnotationLane:
                 with self._cv:
                     # Re-queued rows cleared _idle under the same lock (see
                     # submit), so observing idle + empty here is conclusive
-                    # and a stale idle cannot busy-spin this loop.
-                    if not self._q:
+                    # and a stale idle cannot busy-spin this loop. Pending
+                    # drop records count as work: drained means every due
+                    # accounting record reached the topic too.
+                    if not self._q and not self._drop_backlog:
                         return True
 
     def close(self, timeout: float = 30.0) -> bool:
@@ -273,5 +352,6 @@ class AsyncAnnotationLane:
             depth = len(self._q)
             return {"submitted": self.submitted, "annotated": self.annotated,
                     "dropped": self.dropped,
+                    "drop_records": self.drop_records,
                     "backend_errors": self.backend_errors,
                     "queue_depth": depth}

@@ -233,11 +233,13 @@ class StreamingClassifier:
         explain_async: bool = False,
         annotations_topic: Optional[str] = None,
         annotations_producer: Optional[Producer] = None,
+        annotations_queue: int = 1024,
         tracer: Optional[Tracer] = None,
         dlq_topic: Optional[str] = None,
         dlq_max_attempts: int = 3,
         dlq_attempts: Optional[dict] = None,
         breaker: Optional[object] = None,
+        explain_service: Optional[object] = None,
         shadow: Optional[object] = None,
         scheduler: Optional[object] = None,
         async_dispatch: bool = False,
@@ -298,7 +300,7 @@ class StreamingClassifier:
             self._annotation_lane = AsyncAnnotationLane(
                 explain_batch_fn, annotations_producer,
                 annotations_topic or f"{output_topic}-annotations",
-                rowtrace=rowtrace)
+                max_queue=annotations_queue, rowtrace=rowtrace)
             self.explain_fn = explain_fn = None
             self.explain_batch_fn = explain_batch_fn = None
         # Optional utils.tracing.Tracer: per-batch "dispatch" / "finish"
@@ -333,6 +335,11 @@ class StreamingClassifier:
         # ``snapshot()``) — health() surfaces its state; the engine never
         # calls it directly (the explain hook / annotation lane own calls).
         self._breaker = breaker
+        # Optional explain/slotserve SlotServeService (anything with
+        # ``snapshot()``): the continuous-batching explanation lane behind
+        # the explain hook. Same contract as the breaker — health()
+        # surfaces its slot/queue/latency block, the hook owns the calls.
+        self._explain_service = explain_service
         # Optional sched/scheduler.AdaptiveScheduler: owns the consume->
         # score handoff — deadline-driven dynamic batching over the padding
         # ladder, admission control (explicit shedding to the DLQ lane),
@@ -835,6 +842,7 @@ class StreamingClassifier:
         now = self._clock()
         lane = self._annotation_lane
         breaker = self._breaker
+        explain_service = self._explain_service
         # Model-lifecycle block (docs/model_lifecycle.md): present when the
         # engine scores through a HotSwapPipeline (active/staged versions,
         # swap count) and/or a ShadowScorer is attached (divergence stats);
@@ -875,6 +883,13 @@ class StreamingClassifier:
             "annotations": lane.stats() if lane is not None else None,
             "breaker": (breaker.snapshot()
                         if breaker is not None and hasattr(breaker, "snapshot")
+                        else None),
+            # Slotserve lane (docs/explain_serving.md): slots busy/free,
+            # admission queue, admitted/completed/dropped accounting,
+            # expl/s, p50/p99 explain latency, kv_bytes.
+            "explain": (explain_service.snapshot()
+                        if explain_service is not None
+                        and hasattr(explain_service, "snapshot")
                         else None),
             "model": model,
             # Row-tracing accounting (obs/trace.py): span begun/ended

@@ -40,7 +40,8 @@ def test_lane_annotates_and_keys_records():
                              "confidence": 0.9, "analysis": "analysis 1"}
     assert by_key[b"k2"]["prediction"] == 2
     assert lane.stats() == {"submitted": 2, "annotated": 2, "dropped": 0,
-                            "backend_errors": 0, "queue_depth": 0}
+                            "drop_records": 0, "backend_errors": 0,
+                            "queue_depth": 0}
 
 
 def test_lane_bounded_queue_drops_oldest():

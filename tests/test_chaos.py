@@ -568,7 +568,8 @@ def test_health_snapshot_fields_and_monotonic_ages(pipeline):
                        "consecutive_flush_failures", "processed",
                        "malformed", "dead_lettered", "shed",
                        "row_latency_ms", "device", "sched", "dlq",
-                       "annotations", "breaker", "model", "trace"}
+                       "annotations", "breaker", "explain", "model",
+                       "trace"}
     assert h1["shed"] == 0 and h1["sched"] is None   # no scheduler attached
     assert h1["model"] is None          # plain pipeline: no lifecycle block
     assert h1["running"] is False
@@ -617,6 +618,7 @@ ANNOTATION_STATS_SCHEMA = {
     "submitted": (int,),
     "annotated": (int,),
     "dropped": (int,),
+    "drop_records": (int,),
     "backend_errors": (int,),
     "queue_depth": (int,),
 }

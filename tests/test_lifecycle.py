@@ -380,6 +380,7 @@ ENGINE_HEALTH_SCHEMA = {
     "dlq": (type(None), dict),
     "annotations": (type(None), dict),
     "breaker": (type(None), dict),
+    "explain": (type(None), dict),
     "model": (type(None), dict),
     "trace": (type(None), dict),
 }

@@ -1,0 +1,511 @@
+"""Slotserve invariant suite (docs/explain_serving.md).
+
+Pins the continuous-batching lane's CLAIMS, not just its plumbing:
+
+* **decode parity** — a row decoded through the slot pool emits exactly
+  the fixed-batch path's greedy tokens, including after slot reuse (the
+  cross-slot KV-contamination pin: a recycled slot must never leak a
+  prior row's cache);
+* **FIFO-per-row output** — ``generate_batch``/``explain_rows`` replies
+  align positionally with their prompts whatever order rows retire in;
+* **honest accounting** — ``admitted == completed + dropped`` always
+  (queue overflow, close residue, decoder death), and every annotation-
+  lane drop-OLDEST eviction leaves a STRUCTURED record carrying the
+  row's trace cid, join-able to ``chain(cid)``;
+* **degradation** — a dead decoder fails requests with BackendError (the
+  breaker's food), the slot hook converts failures into accounted
+  markers, and the lane recovers when the device comes back;
+* **schema** — ``snapshot()`` is the engine's ``health()["explain"]``
+  block, key set pinned here for FC301;
+* **end to end** — seeded chaos + the serve CLI (``--explain-slots N``)
+  + the ``campaign_explain`` game day's coverage gate.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.explain.backends import BackendError, frame_prompt
+from fraud_detection_tpu.explain.circuit import (BreakerOpenError,
+                                                 CircuitBreakerBackend)
+from fraud_detection_tpu.explain.onpod import OnPodBackend, flatten_chat
+from fraud_detection_tpu.explain.slotserve import (DROPPED_MARKER,
+                                                   UNAVAILABLE_MARKER,
+                                                   SlotServeService,
+                                                   make_slot_explain_hook)
+from fraud_detection_tpu.models import llm
+from fraud_detection_tpu.stream import InProcessBroker, StreamingClassifier
+
+pytestmark = pytest.mark.slotserve
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = llm.TransformerConfig(d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                                max_seq=1024)
+    return llm.LanguageModel.init_random(cfg, seed=3)
+
+
+def make_service(lm, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_new_tokens", 24)
+    kw.setdefault("prompt_width", 448)
+    kw.setdefault("decode_window", 8)
+    kw.setdefault("wait_timeout", 120.0)
+    return SlotServeService(lm, **kw)
+
+
+def prompts_varied(n, base=0):
+    return [f"Analyze dialogue {base + i}: the caller claims to be the "
+            "bank fraud department and demands gift cards. "
+            + "Customer hesitates. " * (i % 4) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# decode parity + FIFO + slot reuse
+# ---------------------------------------------------------------------------
+
+def test_slot_outputs_match_fixed_batch_greedy(lm):
+    """Greedy outputs through the slot pool == the fixed-batch decode path
+    (generate_tokens_batch under OnPodBackend), positionally aligned.
+    12 prompts through 4 slots forces REUSE: every slot serves ~3 rows, so
+    equality here is also the cross-slot KV-contamination pin."""
+    svc = make_service(lm)
+    try:
+        prompts = prompts_varied(12)
+        got = svc.generate_batch(prompts, temperature=0.0, max_tokens=24)
+        want = OnPodBackend.from_model(lm).generate_batch(
+            prompts, temperature=0.0, max_tokens=24)
+        assert got == list(want)
+        snap = svc.snapshot()
+        assert snap["admitted"] == 12
+        assert snap["completed"] == 12
+        assert snap["dropped"] == 0
+        assert snap["truncated"] == 0
+        assert snap["prefills"] == 12
+    finally:
+        assert svc.close()
+
+
+def test_slot_reuse_never_leaks_prior_kv(lm):
+    """The SAME prompt decodes identically fresh and after heavy pool
+    churn — a reused slot whose stale cache tail leaked into attention
+    would diverge here."""
+    svc = make_service(lm, slots=2)
+    try:
+        probe = "Analyze dialogue 999: urgent wire transfer demanded now."
+        fresh = svc.generate_batch([probe], temperature=0.0, max_tokens=24)
+        svc.generate_batch(prompts_varied(6, base=50), temperature=0.0,
+                           max_tokens=24)       # churn both slots
+        again = svc.generate_batch([probe], temperature=0.0, max_tokens=24)
+        assert fresh == again
+    finally:
+        svc.close()
+
+
+def test_explain_rows_positional_and_traced(lm):
+    from fraud_detection_tpu.obs.trace import RowTracer
+
+    tracer = RowTracer(worker="t0", sample=1.0)
+    svc = make_service(lm, rowtrace=tracer)
+    try:
+        cids = ["t0-1:0:5", None, "t0-1:0:7"]
+        out = svc.explain_rows(["scam text A", "scam text B", "scam text C"],
+                               [1, 1, 1], [0.9, 0.8, 0.7], cids=cids,
+                               max_tokens=8)
+        assert len(out) == 3 and all(isinstance(s, str) for s in out)
+        # every traced row got an "explain" span with its slot recorded
+        for cid in ("t0-1:0:5", "t0-1:0:7"):
+            spans = [s for s in tracer.chain(cid) if s.stage == "explain"]
+            assert len(spans) == 1 and spans[0].ok
+            assert "slot=" in spans[0].detail
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# admission accounting
+# ---------------------------------------------------------------------------
+
+def test_queue_overflow_drops_oldest_with_accounting(lm):
+    svc = make_service(lm, slots=1, max_queue=2, max_new_tokens=8)
+    try:
+        reqs = [svc.submit(flatten_chat(frame_prompt(p)), max_tokens=8)
+                for p in prompts_varied(8)]
+        texts = [r.wait(120.0) for r in reqs]
+        dropped = [t for t in texts
+                   if t == DROPPED_MARKER.format(reason="queue_overflow")]
+        assert dropped, "overflow should have dropped the oldest requests"
+        snap = svc.snapshot()
+        assert snap["admitted"] == 8
+        assert snap["admitted"] == snap["completed"] + snap["dropped"]
+        assert snap["dropped"] == len(dropped)
+    finally:
+        svc.close()
+
+
+def test_close_residual_counts_dropped(lm):
+    svc = make_service(lm, slots=1, max_queue=64, max_new_tokens=24)
+    reqs = [svc.submit(flatten_chat(frame_prompt(p)), max_tokens=24)
+            for p in prompts_varied(6)]
+    # Close with a tiny drain budget: residual queue resolves as dropped.
+    svc.close(timeout=0.05)
+    texts = [r.wait(120.0) for r in reqs]
+    assert any(t == DROPPED_MARKER.format(reason="closed") for t in texts)
+    snap = svc.snapshot()
+    assert snap["admitted"] == 6
+    assert snap["admitted"] == snap["completed"] + snap["dropped"]
+    # submissions after close are refused-as-dropped, still accounted
+    late = svc.submit("late", max_tokens=4)
+    assert late.wait(5.0) == DROPPED_MARKER.format(reason="closed")
+    snap = svc.snapshot()
+    assert snap["admitted"] == snap["completed"] + snap["dropped"]
+
+
+def test_truncation_counted(lm):
+    svc = make_service(lm, prompt_width=64, max_new_tokens=4)
+    try:
+        svc.generate_batch(["x" * 500], temperature=0.0, max_tokens=4)
+        assert svc.snapshot()["truncated"] == 1
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# degradation: decoder death, breaker, marker accounting
+# ---------------------------------------------------------------------------
+
+def test_decoder_failure_fails_requests_then_recovers(lm):
+    svc = make_service(lm, slots=2, max_new_tokens=8)
+    try:
+        real_prefill = svc._decoder.prefill
+
+        def boom(*a, **k):
+            raise RuntimeError("device lost")
+
+        svc._decoder.prefill = boom
+        with pytest.raises(BackendError, match="decoder failed"):
+            svc.generate_batch(["will fail"], max_tokens=4)
+        snap = svc.snapshot()
+        assert snap["errors"] >= 1
+        assert snap["admitted"] == snap["completed"] + snap["dropped"]
+        # device comes back: the lane keeps serving
+        svc._decoder.prefill = real_prefill
+        out = svc.generate_batch(["recovers"], temperature=0.0, max_tokens=4)
+        assert len(out) == 1 and isinstance(out[0], str)
+        snap = svc.snapshot()
+        assert snap["admitted"] == snap["completed"] + snap["dropped"]
+    finally:
+        svc.close()
+
+
+def test_breaker_wraps_slotserve_and_hook_emits_markers(lm):
+    clock = type("C", (), {"t": 0.0})()
+    svc = make_service(lm, slots=2, max_new_tokens=8)
+    try:
+        svc._decoder.prefill = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("device lost"))
+        breaker = CircuitBreakerBackend(svc, failure_threshold=1,
+                                        probe_interval=30.0,
+                                        clock=lambda: clock.t)
+        hook = make_slot_explain_hook(breaker, max_tokens=4)
+        # first call: real failure trips the breaker; rows get markers
+        out = hook(["a", "b"], [1, 1], [0.9, 0.9], cids=[None, None])
+        assert out == [UNAVAILABLE_MARKER.format(reason="BackendError")] * 2
+        assert breaker.snapshot()["state"] == "open"
+        # while open: fast-fail, STILL a full marker row set (accounted)
+        out = hook(["c"], [1], [0.5])
+        assert out == [UNAVAILABLE_MARKER.format(reason="BreakerOpenError")]
+        assert breaker.snapshot()["fast_fails"] >= 1
+        with pytest.raises(BreakerOpenError):
+            breaker.explain_rows(["d"], [1], [0.5])
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# annotation-lane drop records (the satellite fix) + chaos coverage
+# ---------------------------------------------------------------------------
+
+def _feed(broker, n, scam_every=3):
+    from tests.fixtures import BENIGN_DIALOGUE, SCAM_DIALOGUE
+
+    prod = broker.producer()
+    for i in range(n):
+        text = SCAM_DIALOGUE if i % scam_every == 0 else BENIGN_DIALOGUE
+        prod.produce("in", json.dumps({"text": text, "id": i}).encode(),
+                     key=str(i).encode())
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    return synthetic_demo_pipeline(batch_size=64, n=400, seed=3,
+                                   num_features=2048,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
+
+
+def test_lane_drop_records_carry_trace_ids(pipeline):
+    """Drop-OLDEST in the annotation lane is not a bare counter: every
+    eviction lands a structured record on the side topic whose ``trace``
+    id joins back to the row's span chain."""
+    from fraud_detection_tpu.obs.trace import RowTracer
+
+    tracer = RowTracer(worker="w0", sample=1.0)
+    broker = InProcessBroker(num_partitions=2)
+    _feed(broker, 48, scam_every=2)
+
+    slow = threading.Event()
+
+    def hook(texts, labels, confs, cids=None):
+        slow.wait(0.25)          # a slow backend so the queue overflows
+        return ["ok"] * len(texts)
+
+    hook.accepts_cids = True
+    engine = StreamingClassifier(
+        pipeline, broker.consumer(["in"], "g"), broker.producer(), "out",
+        batch_size=16, max_wait=0.01,
+        explain_batch_fn=hook, explain_async=True,
+        annotations_producer=broker.producer(), annotations_queue=4,
+        rowtrace=tracer)
+    engine.run(max_messages=48, idle_timeout=1.0)
+    engine.close_annotations(timeout=30.0)
+    stats = engine.annotation_stats()
+    assert stats["dropped"] > 0
+    assert stats["drop_records"] == stats["dropped"]
+    assert stats["submitted"] == stats["annotated"] + stats["dropped"]
+    records = [json.loads(m.value)
+               for m in broker.messages("out-annotations")]
+    drops = [r for r in records if r.get("dropped")]
+    assert len(drops) == stats["drop_records"]
+    for rec in drops:
+        assert rec["reason"] == "queue_overflow"
+        assert rec["analysis"] is None
+        chain = tracer.chain(rec["trace"])
+        stages = {s.stage for s in chain}
+        # the dropped row's chain: flagged at classification, then the
+        # failed-annotate marker the drop emission recorded
+        assert "flag" in stages and "annotate" in stages
+        assert any(s.stage == "annotate" and not s.ok
+                   and "dropped" in (s.detail or "") for s in chain)
+
+
+@pytest.mark.chaos
+def test_chaos_every_flagged_row_explained_or_accounted(lm, pipeline):
+    """Seeded broker chaos on the CLASSIFICATION path + slotserve behind
+    the lane: zero lost/duplicated classifications, and the lane's
+    coverage invariant holds — submitted == annotated + drop_records,
+    slot accounting exact."""
+    from fraud_detection_tpu.obs.trace import RowTracer
+    from fraud_detection_tpu.stream.faults import FaultPlan
+
+    tracer = RowTracer(worker="w0", sample=1.0)
+    svc = make_service(lm, slots=2, max_new_tokens=6, rowtrace=tracer)
+    try:
+        hook = make_slot_explain_hook(svc, max_tokens=6)
+        broker = InProcessBroker(num_partitions=2)
+        _feed(broker, 60, scam_every=3)
+        plan = FaultPlan(seed=11, duplicate_rate=0.1, corrupt_rate=0.05,
+                         flush_fail_rate=0.05, max_faults=12)
+        engine = StreamingClassifier(
+            pipeline, plan.consumer(broker.consumer(["in"], "g")),
+            plan.producer(broker.producer()), "out",
+            batch_size=16, max_wait=0.01,
+            explain_batch_fn=hook, explain_async=True,
+            annotations_producer=broker.producer(), annotations_queue=8,
+            explain_service=svc,
+            dlq_topic="dlq", rowtrace=tracer)
+        engine.run(max_messages=60, idle_timeout=1.0)
+        engine.close_annotations(timeout=60.0)
+        # classification stays exact under chaos (at-least-once)
+        fed = {str(i).encode() for i in range(60)}
+        out_keys = {m.key for m in broker.messages("out")}
+        dlq_keys = {m.key for m in broker.messages("dlq")}
+        assert fed <= (out_keys | dlq_keys)
+        # the lane's coverage invariant
+        stats = engine.annotation_stats()
+        assert stats["submitted"] > 0
+        assert stats["submitted"] == (stats["annotated"] + stats["dropped"])
+        assert stats["drop_records"] == stats["dropped"]
+        snap = svc.snapshot()
+        assert snap["admitted"] == snap["completed"] + snap["dropped"]
+        h = engine.health()
+        assert h["explain"]["slots"] == 2
+        assert h["trace"]["spans_open"] == 0
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# health schema (FC301 contract)
+# ---------------------------------------------------------------------------
+
+SLOTSERVE_BLOCK_SCHEMA = {
+    "slots": (int,),
+    "busy": (int,),
+    "free": (int,),
+    "queue_depth": (int,),
+    "admitted": (int,),
+    "completed": (int,),
+    "dropped": (int,),
+    "errors": (int,),
+    "truncated": (int,),
+    "expl_per_s": (type(None), int, float),
+    "latency_ms": (dict,),
+    "admit_to_first_token_ms": (dict,),
+    "occupancy": (type(None), int, float),
+    "iterations": (int,),
+    "prefills": (int,),
+    "decode_steps": (int,),
+    "tokens_out": (int,),
+    "kv_bytes": (int,),
+}
+
+
+def test_snapshot_schema_contract(lm):
+    svc = make_service(lm, slots=2, max_new_tokens=4)
+    try:
+        svc.generate_batch(["one row"], temperature=0.0, max_tokens=4)
+        snap = svc.snapshot()
+        assert set(snap) == set(SLOTSERVE_BLOCK_SCHEMA), (
+            "snapshot() keys changed — update SLOTSERVE_BLOCK_SCHEMA AND "
+            f"docs/explain_serving.md (extra: "
+            f"{set(snap) - set(SLOTSERVE_BLOCK_SCHEMA)}, missing: "
+            f"{set(SLOTSERVE_BLOCK_SCHEMA) - set(snap)})")
+        for key, types in SLOTSERVE_BLOCK_SCHEMA.items():
+            assert isinstance(snap[key], types), (key, type(snap[key]))
+        for sub in ("latency_ms", "admit_to_first_token_ms"):
+            assert set(snap[sub]) == {"p50", "p99"}
+        assert snap["expl_per_s"] is not None
+        assert snap["latency_ms"]["p50"] is not None
+        assert snap["admit_to_first_token_ms"]["p99"] is not None
+        json.dumps(snap)
+    finally:
+        svc.close()
+
+
+def test_engine_health_explain_block(lm, pipeline):
+    svc = make_service(lm, slots=2, max_new_tokens=4)
+    try:
+        broker = InProcessBroker()
+        _feed(broker, 8, scam_every=4)
+        engine = StreamingClassifier(
+            pipeline, broker.consumer(["in"], "g"), broker.producer(),
+            "out", batch_size=8, max_wait=0.01,
+            explain_batch_fn=make_slot_explain_hook(svc, max_tokens=4),
+            explain_async=True, annotations_producer=broker.producer(),
+            explain_service=svc)
+        engine.run(max_messages=8, idle_timeout=1.0)
+        engine.close_annotations(timeout=30.0)
+        h = engine.health()
+        assert set(h["explain"]) == set(SLOTSERVE_BLOCK_SCHEMA)
+        json.dumps(h)
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# int8 + temperature determinism
+# ---------------------------------------------------------------------------
+
+def test_int8_model_serves_through_slots(lm):
+    """The PR 7 per-block quantizer composes: an int8 LanguageModel rides
+    the same slot programs (Q8 weights through _mm / the int8 head)."""
+    svc = make_service(lm.quantized(), slots=2, max_new_tokens=6)
+    try:
+        out = svc.generate_batch(["int8 row A", "int8 row B"],
+                                 temperature=0.0, max_tokens=6)
+        assert len(out) == 2 and all(isinstance(s, str) for s in out)
+        snap = svc.snapshot()
+        assert snap["completed"] == 2
+    finally:
+        assert svc.close()
+
+
+def test_sampled_decode_deterministic_per_seed(lm):
+    a = make_service(lm, slots=2, max_new_tokens=8, seed=5)
+    try:
+        out_a = a.generate_batch(["sample me"], temperature=0.8,
+                                 max_tokens=8)
+    finally:
+        a.close()
+    b = make_service(lm, slots=2, max_new_tokens=8, seed=5)
+    try:
+        out_b = b.generate_batch(["sample me"], temperature=0.8,
+                                 max_tokens=8)
+    finally:
+        b.close()
+    assert out_a == out_b
+
+
+# ---------------------------------------------------------------------------
+# serve CLI e2e + game day
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_explain_slots_e2e(capsys):
+    from fraud_detection_tpu.app.serve import main as serve_main
+
+    rc = serve_main(["--model", "synthetic", "--demo", "120",
+                     "--batch-size", "64", "--max-wait", "0.01",
+                     "--explain", "onpod-demo", "--explain-slots", "2",
+                     "--explain-tokens", "8", "--trace"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = json.loads([l for l in out.splitlines()
+                        if l.startswith("{")][0])
+    snap = stats["explain"]
+    assert snap["slots"] == 2
+    assert snap["admitted"] == snap["completed"] + snap["dropped"]
+    assert snap["completed"] > 0
+    lane = stats["annotations"]
+    assert lane["submitted"] == lane["annotated"] + lane["dropped"]
+    assert stats["health"]["explain"]["slots"] == 2
+
+
+def test_serve_cli_explain_slots_validation():
+    from fraud_detection_tpu.app.serve import main as serve_main
+
+    with pytest.raises(SystemExit, match="onpod-family"):
+        serve_main(["--model", "synthetic", "--demo", "10",
+                    "--explain", "canned", "--explain-slots", "2"])
+    with pytest.raises(SystemExit, match="explain-slots must be"):
+        serve_main(["--model", "synthetic", "--demo", "10",
+                    "--explain", "onpod-demo", "--explain-slots", "-1"])
+
+
+@pytest.mark.scenario
+def test_campaign_explain_gameday_passes():
+    from fraud_detection_tpu.scenarios.gameday import (get_scenario,
+                                                       run_gameday)
+
+    result = run_gameday(get_scenario("campaign_explain", seed=5,
+                                      scale=0.25))
+    assert result.ok, result.report.table()
+    gates = {v.name: v for v in result.report.verdicts}
+    assert gates["explain_coverage"].observed == 1.0
+    assert gates["slot_accounting_exact"].ok
+    ev = result.evidence
+    assert ev["annotations"]["submitted"] == (
+        ev["annotations"]["annotated"] + ev["annotations"]["dropped"])
+    assert ev["annotations"]["drop_records"] == ev["annotations"]["dropped"]
+
+
+def test_gameday_validation_rejects_bad_configs():
+    from fraud_detection_tpu.scenarios.gameday import GameDay
+    from fraud_detection_tpu.scenarios.traffic import SteadyLoad
+
+    traffic = (SteadyLoad(name="s", rate=10, duration_s=1.0),)
+    with pytest.raises(ValueError, match="single-engine"):
+        GameDay(name="x", description="", traffic=traffic, slos=(),
+                workers=2, explain_slots=4)
+    with pytest.raises(ValueError, match="not both"):
+        GameDay(name="x", description="", traffic=traffic, slos=(),
+                breaker_threshold=2, explain_slots=4)
+    with pytest.raises(ValueError, match="explain_slots must be"):
+        GameDay(name="x", description="", traffic=traffic, slos=(),
+                explain_slots=0)
