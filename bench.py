@@ -168,6 +168,18 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
         "scenarios": ({name: s.get("ok") for name, s in
                        ((line.get("scenarios") or {}).get("scenarios")
                         or {}).items()} or None),
+        # Sentinel evidence (ISSUE 14, docs/observability.md): per-fault
+        # detection latency in virtual seconds + the paired evaluation-
+        # overhead ratio, so a detection regression or a hot sentinel
+        # diffs in the trend file.
+        "alerts": (lambda al: ({
+            "detection_pass": al.get("detection_pass"),
+            "detection_latency_s": {
+                f"{scenario}:{rule}": d.get("latency_s")
+                for scenario, block in (al.get("detection") or {}).items()
+                for rule, d in (block.get("detects") or {}).items()},
+            "overhead_ratio": (al.get("overhead") or {}).get("ratio"),
+        } if al else None))(line.get("alerts") or {}),
         # Fleet scaling trend (ISSUE 8): worker count, per-worker vs
         # aggregate rate, and the globally-coordinated shed count.
         "fleet": ({
@@ -603,7 +615,8 @@ def _warm(pipe, texts, batch_size: int) -> None:
 
 
 def _stream_run(pipe, texts, batch_size: int, depth: int, n_msgs: int,
-                tracer=None, async_dispatch=None, rowtrace=None):
+                tracer=None, async_dispatch=None, rowtrace=None,
+                sentinel_setup=None):
     """One timed streaming run: fresh broker, n_msgs produced, engine drains.
     The ONE definition of the measured loop — the headline and tree-family
     sections must not drift apart. ``tracer`` (utils.tracing.Tracer) records
@@ -631,7 +644,15 @@ def _stream_run(pipe, texts, batch_size: int, depth: int, n_msgs: int,
         pipe, consumer, broker.producer(), "dialogues-classified",
         batch_size=batch_size, max_wait=0.01, pipeline_depth=depth,
         tracer=tracer, async_dispatch=async_dispatch, rowtrace=rowtrace)
-    stats = engine.run(max_messages=n_msgs, idle_timeout=1.0)
+    # ``sentinel_setup(engine)`` -> finish(): the alerts section arms a
+    # live sentinel over this engine's health for the paired
+    # evaluation-overhead measurement (obs/sentinel/).
+    finish_sentinel = (sentinel_setup(engine)
+                       if sentinel_setup is not None else lambda: None)
+    try:
+        stats = engine.run(max_messages=n_msgs, idle_timeout=1.0)
+    finally:
+        finish_sentinel()
     assert stats.processed == n_msgs, stats.as_dict()
     stats.device_health = engine.health()["device"]
     return stats
@@ -828,6 +849,106 @@ def trace_overhead_bench(pipe, texts, batch_size: int, depth: int,
                   ("spans_begun", "spans_ended", "kept", "sampled_out",
                    "ring_dropped")},
         "stages": best_tracer.stage_quantiles(),
+    }
+
+
+def alerts_bench(pipe, texts, batch_size: int, depth: int,
+                 n_msgs: int) -> dict:
+    """Sentinel evidence (obs/sentinel/, docs/observability.md): two legs.
+
+    **Detection latency** — every catalog game day that declares expected
+    detections runs warp-paced and commits, per seeded fault class, the
+    virtual seconds from fault injection to the matching alert FIRING
+    (the ``detects_*`` verdict's observed latency). A detection
+    regression — a rule that stops firing, or fires later — diffs in the
+    artifact and the trend file instead of only failing a soak.
+
+    **Evaluation overhead** — streaming runs with a live sentinel (full
+    default pack, tight 50ms cadence — far hotter than the serve CLI's
+    1s default) against runs without, as back-to-back PAIRS with
+    alternating arm order; the committed ``ratio`` is the MEDIAN of
+    per-pair ratios (the PR 10 trace-overhead precedent: paired arms
+    share the host's contention regime). CI bench-smoke gates >= 0.95.
+    """
+    from statistics import median
+
+    from fraud_detection_tpu.obs.sentinel import (ChainedHealthSource,
+                                                  Sentinel,
+                                                  default_rule_pack,
+                                                  start_sentinel)
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    seed = int(os.environ.get("BENCH_ALERT_SEED", "11"))
+    scale = float(os.environ.get("BENCH_ALERT_SCALE", "0.4"))
+    names = [n for n in os.environ.get(
+        "BENCH_ALERT_SCENARIOS",
+        "flash_crowd,campaign_breaker,chaos_storm,"
+        "campaign_kill_swap").split(",") if n]
+    detection = {}
+    for name in names:
+        gd = get_scenario(name, seed, scale=scale)
+        if gd.sentinel is None or not gd.sentinel.expect:
+            continue
+        t0 = time.perf_counter()
+        result = run_gameday(gd, pipeline=pipe)
+        detects = {}
+        for v in result.report.verdicts:
+            if v.name.startswith("detects_"):
+                detects[v.name[len("detects_"):]] = {
+                    "ok": bool(v.ok),
+                    "latency_s": (round(v.observed, 3)
+                                  if isinstance(v.observed, (int, float))
+                                  else None)}
+        detection[name] = {"ok": result.ok,
+                           "wall_s": round(time.perf_counter() - t0, 2),
+                           "detects": detects}
+
+    interval = float(os.environ.get("BENCH_ALERT_INTERVAL", "0.05"))
+    rows = min(max(n_msgs, 40_000), 80_000)
+    sentinels = []
+
+    def setup(engine):
+        source = ChainedHealthSource()
+        source.attach(engine)
+        s = Sentinel(source, default_rule_pack(), worker=f"b{len(sentinels)}")
+        sentinels.append(s)
+        return start_sentinel([s], interval)
+
+    ratios = []
+    best_on = best_off = 0.0
+    for rep in range(5):
+        if rep % 2 == 0:
+            off = _stream_run(pipe, texts, batch_size, depth, rows)
+            on = _stream_run(pipe, texts, batch_size, depth, rows,
+                             sentinel_setup=setup)
+        else:
+            on = _stream_run(pipe, texts, batch_size, depth, rows,
+                             sentinel_setup=setup)
+            off = _stream_run(pipe, texts, batch_size, depth, rows)
+        if off.msgs_per_sec > 0:
+            ratios.append(on.msgs_per_sec / off.msgs_per_sec)
+        best_off = max(best_off, off.msgs_per_sec)
+        best_on = max(best_on, on.msgs_per_sec)
+    evaluations = sum(s.evaluations for s in sentinels)
+    false_positives = sum(s.fired for s in sentinels)
+    return {
+        "detection": detection,
+        "detection_pass": (all(d["ok"] for d in detection.values())
+                           if detection else None),
+        "overhead": {
+            "rows": rows,
+            "interval_s": interval,
+            "unwatched_msgs_per_s": round(best_off, 1),
+            "watched_msgs_per_s": round(best_on, 1),
+            # Median paired ratio; >= 0.95 is the acceptance bar (CI
+            # bench-smoke asserts it when the leg lands).
+            "ratio": round(median(ratios), 4) if ratios else None,
+            "pair_ratios": [round(r, 4) for r in ratios],
+            "evaluations": evaluations,
+            # The clean bench stream must not alert: the overhead legs
+            # double as a false-positive check on the default pack.
+            "false_positives": false_positives,
+        },
     }
 
 
@@ -1981,6 +2102,17 @@ def main() -> int:
             "scenarios",
             lambda scratch: scenario_bench(pipe_or_raise()),
             fraction=0.35)
+
+    if os.environ.get("BENCH_ALERTS", "1") != "0":
+        # Sentinel evidence (ISSUE 14, docs/observability.md): detection
+        # latency per seeded fault class (virtual seconds from injection
+        # to firing) + the paired sentinel-evaluation overhead ratio
+        # (median of pairs, gated >= 0.95 by CI bench-smoke).
+        harness.section(
+            "alerts",
+            lambda scratch: alerts_bench(pipe_or_raise(), texts,
+                                         batch_size, depth, n_msgs),
+            fraction=0.3)
 
     # Offered-load sweep (bench.py --load-sweep, default-on so the committed
     # artifact carries the latency-vs-throughput trajectory, not just one

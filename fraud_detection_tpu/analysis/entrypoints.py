@@ -78,6 +78,11 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     # Slotserve explain lane (docs/explain_serving.md): ONE worker owning
     # the slot pool's decoder — admissions, decode windows, retirement.
     ("explain/slotserve/service.py", "self._run"),
+    # Sentinel alerting (obs/sentinel/, docs/observability.md): the ONE
+    # evaluation thread driving every registered sentinel at the serve
+    # CLI's --alert-interval cadence (fleet/worker sentinels evaluate on
+    # the monitor/poll threads instead — no extra thread there).
+    ("obs/sentinel/engine.py", "loop"),
 })
 
 
@@ -171,6 +176,12 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
                "slot-state arrays and the SlotDecoder are worker-only by "
                "the class's role map, waiters block on per-request "
                "events"),
+    EntryPoint("sentinel", "obs/sentinel/engine.py", "loop", None,
+               "single evaluator by construction (start_sentinel spawns "
+               "one thread per call and serve calls it once); all rule/"
+               "incident state under Sentinel._lock, the source pull is "
+               "a read-only health() sample, and recorder file I/O runs "
+               "outside the sentinel lock under the recorder's own lock"),
 )
 
 
@@ -289,6 +300,24 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
                     "explain_rows", "snapshot", "drain", "close",
                     "set_rowtrace"),
         slotserve_lane=("_run",)),
+    # Sentinel (obs/sentinel/, docs/observability.md): evaluate/prime run
+    # on whichever single thread drives this sentinel (the serve
+    # "sentinel" thread, the fleet monitor, a fleet worker's poll path,
+    # the scenario driver); snapshot/firing/healthz are the cross-thread
+    # surface. Everything mutable sits under Sentinel._lock.
+    "obs/sentinel/engine.py::Sentinel": _spec(
+        any_thread=("snapshot", "firing", "critical_firing", "healthz"),
+        sentinel=("evaluate", "prime")),
+    # Chain-cumulative health source: attach() on the supervisor path,
+    # __call__ on the sentinel driver; accumulator under its own lock,
+    # health reads are the usual lock-free racy samples.
+    "obs/sentinel/engine.py::ChainedHealthSource": _spec(
+        any_thread=("attach", "__call__")),
+    # Incident recorder: transitions can arrive from any sentinel's
+    # driving thread; the append log is serialized under _lock and
+    # bundle publication rides the shared atomic writer.
+    "obs/sentinel/bundle.py::IncidentRecorder": _spec(
+        any_thread=("record_fired", "record_resolved", "snapshot")),
 }
 
 
@@ -336,6 +365,12 @@ OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
     "fleet/coordinator.py::FleetCoordinator.bus": ("FleetBus",),
     # Slotserve lane: the service drives its decoder from the lane thread.
     "explain/slotserve/service.py::SlotServeService._decoder": ("SlotDecoder",),
+    # Sentinel seams (obs/sentinel/): the engine/fleet surfaces hold a
+    # sentinel whose snapshot they read; the sentinel drives its recorder.
+    "stream/engine.py::StreamingClassifier._sentinel": ("Sentinel",),
+    "fleet/worker.py::FleetWorker.sentinel": ("Sentinel",),
+    "fleet/fleet.py::Fleet.sentinel": ("Sentinel",),
+    "obs/sentinel/engine.py::Sentinel.recorder": ("IncidentRecorder",),
 }
 
 #: Protocol/ABC name -> concrete in-tree implementations the call-graph

@@ -72,6 +72,10 @@ CONTRACTS: Tuple[Contract, ...] = (
     # sub-object — slots busy/free, admission accounting, expl/s, p50/p99.
     Contract("explain/slotserve/service.py", "SlotServeService.snapshot",
              "test_slotserve.py", "SLOTSERVE_BLOCK_SCHEMA"),
+    # Sentinel alerting (docs/observability.md): the engine's "alerts"
+    # sub-object — rule states, firing lists, incident accounting.
+    Contract("obs/sentinel/engine.py", "Sentinel.snapshot",
+             "test_sentinel.py", "ALERTS_BLOCK_SCHEMA"),
 )
 
 

@@ -331,10 +331,32 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics-interval", type=float, default=2.0,
                     help="seconds between --metrics-file dumps")
     ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
-                    help="serve /metrics (Prometheus text) and "
-                         "/metrics.json on this local port (stdlib HTTP, "
+                    help="serve /metrics (Prometheus text), /metrics.json, "
+                         "and /healthz (readiness: 200, or 503 with the "
+                         "firing rule names while a critical alert fires — "
+                         "--alerts) on this local port (stdlib HTTP, "
                          "daemon thread; 0 picks a free port, printed at "
                          "startup)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="run the sentinel alerting engine (obs/sentinel/, "
+                         "docs/observability.md): the default rule pack "
+                         "(shed/DLQ burn rates, breaker opens, p99 SLO "
+                         "burn, dispatch stall, span leaks, fence events, "
+                         "restart churn) evaluates against live engine "
+                         "health; firing state rides health()['alerts'], "
+                         "the exit stats JSON, /metrics, and /healthz")
+    ap.add_argument("--alert-rules", default=None, metavar="FILE",
+                    help="JSON alert-rule file replacing the default pack "
+                         "(rule grammar: docs/observability.md); implies "
+                         "--alerts")
+    ap.add_argument("--alert-interval", type=float, default=1.0,
+                    help="seconds between sentinel evaluations (--alerts)")
+    ap.add_argument("--incident-dir", default=None, metavar="DIR",
+                    help="flight-recorder output (implies --alerts): every "
+                         "alert transition appends to DIR/incidents.jsonl "
+                         "and a firing incident captures a bundle dir "
+                         "(evidence window, metric deltas, health, "
+                         "implicated trace chains)")
     ap.add_argument("--trace", action="store_true",
                     help="row/batch tracing (obs/trace.py): correlation "
                          "ids minted at poll ride every row to its "
@@ -545,6 +567,23 @@ def main(argv=None) -> int:
     if not 0.0 <= args.trace_sample <= 1.0:
         raise SystemExit(
             f"--trace-sample must be in [0, 1], got {args.trace_sample}")
+    if args.alert_rules is not None or args.incident_dir is not None:
+        args.alerts = True
+    if args.alert_interval <= 0:
+        raise SystemExit(
+            f"--alert-interval must be > 0, got {args.alert_interval}")
+    alert_rules = None
+    if args.alerts:
+        from fraud_detection_tpu.obs.sentinel import (default_rule_pack,
+                                                      load_rules)
+
+        if args.alert_rules is not None:
+            try:
+                alert_rules = load_rules(args.alert_rules)
+            except (OSError, ValueError) as e:
+                raise SystemExit(f"bad --alert-rules: {e}")
+        else:
+            alert_rules = default_rule_pack()
     if args.trace_record is not None:
         # Record mode: full sampling + the per-batch row census, one ring
         # (docs/scenarios.md "Recording a run").
@@ -860,9 +899,10 @@ def main(argv=None) -> int:
 
         metrics_registry = MetricsRegistry()
 
-    def start_metrics():
+    def start_metrics(healthz_fn=None):
         """Start the --metrics-file writer + --metrics-port endpoint once
-        the collectors are registered; returns finish()."""
+        the collectors are registered; returns finish(). ``healthz_fn``
+        wires the sentinel's readiness verdict into /healthz."""
         nonlocal metrics_server
         if metrics_registry is None:
             return lambda: None
@@ -871,7 +911,8 @@ def main(argv=None) -> int:
 
         if args.metrics_port is not None:
             metrics_server = MetricsServer(metrics_registry,
-                                           args.metrics_port)
+                                           args.metrics_port,
+                                           healthz_fn=healthz_fn)
             print(f"metrics: http://127.0.0.1:{metrics_server.port}/metrics",
                   flush=True)
         finish_file = start_metrics_writer(args.metrics_file,
@@ -908,6 +949,41 @@ def main(argv=None) -> int:
                 record_rows=record)
         return tr
 
+    # Sentinel alerting (obs/sentinel/, docs/observability.md): one
+    # sentinel per worker over a CHAIN-CUMULATIVE health source (counters
+    # survive supervised restarts; supervisor.restarts feeds the
+    # restart-churn rule), sharing one incident dir — all driven by the
+    # single "sentinel" thread. The fleet path wires its own coordinator-
+    # level sentinel through Fleet.in_process instead.
+    sentinel_per_worker: dict = {}
+    sentinel_sources: dict = {}
+
+    def sentinel_for(worker: int):
+        if alert_rules is None or args.fleet > 0:
+            return None
+        from fraud_detection_tpu.obs.sentinel import (ChainedHealthSource,
+                                                      IncidentRecorder,
+                                                      Sentinel)
+
+        s = sentinel_per_worker.get(worker)
+        if s is None:
+            source = sentinel_sources[worker] = ChainedHealthSource()
+            recorder = (IncidentRecorder(args.incident_dir,
+                                         rowtrace=rowtrace_for(worker))
+                        if args.incident_dir is not None else None)
+            s = sentinel_per_worker[worker] = Sentinel(
+                source, alert_rules, recorder=recorder,
+                worker=f"w{worker}")
+        return s
+
+    def sentinels_healthz():
+        """Aggregate readiness across every worker's sentinel: not ready
+        while ANY critical alert fires anywhere."""
+        firing = []
+        for s in sentinel_per_worker.values():
+            firing.extend(s.critical_firing())
+        return (not firing, sorted(set(firing)))
+
     if explain_service is not None and args.trace and args.workers == 1:
         # Completed explanations land per-row "explain" spans (slot id +
         # admit wait) on the single worker's chains. Multi-worker runs keep
@@ -922,6 +998,19 @@ def main(argv=None) -> int:
         # lag clears, then exits with the merged fleet stats.
         from fraud_detection_tpu.fleet import Fleet
 
+        fleet_sentinel_kw = {}
+        if args.alerts:
+            # Coordinator-level fleet rules + per-worker engine sentinels
+            # riding the bus (docs/observability.md "Fleet alerting").
+            from fraud_detection_tpu.obs.sentinel import (IncidentRecorder,
+                                                          fleet_rule_pack)
+
+            fleet_sentinel_kw = dict(
+                sentinel_rules=(alert_rules if args.alert_rules is not None
+                                else fleet_rule_pack()),
+                sentinel_recorder=(
+                    IncidentRecorder(args.incident_dir)
+                    if args.incident_dir is not None else None))
         fleet = Fleet.in_process(
             broker, pipe, args.input_topic, args.output_topic, args.fleet,
             batch_size=args.batch_size, max_wait=args.max_wait,
@@ -929,10 +1018,13 @@ def main(argv=None) -> int:
             async_dispatch=args.async_dispatch,
             sched_config=sched_config, dlq_topic=dlq_topic,
             health_file=args.fleet_health_file,
-            trace=args.trace, trace_sample=args.trace_sample)
+            trace=args.trace, trace_sample=args.trace_sample,
+            **fleet_sentinel_kw)
         if metrics_registry is not None:
             metrics_registry.add_collector("fleet", fleet.fleet_health)
-        finish_metrics = start_metrics()
+        finish_metrics = start_metrics(
+            healthz_fn=(fleet.sentinel.healthz
+                        if fleet.sentinel is not None else None))
         print(f"serving: model={model_desc} in={args.input_topic} "
               f"out={args.output_topic} batch={args.batch_size} "
               f"fleet={args.fleet} partitions={args.partitions}", flush=True)
@@ -1012,9 +1104,35 @@ def main(argv=None) -> int:
                                 shadow=shadow,
                                 scheduler=scheduler,
                                 async_dispatch=args.async_dispatch,
-                                rowtrace=rowtrace_for(worker))
+                                rowtrace=rowtrace_for(worker),
+                                sentinel=sentinel_for(worker))
         engines_built.append(e)
+        source = sentinel_sources.get(worker)
+        if source is not None:
+            # Fold the replaced incarnation's counters into the chain-
+            # cumulative alerting source (obs/sentinel/engine.py).
+            source.attach(e)
         return e
+
+    def start_alerting():
+        """Build every worker's sentinel and start the ONE "sentinel"
+        evaluation thread; returns finish() (no-op without --alerts)."""
+        if alert_rules is None:
+            return lambda: None
+        from fraud_detection_tpu.obs.sentinel import start_sentinel
+
+        return start_sentinel([sentinel_for(i)
+                               for i in range(args.workers)],
+                              args.alert_interval)
+
+    def alerts_out():
+        """The exit-stats 'alerts' block: one snapshot (single worker) or
+        a per-worker list."""
+        if alert_rules is None or not sentinel_per_worker:
+            return None
+        snaps = [sentinel_per_worker[w].snapshot()
+                 for w in sorted(sentinel_per_worker)]
+        return snaps[0] if args.workers == 1 else snaps
 
     def finish_annotations():
         """Drain every LIVE engine's async lane; aggregated counters for
@@ -1088,7 +1206,9 @@ def main(argv=None) -> int:
             # with an index label per worker at render time.
             metrics_registry.add_collector(
                 "engine", lambda: [e.health() for e in live if e is not None])
-        finish_metrics = start_metrics()
+        finish_metrics = start_metrics(
+            healthz_fn=sentinels_healthz if args.alerts else None)
+        finish_sentinel = start_alerting()
         from fraud_detection_tpu.obs.export import start_profile_window
 
         finish_profile = start_profile_window(
@@ -1198,6 +1318,10 @@ def main(argv=None) -> int:
         profile = finish_profile()
         if profile is not None:
             merged["profile"] = profile
+        finish_sentinel()
+        alerts = alerts_out()
+        if alerts is not None:
+            merged["alerts"] = alerts
         finish_metrics()
         finish_health()
         print(json.dumps(merged))
@@ -1217,7 +1341,9 @@ def main(argv=None) -> int:
         metrics_registry.add_collector(
             "engine", lambda: (engines_built[-1].health()
                                if engines_built else None))
-    finish_metrics = start_metrics()
+    finish_metrics = start_metrics(
+        healthz_fn=sentinels_healthz if args.alerts else None)
+    finish_sentinel = start_alerting()
     from fraud_detection_tpu.obs.export import start_profile_window
 
     finish_profile = start_profile_window(
@@ -1270,6 +1396,10 @@ def main(argv=None) -> int:
     profile = finish_profile()
     if profile is not None:
         out["profile"] = profile
+    finish_sentinel()
+    alerts = alerts_out()
+    if alerts is not None:
+        out["alerts"] = alerts
     finish_metrics()
     finish_health()
     if args.trace_record is not None and trace_per_worker:

@@ -274,6 +274,9 @@ class FleetCoordinator:
         shed_total = 0
         processed_total = 0
         stage_wires: List[dict] = []
+        alerts_firing = 0
+        alerts_critical = 0
+        worker_alerts: Dict[str, list] = {}
         for wid, entry in snaps.items():
             if wid not in members:
                 continue    # departed/expired worker's stale publish
@@ -287,6 +290,16 @@ class FleetCoordinator:
             obs = doc.get("obs") or {}
             if isinstance(obs.get("stages"), dict):
                 stage_wires.append(obs["stages"])
+            # Per-worker sentinel states riding the bus (obs/sentinel/):
+            # aggregate into the fleet view the coordinator-level
+            # worker_alerts rule judges.
+            alerts = doc.get("alerts")
+            if isinstance(alerts, dict):
+                firing = alerts.get("firing") or []
+                alerts_firing += len(firing)
+                alerts_critical += len(alerts.get("critical_firing") or [])
+                if firing:
+                    worker_alerts[wid] = list(firing)
         global_backlog = sum(backlogs.values()) if backlogs else None
         if global_backlog is not None:
             self._peak_backlog = max(self._peak_backlog, global_backlog)
@@ -294,6 +307,15 @@ class FleetCoordinator:
             "time": self._wall(),
             "generation": generation,
             "workers": sorted(members),
+            # Membership COUNT as a first-class metric: the fleet
+            # sentinel's worker_absence rule is a window delta over it
+            # (a drop means a death or lease expiry — capacity gone).
+            "n_workers": len(members),
+            # Fleet-wide count of firing worker-level alerts (+ the
+            # critical subset) and which worker is firing what.
+            "alerts_firing": alerts_firing,
+            "alerts_critical": alerts_critical,
+            "worker_alerts": worker_alerts,
             "assignments": assignments,
             "pending_release": pending,
             "rebalances": rebalances,

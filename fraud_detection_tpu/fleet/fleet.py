@@ -47,6 +47,10 @@ class Fleet:
                  trace: bool = False,
                  trace_sample: float = 1.0,
                  trace_seed: Optional[int] = None,
+                 sentinel_rules=None,
+                 worker_sentinel_rules=None,
+                 sentinel_clock=None,
+                 sentinel_recorder=None,
                  worker_prefix: str = "w"):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
@@ -57,6 +61,43 @@ class Fleet:
         self.coordinator = FleetCoordinator(
             topics, num_partitions, bus=self.bus, lease_ttl=lease_ttl,
             lag_fn=lag_fn)
+        # Fleet alerting (obs/sentinel/, docs/observability.md):
+        # ``sentinel_rules`` arms a COORDINATOR-level sentinel over the
+        # aggregated fleet view (global watermark burn, worker absence,
+        # worker-alert roll-up), evaluated once per monitor tick right
+        # after the coordinator aggregates; per-worker sentinels (the
+        # default engine pack unless ``worker_sentinel_rules`` overrides)
+        # watch each worker's own engine health on the poll path and ride
+        # the bus, which is what the roll-up aggregates. ``sentinel_clock``
+        # injects the stamp domain (the scenario harness passes virtual
+        # time); None = process monotonic.
+        self.sentinel = None
+        self.worker_sentinels: dict = {}
+        if sentinel_rules is not None:
+            from fraud_detection_tpu.obs.sentinel import (Sentinel,
+                                                          default_rule_pack)
+
+            kw = {} if sentinel_clock is None else {"clock": sentinel_clock}
+            self.sentinel = Sentinel(
+                lambda: {"fleet": self.coordinator.last_view() or {}},
+                sentinel_rules, worker="fleet",
+                recorder=sentinel_recorder, **kw)
+            worker_rules = (worker_sentinel_rules
+                            if worker_sentinel_rules is not None
+                            else default_rule_pack(
+                                fast_s=2.0, slow_s=8.0, resolve_s=1.0,
+                                p99_ms=60000.0, stall_s=30.0))
+            if worker_rules:
+                holder = self.worker_sentinels
+                for i in range(n_workers):
+                    wid = f"{worker_prefix}{i}"
+
+                    def source(w=wid):
+                        worker = self._worker_by_id.get(w)
+                        return worker.health() if worker is not None else None
+
+                    holder[wid] = Sentinel(source, worker_rules,
+                                           worker=wid, **kw)
         self.death_plan = death_plan
         self.tick_interval = tick_interval
         self.health_file = health_file
@@ -75,8 +116,11 @@ class Fleet:
                         self._bind_consumer_factory(make_consumer),
                         death_plan=death_plan,
                         heartbeat_interval=heartbeat_interval,
-                        rowtrace=self.tracers.get(f"{worker_prefix}{i}"))
+                        rowtrace=self.tracers.get(f"{worker_prefix}{i}"),
+                        sentinel=self.worker_sentinels.get(
+                            f"{worker_prefix}{i}"))
             for i in range(n_workers)]
+        self._worker_by_id = {w.worker_id: w for w in self.workers}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -107,7 +151,11 @@ class Fleet:
                    health_file: Optional[str] = None,
                    trace: bool = False,
                    trace_sample: float = 1.0,
-                   trace_seed: Optional[int] = None) -> "Fleet":
+                   trace_seed: Optional[int] = None,
+                   sentinel_rules=None,
+                   worker_sentinel_rules=None,
+                   sentinel_clock=None,
+                   sentinel_recorder=None) -> "Fleet":
         """A fleet over an InProcessBroker: assigned consumers with the
         coordinator's commit fence, group-lag drain signal, one shared
         scoring pipeline, and (with ``sched_config``) a per-worker adaptive
@@ -165,7 +213,11 @@ class Fleet:
                 # One tracer per worker, shared across incarnations —
                 # chains and stage sketches survive rebalances exactly
                 # like the scheduler's SLO window does.
-                rowtrace=fleet_holder["fleet"].tracers.get(worker_id))
+                rowtrace=fleet_holder["fleet"].tracers.get(worker_id),
+                # One sentinel per worker, same sharing contract: alert
+                # state and incident accounting survive rebalances.
+                sentinel=fleet_holder["fleet"].worker_sentinels.get(
+                    worker_id))
 
         fleet = cls(
             n_workers, make_engine, make_consumer,
@@ -174,7 +226,11 @@ class Fleet:
             lag_fn=lambda: broker.group_lag(group_id, [input_topic]),
             death_plan=death_plan, heartbeat_interval=heartbeat_interval,
             tick_interval=tick_interval, health_file=health_file,
-            trace=trace, trace_sample=trace_sample, trace_seed=trace_seed)
+            trace=trace, trace_sample=trace_sample, trace_seed=trace_seed,
+            sentinel_rules=sentinel_rules,
+            worker_sentinel_rules=worker_sentinel_rules,
+            sentinel_clock=sentinel_clock,
+            sentinel_recorder=sentinel_recorder)
         fleet_holder["fleet"] = fleet
         return fleet
 
@@ -195,6 +251,8 @@ class Fleet:
         return {
             "time": time.time(),
             "fleet": self.coordinator.last_view(),
+            "alerts": (self.sentinel.snapshot()
+                       if self.sentinel is not None else None),
             "workers": {w.worker_id: {**w.result(), "health": w.health()}
                         for w in self.workers},
         }
@@ -213,6 +271,10 @@ class Fleet:
                 self.coordinator.tick()
             except Exception:  # noqa: BLE001 — the tick must keep ticking
                 log.exception("fleet coordinator tick failed")
+            if self.sentinel is not None:
+                # Coordinator-level rules judged on the view the tick just
+                # aggregated (evaluate() guards its own failures).
+                self.sentinel.evaluate()
             self._write_health_file()
 
     def _worker_main(self, worker: FleetWorker,
@@ -286,6 +348,21 @@ class Fleet:
         }
         if self.death_plan is not None:
             out["death_plan"] = self.death_plan.report()
+        if self.sentinel is not None:
+            # Final pass AFTER the post-run tick above, so membership
+            # drops and last-tick watermarks are judged before the
+            # snapshot lands in the merged stats. (Worker sentinels got
+            # their last pass on their final poll; their engines are gone
+            # now, so another pass would only count a source error.)
+            self.sentinel.evaluate()
+            out["alerts"] = self.sentinel.snapshot()
+            out["worker_alerts"] = {
+                wid: {k: snap[k] for k in ("firing", "critical_firing",
+                                           "fired", "resolved",
+                                           "still_firing")}
+                for wid, snap in ((wid, s.snapshot())
+                                  for wid, s in
+                                  self.worker_sentinels.items())}
         if self.tracers:
             # Final fleet-level stage attribution straight from the
             # tracers (the post-drain coordinator tick sees no members —

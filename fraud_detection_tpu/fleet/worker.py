@@ -70,7 +70,7 @@ class FleetWorker:
     def __init__(self, worker_id: str, coordinator, bus,
                  make_engine: Callable, make_consumer: Callable, *,
                  death_plan=None, heartbeat_interval: float = 0.2,
-                 rowtrace=None, clock=time.monotonic):
+                 rowtrace=None, sentinel=None, clock=time.monotonic):
         if heartbeat_interval <= 0:
             raise ValueError(
                 f"heartbeat_interval must be > 0, got {heartbeat_interval}")
@@ -89,6 +89,12 @@ class FleetWorker:
         # wires, which the coordinator merges losslessly into fleet-level
         # p50/p99 per stage (docs/observability.md).
         self.rowtrace = rowtrace
+        # Optional obs.sentinel.Sentinel watching THIS worker's engine
+        # health: evaluated on the poll path at heartbeat cadence (the
+        # same rate-limit gate as the coordinator sync), its alert state
+        # rides every bus doc so the coordinator's tick aggregates
+        # fleet-wide firing counts (docs/observability.md).
+        self.sentinel = sentinel
         self._clock = clock
         self.stats = StreamStats()
         self.incarnations = 0
@@ -146,6 +152,10 @@ class FleetWorker:
         if now - self._last_sync < self.heartbeat_interval:
             return
         self._last_sync = now
+        if self.sentinel is not None:
+            # Heartbeat-cadence evaluation on the driver thread, BEFORE
+            # the publish below, so the bus doc carries this pass's state.
+            self.sentinel.evaluate()
         lease = self.coordinator.sync(self.worker_id)
         self._publish(consumer)
         cur = self._lease
@@ -188,7 +198,19 @@ class FleetWorker:
             # fleet-level stage-latency merge (None when not tracing).
             "obs": ({"stages": self.rowtrace.stages_wire()}
                     if self.rowtrace is not None else None),
+            # This worker's alert state (obs/sentinel/): the compact
+            # subset the coordinator aggregates — full incident history
+            # stays in the worker's own health()["alerts"] block.
+            "alerts": (self._alerts_doc()
+                       if self.sentinel is not None else None),
         })
+
+    def _alerts_doc(self) -> dict:
+        snap = self.sentinel.snapshot()
+        return {"firing": snap["firing"],
+                "critical_firing": snap["critical_firing"],
+                "fired": snap["fired"],
+                "resolved": snap["resolved"]}
 
     def run(self, idle_timeout: Optional[float] = None) -> StreamStats:
         """Drive engine incarnations until stopped, killed, or — when

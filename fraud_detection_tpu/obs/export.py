@@ -78,31 +78,56 @@ def start_metrics_writer(path: Optional[str], interval: float,
 
 
 class MetricsServer:
-    """Stdlib HTTP scrape endpoint for one registry (see module doc)."""
+    """Stdlib HTTP scrape endpoint for one registry (see module doc).
+
+    ``healthz_fn`` (optional) wires the ``/healthz`` readiness endpoint:
+    a zero-arg callable returning ``(ok, firing_names)`` — the sentinel's
+    ``healthz()`` (obs/sentinel/engine.py). 200 with ``{"ok": true}``
+    while no critical alert is firing, 503 with the firing rule names as
+    JSON otherwise; scrapes self-count exactly like ``/metrics``. Without
+    a sentinel the endpoint reports ready with ``"alerts": false`` so
+    probers can tell "healthy" from "not watched"."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", healthz_fn=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.registry = registry
+        self.healthz_fn = healthz_fn
         scrapes = registry.counter("metrics_scrapes", "HTTP scrapes served")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib handler contract
+                import json as _json
+
+                status = 200
                 if self.path.split("?", 1)[0] == "/metrics":
                     body = outer.registry.render_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?", 1)[0] == "/metrics.json":
-                    import json as _json
-
                     body = _json.dumps(outer.registry.render_json()).encode()
+                    ctype = "application/json"
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    fn = outer.healthz_fn
+                    if fn is None:
+                        doc = {"ok": True, "alerts": False, "firing": []}
+                        ok = True
+                    else:
+                        try:
+                            ok, firing = fn()
+                        except Exception:  # noqa: BLE001 — probe must answer
+                            ok, firing = True, []
+                        doc = {"ok": bool(ok), "alerts": True,
+                               "firing": list(firing)}
+                    status = 200 if doc["ok"] else 503
+                    body = _json.dumps(doc).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
                 scrapes.inc()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

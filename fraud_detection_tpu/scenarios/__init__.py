@@ -23,8 +23,10 @@ and judges the system against declared SLOs:
 
 from fraud_detection_tpu.scenarios.clock import ScenarioClock, derive_seed
 from fraud_detection_tpu.scenarios.gameday import (CATALOG, ChaosSpec,
+                                                   ExpectedDetection,
                                                    GameDay, GameDayResult,
-                                                   KillSpec, get_scenario,
+                                                   KillSpec, SentinelSpec,
+                                                   get_scenario,
                                                    parse_scenario_ref,
                                                    run_gameday)
 from fraud_detection_tpu.scenarios.record import (dump_tracer,
@@ -41,8 +43,9 @@ from fraud_detection_tpu.scenarios.traffic import (CampaignWave, DiurnalLoad,
                                                    compose, generate)
 
 __all__ = [
-    "CATALOG", "CampaignWave", "ChaosSpec", "DiurnalLoad", "FlashCrowd",
-    "GameDay", "GameDayResult", "KillSpec", "ScenarioClock", "SloReport",
+    "CATALOG", "CampaignWave", "ChaosSpec", "DiurnalLoad",
+    "ExpectedDetection", "FlashCrowd", "GameDay", "GameDayResult",
+    "KillSpec", "ScenarioClock", "SentinelSpec", "SloReport",
     "SloSpec", "SteadyLoad", "TimelineAction", "TrafficEvent",
     "TrafficFeeder", "TrafficSpec", "compose", "derive_seed", "dump_tracer",
     "evaluate", "generate", "get_scenario", "load_recording", "parse_slo",

@@ -100,6 +100,63 @@ class KillSpec:
 
 
 @dataclass(frozen=True)
+class ExpectedDetection:
+    """One seeded fault class and the alert that must catch it: the
+    sentinel gate asserts rule ``rule`` FIRES within ``within_s``
+    sentinel-clock seconds of ``fault_at_s`` (the fault's virtual
+    injection time). Bounds are chosen to hold in BOTH pacing modes: a
+    warp run (time_scale 0) collapses the feed to its end stamp and then
+    advances one virtual tick per evaluation during the drain, so a warp
+    detection latency is bounded below by (timeline end - fault time)."""
+
+    rule: str
+    fault_at_s: float = 0.0
+    within_s: float = 10.0
+
+
+@dataclass(frozen=True)
+class SentinelSpec:
+    """The game day's watchdog (obs/sentinel/, docs/observability.md):
+    which rules run, at what virtual cadence, and what they must detect.
+    Empty ``rules`` resolves to the default pack (single-engine mode) or
+    the fleet pack (fleet mode) with windows scaled to game-day
+    durations. ``zero_incidents`` is the clean-control-arm gate: the run
+    must end with ``alerts.fired == 0`` (the false-positive gate)."""
+
+    interval_s: float = 0.25
+    rules: Tuple = ()                     # obs.sentinel.AlertRule tuple
+    expect: Tuple[ExpectedDetection, ...] = ()
+    zero_incidents: bool = False
+
+    def __post_init__(self):
+        if self.interval_s <= 0:
+            raise ValueError(
+                f"sentinel interval_s must be > 0, got {self.interval_s}")
+        if self.zero_incidents and self.expect:
+            raise ValueError(
+                "a sentinel spec cannot both expect detections and gate "
+                "on zero incidents")
+
+    def resolve_rules(self, fleet_mode: bool) -> Tuple:
+        from fraud_detection_tpu.obs.sentinel import (default_rule_pack,
+                                                      fleet_rule_pack)
+
+        if self.rules:
+            return tuple(self.rules)
+        # Game-day-scaled windows: catalog scenarios run seconds, not
+        # hours — fast/slow burn windows and hysteresis shrink to match,
+        # and the latency/stall limits widen past the warp-mode backlog
+        # artifacts (a warp feed enqueues the whole timeline at once, so
+        # enqueue->produce latency legitimately reaches seconds).
+        if fleet_mode:
+            return fleet_rule_pack(backlog_limit=20000.0, fast_s=2.0,
+                                   slow_s=8.0, resolve_s=1.0)
+        return default_rule_pack(fast_s=1.0, slow_s=4.0, for_s=0.0,
+                                 resolve_s=1.0, p99_ms=60000.0,
+                                 stall_s=30.0, dlq_limit=0.0005)
+
+
+@dataclass(frozen=True)
 class ChaosSpec:
     """Seeded broker-fault rates (stream/faults.py FaultPlan). The
     lethal kinds (poll errors, flush crashes) are single-engine only —
@@ -146,6 +203,11 @@ class GameDay:
     explain_queue: int = 48               # lane queue bound (small = drops
                                           # exercised; every drop records)
     explain_tokens: int = 12
+    # The run's watchdog (obs/sentinel/): rules evaluated on the scenario
+    # clock while the game day runs, with detects_within gates per seeded
+    # fault class — or the zero-incident false-positive gate on the clean
+    # control arm (docs/observability.md "Detection-latency gates").
+    sentinel: Optional[SentinelSpec] = None
     lease_ttl: float = 1.0
     supervise: int = 25
     idle_timeout: float = 1.0
@@ -184,6 +246,16 @@ class GameDay:
             raise ValueError(
                 f"game day {self.name!r}: explain_slots must be >= 1, "
                 f"got {self.explain_slots}")
+        if self.sentinel is not None and self.sentinel.expect:
+            known = {r.name for r in
+                     self.sentinel.resolve_rules(self.fleet_mode)}
+            missing = [e.rule for e in self.sentinel.expect
+                       if e.rule not in known]
+            if missing:
+                raise ValueError(
+                    f"game day {self.name!r}: detects_within expects "
+                    f"rules not in the sentinel pack: {missing} "
+                    f"(pack: {sorted(known)})")
 
     @property
     def fleet_mode(self) -> bool:
@@ -327,14 +399,43 @@ def run_gameday(gd: GameDay, *, pipeline=None, time_scale: float = 0.0,
         evidence.setdefault("errors", []).append(
             f"feeder: {feeder.error!r}")
 
-    report = evaluate(tuple(gd.slos) + tuple(extra_slos), evidence,
-                      scope="gameday")
+    # Sentinel gates (docs/observability.md "Detection-latency gates"):
+    # every expected detection becomes a detects_within SLO, and the
+    # clean control arm gates on zero incidents — auto-derived from the
+    # declaration so a scenario cannot declare a watchdog it forgets to
+    # judge.
+    auto_slos: List[SloSpec] = []
+    if gd.sentinel is not None:
+        evidence["fault_times"] = {e.rule: e.fault_at_s
+                                   for e in gd.sentinel.expect}
+        for e in gd.sentinel.expect:
+            auto_slos.append(SloSpec(f"detects_{e.rule}",
+                                     kind="detects_within", path=e.rule,
+                                     limit=e.within_s))
+        if gd.sentinel.zero_incidents:
+            auto_slos.append(SloSpec("zero_incidents", path="alerts.fired",
+                                     op="==", limit=0))
+
+    report = evaluate(tuple(gd.slos) + tuple(auto_slos) + tuple(extra_slos),
+                      evidence, scope="gameday")
     # Verdict-line summary: the full evidence fed the gates above; the
     # committed line keeps counts and the interesting blocks, not the key
     # lists or whole health trees.
     summary = {k: v for k, v in evidence.items()
                if k not in ("fed_keys", "out_keys", "dlq_keys", "health",
-                            "stage_latency_ms", "traces")}
+                            "stage_latency_ms", "traces", "alerts")}
+    alerts = evidence.get("alerts")
+    if isinstance(alerts, dict):
+        summary["alerts"] = {
+            "evaluations": alerts.get("evaluations"),
+            "fired": alerts.get("fired"),
+            "resolved": alerts.get("resolved"),
+            "still_firing": alerts.get("still_firing"),
+            "firing": alerts.get("firing"),
+            "incidents": [{k: i.get(k) for k in
+                           ("rule", "severity", "fired_at", "resolved_at")}
+                          for i in alerts.get("incidents") or []],
+        }
     summary["out_rows"] = len(evidence["out_keys"])
     summary["dlq_rows"] = len(evidence["dlq_keys"])
     summary["traces"] = [
@@ -359,13 +460,24 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
             min_polls=k.min_polls, max_polls=k.max_polls, modes=k.modes)
     dlq_topic = DLQ_TOPIC if (gd.dlq or (
         gd.sched is not None and gd.sched.shed_policy != "none")) else None
+    sentinel_kw = {}
+    if gd.sentinel is not None:
+        # Coordinator-level watchdog on the scenario clock: the fleet
+        # sentinel stamps virtual seconds (same VirtualCadence semantics
+        # as the single-engine runner, stepped at the monitor tick), so
+        # detects_within judges warp and paced fleet runs on one axis.
+        from fraud_detection_tpu.obs.sentinel import VirtualCadence
+
+        sentinel_kw = dict(
+            sentinel_rules=gd.sentinel.resolve_rules(fleet_mode=True),
+            sentinel_clock=VirtualCadence(clock.now, 0.02))
     fleet = Fleet.in_process(
         broker, serving, INPUT_TOPIC, OUTPUT_TOPIC, gd.workers,
         batch_size=gd.batch_size, max_wait=gd.max_wait,
         sched_config=gd.sched, dlq_topic=dlq_topic,
         death_plan=death_plan, lease_ttl=gd.lease_ttl,
         heartbeat_interval=0.02, tick_interval=0.02,
-        fault_plan=plan, trace=True, trace_sample=1.0)
+        fault_plan=plan, trace=True, trace_sample=1.0, **sentinel_kw)
     feeder.start()
     _wait_for_feed(feeder, n=min(64, len(feeder.events)))
     # Workers self-drain once input is idle AND the group's committed lag
@@ -389,6 +501,8 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         "errors": list(out["errors"]),
         "stage_latency_ms": out.get("stage_latency_ms"),
         "traces": [t.snapshot() for t in fleet.tracers.values()],
+        "alerts": out.get("alerts"),
+        "worker_alerts": out.get("worker_alerts"),
     }
 
 
@@ -446,6 +560,36 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
     dlq_attempts: dict = {}
     engines: list = []
 
+    # The watchdog (obs/sentinel/): ONE sentinel shared across the
+    # supervised incarnation chain (like the tracer and the poison
+    # tracker), reading the LIVE engine's health on the scenario clock —
+    # VirtualCadence stamps evaluations in virtual seconds, and the
+    # driver's wall cadence scales with time_scale (warp runs evaluate
+    # every interval_s WALL seconds during the drain, advancing one
+    # virtual tick each), so warp and paced game days judge detection
+    # latency on the same axis.
+    sentinel = None
+    sentinel_source = None
+    finish_sentinel = lambda: None  # noqa: E731 — mirrors serve's finishers
+    if gd.sentinel is not None:
+        from fraud_detection_tpu.obs.sentinel import (ChainedHealthSource,
+                                                      Sentinel,
+                                                      VirtualCadence,
+                                                      start_sentinel)
+
+        # Chain-cumulative counters: a chaos run's restart chain must
+        # read as monotonic burns + a supervisor.restarts counter, not as
+        # per-incarnation resets the sampling cadence can miss.
+        sentinel_source = ChainedHealthSource()
+        sentinel = Sentinel(
+            sentinel_source,
+            gd.sentinel.resolve_rules(fleet_mode=False),
+            clock=VirtualCadence(clock.now, gd.sentinel.interval_s),
+            worker="gd0")
+        wall_interval = gd.sentinel.interval_s * (
+            clock.time_scale if clock.time_scale > 0 else 1.0)
+        finish_sentinel = start_sentinel([sentinel], wall_interval)
+
     def harvest_annotations(engine) -> None:
         engine.close_annotations(timeout=120.0)
         s = engine.annotation_stats() or {}
@@ -472,8 +616,10 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
             annotations_queue=gd.explain_queue,
             explain_service=explain_service,
             dlq_topic=dlq_topic, dlq_attempts=dlq_attempts,
-            scheduler=scheduler, rowtrace=tracer)
+            scheduler=scheduler, rowtrace=tracer, sentinel=sentinel)
         engines.append(engine)
+        if sentinel_source is not None:
+            sentinel_source.attach(engine)
         return engine
 
     feeder.start()
@@ -509,6 +655,10 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
                 and broker.group_lag("gameday", [INPUT_TOPIC]) <= 0):
             break
     feeder.join(timeout=120.0)
+    # Stop the watchdog with a FINAL evaluation pass, so a condition that
+    # only became judgeable at the very end of the drain still transitions
+    # before the verdict reads the snapshot.
+    finish_sentinel()
     annotations = None
     explain_snap = None
     coverage = None
@@ -542,6 +692,7 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         "annotation_rows": (broker.topic_size(ANNOTATIONS_TOPIC)
                             if explain_async else None),
         "traces": [tracer.snapshot()],
+        "alerts": sentinel.snapshot() if sentinel is not None else None,
         "errors": errors,
     }
 
@@ -577,6 +728,10 @@ def _flash_crowd(seed: int, scale: float) -> GameDay:
         sched=_sched_config(max_queue=800, shed_policy="adaptive",
                             target_p99_ms=4000.0),
         dlq=True,
+        # The watchdog must CATCH the ramp: the shed-burn alert fires
+        # within bounded virtual seconds of the flash crowd's onset.
+        sentinel=SentinelSpec(expect=(
+            ExpectedDetection("shed_burn", fault_at_s=0.6, within_s=12.0),)),
         slos=(
             SloSpec("exact_accounting", kind="exact_accounting"),
             SloSpec("admission_shed_bit", path="stats.shed", op=">=",
@@ -605,6 +760,12 @@ def _campaign_breaker(seed: int, scale: float) -> GameDay:
         ),
         breaker_threshold=3,
         dlq=True,
+        # The breaker trip is the seeded fault here: the breaker_open
+        # delta rule must fire within bounded virtual seconds of the
+        # campaign wave that drives the dead backend.
+        sentinel=SentinelSpec(expect=(
+            ExpectedDetection("breaker_open", fault_at_s=0.8,
+                              within_s=12.0),)),
         slos=(
             SloSpec("exact_accounting", kind="exact_accounting"),
             SloSpec("breaker_tripped", path="breaker.opens", op=">=",
@@ -630,6 +791,13 @@ def _campaign_kill_swap(seed: int, scale: float) -> GameDay:
                        max_polls=6),
         hot_swap_at=1.2,
         lease_ttl=0.8,
+        # The fleet watchdog must see the kill: membership shrank while
+        # committed work remained (the while-gate separates the death
+        # from the clean drain exit). Kill timing is poll-count-seeded,
+        # not virtual-timed, so the bound covers the whole run.
+        sentinel=SentinelSpec(expect=(
+            ExpectedDetection("worker_absence", fault_at_s=0.0,
+                              within_s=60.0),)),
         traffic=(
             SteadyLoad(name="baseline", rate=200 * scale, duration_s=3.0,
                        scam_fraction=0.15),
@@ -702,6 +870,16 @@ def _chaos_storm(seed: int, scale: float) -> GameDay:
                         flush_fail_rate=0.05, flush_crash_rate=0.04,
                         commit_fence_rate=0.04, max_faults=40),
         dlq=True,
+        # Transport chaos kills incarnations from t=0 (poll errors, flush
+        # crashes): the restart-churn rule — judged through the
+        # chain-cumulative source — must see the crash loop. (Corruption
+        # would also DLQ rows, but corrupt draws are per-poll and can be
+        # zero at small scales; the restart chain is the guaranteed
+        # manifestation.) The bound is wide because supervised backoff
+        # chains stretch the drain.
+        sentinel=SentinelSpec(expect=(
+            ExpectedDetection("restart_churn", fault_at_s=0.0,
+                              within_s=25.0),)),
         traffic=(
             SteadyLoad(name="baseline", rate=180 * scale, duration_s=3.0,
                        scam_fraction=0.2),
@@ -730,6 +908,9 @@ def _diurnal_hotkey(seed: int, scale: float) -> GameDay:
                              base_rate=80 * scale, peak_rate=400 * scale,
                              period_s=4.0, scam_fraction=0.25,
                              hot_fraction=0.5, hot_keys=3),),
+        # The false-positive gate: the FULL default rule pack runs on the
+        # clean control arm and must produce ZERO incidents.
+        sentinel=SentinelSpec(zero_incidents=True),
         slos=(
             SloSpec("exact_accounting", kind="exact_accounting"),
             SloSpec("p99_batch_s", path="stats.p99_batch_latency_sec",

@@ -51,6 +51,13 @@ _OPS = {
 BUILTIN_KINDS = ("zero_loss", "zero_dup", "exact_accounting",
                  "spans_exact", "no_errors")
 
+#: Parameterized kinds beyond the bare builtins: ``detects_within`` judges
+#: the sentinel's detection latency — ``path`` names the alert rule,
+#: ``limit`` the allowed sentinel-clock seconds between the fault's
+#: injection time (``evidence["fault_times"][rule]``) and the rule's first
+#: FIRING incident (docs/observability.md "Detection-latency gates").
+PARAM_KINDS = ("detects_within",)
+
 
 @dataclass(frozen=True)
 class SloSpec:
@@ -68,10 +75,21 @@ class SloSpec:
     scope: str = "any"
 
     def __post_init__(self):
-        if self.kind not in BUILTIN_KINDS and self.kind != "metric":
+        if self.kind not in BUILTIN_KINDS and self.kind not in PARAM_KINDS \
+                and self.kind != "metric":
             raise ValueError(
                 f"unknown SLO kind {self.kind!r} (builtins: "
-                f"{BUILTIN_KINDS})")
+                f"{BUILTIN_KINDS}, parameterized: {PARAM_KINDS})")
+        if self.kind == "detects_within":
+            if not self.path:
+                raise ValueError(
+                    f"detects_within SLO {self.name!r} needs the alert "
+                    f"rule name in 'path'")
+            if not isinstance(self.limit, (int, float)) \
+                    or isinstance(self.limit, bool) or self.limit <= 0:
+                raise ValueError(
+                    f"detects_within SLO {self.name!r} needs a positive "
+                    f"numeric limit (seconds), got {self.limit!r}")
         if self.kind == "metric":
             if not self.path:
                 raise ValueError(f"metric SLO {self.name!r} needs a path")
@@ -224,6 +242,34 @@ def _check_builtin(spec: SloSpec, evidence: dict) -> SloVerdict:
                           "spans_open==0, traced==closed",
                           f"bad tracers: {[t.get('worker') for t in bad]}"
                           if bad else "")
+    if spec.kind == "detects_within":
+        # The sentinel gate (docs/observability.md): the named alert rule
+        # must have FIRED, and its first firing must land within ``limit``
+        # sentinel-clock seconds of the fault's injection time. Missing
+        # alerts evidence FAILS — a game day that declared a sentinel but
+        # produced no alert block lost its watchdog, which is itself the
+        # incident.
+        rule = spec.path
+        alerts = evidence.get("alerts")
+        expected = f"alert {rule!r} fires within {spec.limit}s of the fault"
+        if not isinstance(alerts, dict):
+            return SloVerdict(spec.name, False, "<no alerts evidence>",
+                              expected, "the run produced no sentinel "
+                              "snapshot — was the sentinel wired?")
+        incidents = [i for i in alerts.get("incidents") or []
+                     if i.get("rule") == rule
+                     and isinstance(i.get("fired_at"), (int, float))]
+        fault_at = (evidence.get("fault_times") or {}).get(rule, 0.0)
+        if not incidents:
+            return SloVerdict(spec.name, False, "<never fired>", expected,
+                              f"sentinel evaluated "
+                              f"{alerts.get('evaluations')}x, firing="
+                              f"{alerts.get('firing')}")
+        fired_at = min(i["fired_at"] for i in incidents)
+        latency = fired_at - fault_at
+        return SloVerdict(spec.name, latency <= spec.limit,
+                          round(latency, 3), expected,
+                          f"fault_at={fault_at} fired_at={fired_at}")
     if spec.kind == "no_errors":
         errors = list(evidence.get("errors") or [])
         feeder = evidence.get("feeder") or {}

@@ -244,6 +244,7 @@ class StreamingClassifier:
         scheduler: Optional[object] = None,
         async_dispatch: bool = False,
         rowtrace: Optional[object] = None,
+        sentinel: Optional[object] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if pipeline_depth < 1:
@@ -373,6 +374,15 @@ class StreamingClassifier:
         # pays one ``wants()`` gate per batch while a candidate is staged,
         # nothing when idle.
         self._shadow = shadow
+        # Optional obs.sentinel.Sentinel (anything with ``snapshot()``):
+        # the alerting engine watching this worker. Same contract as the
+        # breaker — health() surfaces its alert/incident block; evaluation
+        # is driven externally (the serve "sentinel" thread, the scenario
+        # harness's virtual-time driver), never from the hot loop. Share
+        # ONE sentinel across a worker's supervised incarnations (like the
+        # tracer and the DLQ poison tracker) so incident accounting
+        # survives restarts.
+        self._sentinel = sentinel
         # Injectable monotonic clock for health ages (tests drive it).
         self._clock = clock
         self._created_at = clock()
@@ -843,6 +853,7 @@ class StreamingClassifier:
         lane = self._annotation_lane
         breaker = self._breaker
         explain_service = self._explain_service
+        sentinel = self._sentinel
         # Model-lifecycle block (docs/model_lifecycle.md): present when the
         # engine scores through a HotSwapPipeline (active/staged versions,
         # swap count) and/or a ShadowScorer is attached (divergence stats);
@@ -870,6 +881,13 @@ class StreamingClassifier:
             "malformed": self.stats.malformed,
             "dead_lettered": self.stats.dead_lettered,
             "shed": self.stats.shed,
+            # Fence/zombie + lost-delivery counters (docs/robustness.md):
+            # commits fenced by a rebalance and flushes that failed with
+            # offsets held back — the sentinel's fence_events rule and
+            # any external alerting read these from health, so they
+            # belong in the block, not just the exit stats.
+            "rebalanced_commits": self.stats.rebalanced_commits,
+            "commits_skipped": self.stats.commits_skipped,
             "row_latency_ms": {"p50": self.stats.row_latency_ms(0.50),
                                "p99": self.stats.row_latency_ms(0.99)},
             "device": self._device_block(),
@@ -896,6 +914,13 @@ class StreamingClassifier:
             # counters, ring depth/drops, per-stage latency quantiles.
             "trace": (self._rowtrace.snapshot()
                       if self._rowtrace is not None else None),
+            # Alerting (obs/sentinel/, docs/observability.md): rule
+            # states, firing/critical lists, incident accounting
+            # (fired == resolved + still_firing), recent incidents.
+            "alerts": (sentinel.snapshot()
+                       if sentinel is not None
+                       and hasattr(sentinel, "snapshot")
+                       else None),
         }
 
     def _device_block(self) -> dict:

@@ -567,9 +567,10 @@ def test_health_snapshot_fields_and_monotonic_ages(pipeline):
                        "last_batch_age_sec", "in_flight_depth",
                        "consecutive_flush_failures", "processed",
                        "malformed", "dead_lettered", "shed",
+                       "rebalanced_commits", "commits_skipped",
                        "row_latency_ms", "device", "sched", "dlq",
                        "annotations", "breaker", "explain", "model",
-                       "trace"}
+                       "trace", "alerts"}
     assert h1["shed"] == 0 and h1["sched"] is None   # no scheduler attached
     assert h1["model"] is None          # plain pipeline: no lifecycle block
     assert h1["running"] is False

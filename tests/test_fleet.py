@@ -553,7 +553,8 @@ def test_fleet_health_file_written_during_run(pipeline, tmp_path):
                              health_file=str(path))
     fleet.run(idle_timeout=0.3, join_timeout=90.0)
     doc = json.loads(path.read_text())
-    assert set(doc) == {"time", "fleet", "workers"}
+    assert set(doc) == {"time", "fleet", "alerts", "workers"}
+    assert doc["alerts"] is None          # no sentinel rules armed
     assert doc["fleet"]["rebalances"] >= 1
 
 

@@ -374,6 +374,8 @@ ENGINE_HEALTH_SCHEMA = {
     "malformed": (int,),
     "dead_lettered": (int,),
     "shed": (int,),
+    "rebalanced_commits": (int,),
+    "commits_skipped": (int,),
     "row_latency_ms": (dict,),
     "device": (dict,),
     "sched": (type(None), dict),
@@ -383,6 +385,7 @@ ENGINE_HEALTH_SCHEMA = {
     "explain": (type(None), dict),
     "model": (type(None), dict),
     "trace": (type(None), dict),
+    "alerts": (type(None), dict),
 }
 
 DEVICE_BLOCK_SCHEMA = {

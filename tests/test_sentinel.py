@@ -1,0 +1,712 @@
+"""Sentinel alerting: rules, burn-rate windows, incident lifecycle,
+flight-recorder bundles, scenario-clock compatibility, serve CLI e2e
+(obs/sentinel/, docs/observability.md "Alerting and incidents").
+
+The invariants pinned here:
+
+* burn-rate rules need BOTH windows over the limit (fast catches, slow
+  confirms) and hysteresis prevents flapping in both directions;
+* incident accounting is exact — ``fired == resolved + still_firing`` —
+  including across a supervised chaos restart chain;
+* a warp-paced scenario run (time_scale 0) and a paced run produce the
+  SAME incident sequence at the same virtual times (the injectable-clock
+  contract the detects_within gates rely on);
+* every transition leaves a parseable ``incidents.jsonl`` line and firing
+  leaves a bundle dir (evidence window, metric deltas, health, implicated
+  trace chains);
+* the clean path fires NOTHING (default pack on a clean serve demo), and
+  ``/healthz`` flips 503 exactly while a critical alert fires.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fraud_detection_tpu.obs.sentinel import (AlertRule, ChainedHealthSource,
+                                              IncidentRecorder, Sentinel,
+                                              VirtualCadence,
+                                              default_rule_pack,
+                                              evaluate_timeline,
+                                              fleet_rule_pack, load_rules,
+                                              parse_rules, resolve_path)
+
+pytestmark = pytest.mark.sentinel
+
+
+class ScriptedSource:
+    """A mutable snapshot source tests drive step by step."""
+
+    def __init__(self, **state):
+        self.state = dict(state)
+        self.fail = False
+
+    def __call__(self):
+        if self.fail:
+            raise RuntimeError("scripted source failure")
+        return json.loads(json.dumps(self.state))   # deep copy, JSON-safe
+
+    def bump(self, **deltas):
+        for k, v in deltas.items():
+            self.state[k] = self.state.get(k, 0) + v
+
+
+def burn_rule(limit=0.05, fast=2.0, slow=8.0, **kw):
+    return AlertRule("burn", "burn_rate", num="bad", den="total", op=">",
+                     limit=limit, fast_s=fast, slow_s=slow, **kw)
+
+
+# ---------------------------------------------------------------------------
+# rules: validation, path resolution, parsing
+# ---------------------------------------------------------------------------
+
+def test_rule_validation_errors():
+    with pytest.raises(ValueError, match="unknown kind"):
+        AlertRule("x", "nope", path="a")
+    with pytest.raises(ValueError, match="severity"):
+        AlertRule("x", "static", path="a", severity="page")
+    with pytest.raises(ValueError, match="needs a path"):
+        AlertRule("x", "static")
+    with pytest.raises(ValueError, match="num and den"):
+        AlertRule("x", "burn_rate", num="a")
+    with pytest.raises(ValueError, match="slow_s"):
+        AlertRule("x", "burn_rate", num="a", den="b", fast_s=10, slow_s=5)
+    with pytest.raises(ValueError, match="op"):
+        AlertRule("x", "static", path="a", op="~=")
+
+
+def test_resolve_path_nested_and_sums():
+    snap = {"a": {"b": 3}, "c": [10, {"d": 4}], "e": None, "f": 2}
+    assert resolve_path(snap, "a.b") == (True, 3)
+    assert resolve_path(snap, "c.1.d") == (True, 4)
+    assert resolve_path(snap, "a.b+f") == (True, 5.0)
+    assert resolve_path(snap, "e") == (False, None)
+    assert resolve_path(snap, "a.z") == (False, None)
+    # A half-reported sum is missing, never garbage.
+    assert resolve_path(snap, "a.b+missing") == (False, None)
+
+
+def test_parse_rules_rejects_unknown_fields_and_duplicates(tmp_path):
+    with pytest.raises(ValueError, match="unknown fields"):
+        parse_rules([{"name": "r", "kind": "static", "path": "a",
+                      "treshold": 3}])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_rules([{"name": "r", "kind": "static", "path": "a"},
+                     {"name": "r", "kind": "static", "path": "b"}])
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({"rules": [
+        {"name": "dlq", "kind": "burn_rate", "num": "dead_lettered",
+         "den": "processed", "limit": 0.01, "fast_s": 5, "slow_s": 20}]}))
+    rules = load_rules(str(path))
+    assert [r.name for r in rules] == ["dlq"]
+    assert rules[0].slow_s == 20
+
+
+def test_default_and_fleet_packs_cover_the_failure_modes():
+    names = {r.name for r in default_rule_pack()}
+    # The ISSUE's failure-mode list, one rule each (docs/observability.md).
+    assert {"shed_burn", "breaker_open", "explain_coverage_drop",
+            "p99_slo_burn", "dlq_rate", "dispatch_stall", "spans_leak",
+            "fence_events", "restart_churn"} <= names
+    fleet = {r.name for r in fleet_rule_pack()}
+    assert {"fleet_watermark_burn", "worker_absence",
+            "worker_alerts"} <= fleet
+
+
+# ---------------------------------------------------------------------------
+# burn-rate unit suite (fast trips / slow holds / hysteresis)
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_needs_both_windows():
+    """A short spike trips the FAST window but the slow window holds —
+    no incident; a sustained burn crosses both and fires."""
+    src = ScriptedSource(bad=0, total=0)
+    s = Sentinel(src, [burn_rule(limit=0.05, fast=2.0, slow=20.0)])
+    t = 0.0
+    for _ in range(30):                       # 30s clean history
+        src.bump(total=100)
+        s.evaluate(now=t)
+        t += 1.0
+    # 2s spike at 20% — fast trips, slow (20s window) stays ~2%.
+    for _ in range(2):
+        src.bump(total=100, bad=20)
+        assert s.evaluate(now=t) == []
+        t += 1.0
+    assert s.firing() == []
+    # Sustained burn: the slow window crosses too — fires exactly once.
+    fired = []
+    for _ in range(25):
+        src.bump(total=100, bad=20)
+        fired += s.evaluate(now=t)
+        t += 1.0
+    assert [f["event"] for f in fired] == ["fired"]
+    assert s.firing() == ["burn"]
+
+
+def test_burn_rate_abstains_without_traffic():
+    """min_den: an idle stream (denominator below the floor) must not
+    alert — no traffic is not a 100% burn."""
+    src = ScriptedSource(bad=0, total=0)
+    s = Sentinel(src, [burn_rule(limit=0.05, min_den=10)])
+    for t in range(10):
+        src.bump(bad=1)                      # bad moves, total doesn't
+        s.evaluate(now=float(t))
+    assert s.firing() == []
+
+
+def test_for_s_hysteresis_prevents_flap_fire():
+    """A condition that flaps on/off faster than for_s never fires; one
+    held past for_s does."""
+    src = ScriptedSource(v=0)
+    rule = AlertRule("hot", "static", path="v", op=">", limit=10,
+                     for_s=3.0, fast_s=1.0, slow_s=4.0)
+    s = Sentinel(src, [rule])
+    t = 0.0
+    for _ in range(5):                       # 2s over, 2s under, repeat
+        src.state["v"] = 20
+        s.evaluate(now=t); s.evaluate(now=t + 1)
+        src.state["v"] = 0
+        s.evaluate(now=t + 2); s.evaluate(now=t + 3)
+        t += 4.0
+    assert s.fired == 0
+    src.state["v"] = 20
+    for i in range(4):
+        s.evaluate(now=t + i)
+    assert s.firing() == ["hot"]
+    assert s.fired == 1
+
+
+def test_resolve_s_hysteresis_prevents_flap_resolve():
+    """A firing incident survives a clear shorter than resolve_s — one
+    incident, not a storm."""
+    src = ScriptedSource(v=20)
+    rule = AlertRule("hot", "static", path="v", op=">", limit=10,
+                     resolve_s=5.0, fast_s=1.0, slow_s=4.0)
+    s = Sentinel(src, [rule])
+    s.evaluate(now=0.0)
+    assert s.firing() == ["hot"]
+    src.state["v"] = 0                        # clears for 2s...
+    s.evaluate(now=1.0); s.evaluate(now=2.0)
+    src.state["v"] = 20                       # ...then relapses
+    s.evaluate(now=3.0)
+    assert s.fired == 1 and s.resolved == 0   # still the SAME incident
+    src.state["v"] = 0                        # clear past resolve_s
+    for t in (4.0, 6.0, 9.5):
+        s.evaluate(now=t)
+    assert s.resolved == 1 and s.firing() == []
+    snap = s.snapshot()
+    assert snap["incidents"][0]["resolved_at"] == 9.5
+
+
+def test_counter_reset_reads_as_restart_not_negative_burn():
+    """A supervised restart resets engine counters; the window delta must
+    treat the drop as 'restarted from zero', not a negative rate."""
+    src = ScriptedSource(bad=40, total=400)
+    s = Sentinel(src, [burn_rule(limit=0.05, fast=2.0, slow=4.0)])
+    s.evaluate(now=0.0)
+    src.state.update(bad=0, total=0)          # incarnation reset
+    src.bump(total=100, bad=10)               # burn continues post-reset
+    out = s.evaluate(now=2.0)
+    assert [o["event"] for o in out] == ["fired"]
+
+
+def test_delta_decrease_watches_gauges():
+    """worker_absence semantics: a negative membership delta IS the
+    signal (no reset rewrite), and the while-gate keeps a clean drain
+    exit (lag 0) from reading as a death."""
+    src = ScriptedSource()
+    src.state = {"fleet": {"n_workers": 2, "committed_lag": 50}}
+    rule = [r for r in fleet_rule_pack(fast_s=5.0, slow_s=10.0)
+            if r.name == "worker_absence"]
+    s = Sentinel(src, rule)
+    s.evaluate(now=0.0)
+    src.state["fleet"]["n_workers"] = 1       # death while work remains
+    out = s.evaluate(now=1.0)
+    assert [o["event"] for o in out] == ["fired"]
+    # Clean-drain variant: drop with lag cleared -> inert.
+    src2 = ScriptedSource()
+    src2.state = {"fleet": {"n_workers": 2, "committed_lag": 0}}
+    s2 = Sentinel(src2, rule)
+    s2.evaluate(now=0.0)
+    src2.state["fleet"]["n_workers"] = 0
+    assert s2.evaluate(now=1.0) == []
+
+
+def test_absence_and_stale_rules():
+    src = ScriptedSource(progress=0, busy=True)
+    absent = AlertRule("gone", "absence", path="missing_block",
+                       fast_s=1.0, slow_s=2.0)
+    stale = AlertRule("stuck", "stale", path="progress",
+                      while_path="busy", fast_s=2.0, slow_s=4.0)
+    s = Sentinel(src, [absent, stale])
+    s.evaluate(now=0.0)
+    assert "gone" in s.firing()               # the path never existed
+    for t in (1.0, 2.0, 3.0):
+        s.evaluate(now=t)                     # progress frozen 3s > window
+    assert "stuck" in s.firing()
+    src.bump(progress=5)
+    src.state["missing_block"] = {"ok": 1}
+    s.evaluate(now=4.0)
+    assert s.firing() == []
+
+
+def test_source_failure_counts_never_raises():
+    src = ScriptedSource(v=0)
+    s = Sentinel(src, [AlertRule("r", "static", path="v", op=">", limit=1,
+                                 fast_s=1.0, slow_s=2.0)])
+    src.fail = True
+    assert s.evaluate(now=0.0) == []
+    assert s.snapshot()["eval_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the alerts health block (schema contract, FC301-checked)
+# ---------------------------------------------------------------------------
+
+ALERTS_BLOCK_SCHEMA = {
+    "worker": (str,),
+    "rules": (int,),
+    "evaluations": (int,),
+    "eval_errors": (int,),
+    "last_eval_at": (type(None), int, float),
+    "ring_depth": (int,),
+    "firing": (list,),
+    "critical_firing": (list,),
+    "pending": (list,),
+    "fired": (int,),
+    "resolved": (int,),
+    "still_firing": (int,),
+    "incidents": (list,),
+    "recorder": (type(None), dict),
+}
+
+
+def _assert_alerts_schema(snap):
+    assert set(snap) == set(ALERTS_BLOCK_SCHEMA), (
+        f"alerts block keys changed — update ALERTS_BLOCK_SCHEMA AND the "
+        f"docs/pollers (extra: {set(snap) - set(ALERTS_BLOCK_SCHEMA)}, "
+        f"missing: {set(ALERTS_BLOCK_SCHEMA) - set(snap)})")
+    for key, types in ALERTS_BLOCK_SCHEMA.items():
+        assert isinstance(snap[key], types), (key, type(snap[key]))
+
+
+def test_alerts_block_schema_and_accounting():
+    src = ScriptedSource(v=20)
+    s = Sentinel(src, [AlertRule("a", "static", path="v", op=">", limit=10,
+                                 fast_s=1.0, slow_s=2.0),
+                       AlertRule("b", "static", path="v", op=">", limit=5,
+                                 severity="warning", fast_s=1.0,
+                                 slow_s=2.0)])
+    s.evaluate(now=0.0)
+    src.state["v"] = 8                        # resolves a, keeps b
+    s.evaluate(now=1.0)
+    snap = s.snapshot()
+    _assert_alerts_schema(snap)
+    json.dumps(snap)                          # JSON-serializable
+    assert snap["fired"] == snap["resolved"] + snap["still_firing"]
+    assert snap["critical_firing"] == []      # a resolved; b is warning
+    assert snap["firing"] == ["b"]
+    assert s.healthz() == (True, [])
+
+
+def test_engine_health_carries_alerts_block():
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+    from fraud_detection_tpu.stream import InProcessBroker
+    from fraud_detection_tpu.stream.engine import StreamingClassifier
+    from tests.test_registry import const_model, make_featurizer
+
+    pipe = ServingPipeline(make_featurizer(), const_model(-8.0),
+                           batch_size=16)
+    broker = InProcessBroker()
+    feeder = broker.producer()
+    for i in range(16):
+        feeder.produce("in", json.dumps({"text": f"hello {i}"}).encode(),
+                       key=str(i).encode())
+    engine = StreamingClassifier(
+        pipe, broker.consumer(["in"], "g"), broker.producer(), "out",
+        batch_size=16)
+    source = ChainedHealthSource()
+    source.attach(engine)
+    sentinel = Sentinel(source, default_rule_pack())
+    engine._sentinel = sentinel               # health() surfaces it
+    engine.run(max_messages=16, idle_timeout=2.0)
+    sentinel.evaluate()
+    h = engine.health()
+    _assert_alerts_schema(h["alerts"])
+    assert h["alerts"]["fired"] == 0          # clean stream: no incidents
+    assert h["rebalanced_commits"] == 0 and h["commits_skipped"] == 0
+    # The chained source exposes the supervisor block.
+    assert source()["supervisor"]["restarts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# incident flight recorder
+# ---------------------------------------------------------------------------
+
+def test_incident_log_and_bundle(tmp_path):
+    src = ScriptedSource(bad=0, total=0)
+    rec = IncidentRecorder(str(tmp_path / "inc"))
+    s = Sentinel(src, [burn_rule(limit=0.05, fast=2.0, slow=4.0,
+                                 resolve_s=1.0)],
+                 recorder=rec, worker="t0")
+    s.evaluate(now=0.0)
+    src.bump(total=100, bad=20)
+    s.evaluate(now=1.0)                       # fires
+    assert s.firing() == ["burn"]
+    for t in (3.0, 6.0, 9.0):                 # burn ages out -> resolves
+        src.bump(total=100)
+        s.evaluate(now=t)
+    assert s.firing() == []
+    lines = [json.loads(l) for l in
+             (tmp_path / "inc" / "incidents.jsonl").read_text().splitlines()]
+    assert [l["event"] for l in lines] == ["fired", "resolved"]
+    assert lines[0]["rule"] == "burn" and lines[0]["id"] == lines[1]["id"]
+    assert lines[1]["resolved_at"] is not None
+    bundle_dir = tmp_path / "inc" / lines[0]["id"]
+    bundle = json.loads((bundle_dir / "bundle.json").read_text())
+    assert bundle["rule"]["name"] == "burn"
+    assert bundle["evidence_window"][0]["value"] == {"fast": 0.2,
+                                                     "slow": 0.2}
+    assert bundle["ring"]["deltas"]["bad"] == 20
+    assert bundle["health"]["total"] == 100
+    resolution = json.loads((bundle_dir / "resolution.json").read_text())
+    assert resolution["incident"]["resolved_at"] is not None
+    assert rec.snapshot()["recorded"] == 2
+
+
+def test_bundle_carries_implicated_trace_chains(tmp_path):
+    from fraud_detection_tpu.obs import RowTracer
+
+    tracer = RowTracer(worker="w0", sample=1.0)
+    bt = tracer.batch_begin(4)
+    cid = f"{bt.cid}:0:7"
+    bt.event("dlq", cid, ok=False, detail="poison")
+    tracer.commit(bt)
+    rec = IncidentRecorder(str(tmp_path), rowtrace=tracer)
+    src = ScriptedSource(v=20)
+    s = Sentinel(src, [AlertRule("a", "static", path="v", op=">", limit=1,
+                                 fast_s=1.0, slow_s=2.0)], recorder=rec)
+    s.evaluate(now=0.0)
+    incident = s.snapshot()["incidents"][0]
+    bundle = json.loads(
+        (tmp_path / incident["id"] / "bundle.json").read_text())
+    chains = bundle["chains"]
+    assert chains and chains[0]["cid"] == cid
+    assert chains[0]["event"] == "dlq"
+    stages = {sp["stage"] for sp in chains[0]["chain"]}
+    assert "poll" in stages and "dlq" in stages   # full poll->terminal
+
+
+# ---------------------------------------------------------------------------
+# scenario-clock compatibility (the warp-vs-paced regression, satellite 1)
+# ---------------------------------------------------------------------------
+
+def _scripted_run(time_scale: float):
+    """A deterministic 'metric as a function of virtual time' run: the
+    burn starts at t=5 and stops at t=12; evaluation every 0.5 virtual
+    seconds through the scenario clock."""
+    from fraud_detection_tpu.scenarios import ScenarioClock
+
+    clock = ScenarioClock(7, time_scale=time_scale)
+    state = {"bad": 0, "total": 0}
+
+    real_now = clock.now
+
+    def source():
+        # Integrated counters as a pure function of virtual time.
+        t = real_now()
+        state["total"] = int(t * 100)
+        state["bad"] = int(max(0.0, min(t, 12.0) - 5.0) * 30)
+        return dict(state)
+
+    s = Sentinel(source, [burn_rule(limit=0.1, fast=1.0, slow=3.0,
+                                    resolve_s=1.0)])
+    clock.start()
+    transitions = evaluate_timeline(s, clock, until_s=20.0, interval_s=0.5)
+    return [(tr["event"], tr["rule"], tr.get("fired_at"),
+             tr.get("resolved_at")) for tr in transitions], s.snapshot()
+
+
+@pytest.mark.scenario
+def test_warp_and_paced_runs_fire_identical_incident_sequences():
+    """The injectable-clock contract: a warp run (time_scale 0) and a
+    paced run evaluate rules at the SAME virtual times and produce the
+    SAME incident sequence — what makes detects_within deterministic."""
+    warp, warp_snap = _scripted_run(0.0)
+    paced, paced_snap = _scripted_run(0.005)   # 100ms wall for 20 virtual s
+    assert warp == paced
+    assert warp                                  # it actually fired
+    assert warp_snap["fired"] == paced_snap["fired"] == 1
+    assert warp_snap["resolved"] == paced_snap["resolved"] == 1
+
+
+def test_virtual_cadence_never_stalls():
+    vals = iter([0.0, 3.0, 3.0, 3.0])
+    vc = VirtualCadence(lambda: next(vals), step=0.5)
+    assert vc() == 0.0
+    assert vc() == 3.0
+    assert vc() == 3.5          # the cursor keeps advancing past the feed
+    assert vc() == 4.0
+
+
+# ---------------------------------------------------------------------------
+# chaos: exact incident accounting across a supervised restart chain
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_incident_accounting_exact_across_supervised_chaos(tmp_path):
+    """One sentinel over the chain-cumulative source, evaluated from the
+    driver thread while a seeded chaos plan kills incarnations: the
+    restart churn is DETECTED and ``fired == resolved + still_firing``
+    holds at every observation point."""
+    from fraud_detection_tpu.models.pipeline import ServingPipeline
+    from fraud_detection_tpu.stream import InProcessBroker
+    from fraud_detection_tpu.stream.engine import (StreamingClassifier,
+                                                   run_supervised)
+    from fraud_detection_tpu.stream.faults import FaultPlan
+    from tests.test_registry import const_model, make_featurizer
+
+    pipe = ServingPipeline(make_featurizer(), const_model(-8.0),
+                           batch_size=32)
+    broker = InProcessBroker(num_partitions=2)
+    feeder = broker.producer()
+    for i in range(400):
+        feeder.produce("in", json.dumps({"text": f"msg {i}"}).encode(),
+                       key=str(i).encode())
+    plan = FaultPlan(seed=3, poll_error_rate=0.12, flush_crash_rate=0.05,
+                     corrupt_rate=0.05, max_faults=25,
+                     sleep=lambda s: None)
+    source = ChainedHealthSource()
+    rec = IncidentRecorder(str(tmp_path))
+    sentinel = Sentinel(source,
+                        default_rule_pack(fast_s=0.5, slow_s=2.0,
+                                          resolve_s=0.5),
+                        recorder=rec, worker="w0")
+    sentinel.prime()
+    stop = threading.Event()
+
+    def evaluator():
+        while not stop.wait(0.01):
+            sentinel.evaluate()
+            snap = sentinel.snapshot()
+            assert snap["fired"] == (snap["resolved"]
+                                     + snap["still_firing"])
+
+    thread = threading.Thread(target=evaluator, daemon=True)
+    thread.start()
+    dlq_attempts: dict = {}
+
+    def make_engine():
+        engine = StreamingClassifier(
+            pipe, plan.consumer(broker.consumer(["in"], "g")),
+            plan.producer(broker.producer()), "out", batch_size=32,
+            max_wait=0.01, dlq_topic="dlq", dlq_attempts=dlq_attempts,
+            sentinel=sentinel)
+        source.attach(engine)
+        return engine
+
+    try:
+        run_supervised(make_engine, max_restarts=40, idle_timeout=0.5,
+                       sleep=lambda s: time.sleep(min(s, 0.01)))
+    finally:
+        stop.set()
+        thread.join(timeout=5.0)
+    sentinel.evaluate()
+    snap = sentinel.snapshot()
+    assert snap["fired"] == snap["resolved"] + snap["still_firing"]
+    assert snap["fired"] >= 1, snap          # the chaos WAS detected
+    assert "restart_churn" in {i["rule"] for i in snap["incidents"]}
+    # Every transition is on disk, parseable, fired/resolved balanced
+    # with the in-memory accounting.
+    lines = [json.loads(l) for l in
+             (tmp_path / "incidents.jsonl").read_text().splitlines()]
+    assert len([l for l in lines if l["event"] == "fired"]) == snap["fired"]
+    assert (len([l for l in lines if l["event"] == "resolved"])
+            == snap["resolved"])
+
+
+# ---------------------------------------------------------------------------
+# /healthz readiness endpoint
+# ---------------------------------------------------------------------------
+
+@pytest.mark.obs
+def test_healthz_flips_503_on_critical_alert():
+    from fraud_detection_tpu.obs import MetricsRegistry
+    from fraud_detection_tpu.obs.export import MetricsServer
+
+    src = ScriptedSource(v=0)
+    s = Sentinel(src, [AlertRule("crit", "static", path="v", op=">",
+                                 limit=10, fast_s=1.0, slow_s=2.0),
+                       AlertRule("warn", "static", path="v", op=">",
+                                 limit=5, severity="warning",
+                                 fast_s=1.0, slow_s=2.0)])
+    registry = MetricsRegistry()
+    server = MetricsServer(registry, 0, healthz_fn=s.healthz)
+    url = f"http://127.0.0.1:{server.port}/healthz"
+    try:
+        doc = json.loads(urllib.request.urlopen(url).read())
+        assert doc == {"ok": True, "alerts": True, "firing": []}
+        src.state["v"] = 8                    # warning only: still ready
+        s.evaluate(now=0.0)
+        assert json.loads(urllib.request.urlopen(url).read())["ok"] is True
+        src.state["v"] = 20                   # critical fires: 503
+        s.evaluate(now=1.0)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url)
+        assert exc.value.code == 503
+        doc = json.loads(exc.value.read())
+        assert doc["ok"] is False and doc["firing"] == ["crit"]
+        # Self-counting: the scrape counter saw all three probes.
+        flat = registry.render_json()["metrics"]
+        assert flat["fraud_metrics_scrapes_total"] >= 3
+    finally:
+        server.close()
+
+
+def test_healthz_without_sentinel_reports_unwatched():
+    from fraud_detection_tpu.obs import MetricsRegistry
+    from fraud_detection_tpu.obs.export import MetricsServer
+
+    server = MetricsServer(MetricsRegistry(), 0)
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz").read())
+        assert doc == {"ok": True, "alerts": False, "firing": []}
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# detects_within SLO gate (unit)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scenario
+def test_detects_within_slo_gate():
+    from fraud_detection_tpu.scenarios.slo import SloSpec, evaluate
+
+    spec = SloSpec("detects_x", kind="detects_within", path="shed_burn",
+                   limit=5.0)
+    ok_evidence = {
+        "alerts": {"incidents": [{"rule": "shed_burn", "fired_at": 4.0}],
+                   "evaluations": 10, "firing": []},
+        "fault_times": {"shed_burn": 1.0},
+    }
+    report = evaluate([spec], ok_evidence)
+    assert report.ok and report.verdicts[0].observed == 3.0
+    late = dict(ok_evidence)
+    late["fault_times"] = {"shed_burn": -2.0}   # 6s latency > 5
+    assert not evaluate([spec], late).ok
+    never = {"alerts": {"incidents": [], "evaluations": 10, "firing": []}}
+    report = evaluate([spec], never)
+    assert not report.ok and report.verdicts[0].observed == "<never fired>"
+    assert not evaluate([spec], {}).ok          # missing alerts FAILS
+    with pytest.raises(ValueError, match="rule name"):
+        SloSpec("bad", kind="detects_within", limit=5.0)
+    with pytest.raises(ValueError, match="positive numeric"):
+        SloSpec("bad", kind="detects_within", path="r", limit=0)
+
+
+# ---------------------------------------------------------------------------
+# game days (fast, scaled down): detection + the false-positive gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scenario
+def test_gameday_flash_crowd_detects_shed_burn():
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    result = run_gameday(get_scenario("flash_crowd", 0, scale=0.4))
+    assert result.ok, result.table()
+    verdicts = {v.name: v for v in result.report.verdicts}
+    assert verdicts["detects_shed_burn"].ok
+    alerts = result.evidence["alerts"]
+    assert alerts["fired"] == (alerts["resolved"] + alerts["still_firing"])
+
+
+@pytest.mark.scenario
+def test_gameday_control_arm_zero_incidents():
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    result = run_gameday(get_scenario("diurnal_hotkey", 0, scale=0.25))
+    assert result.ok, result.table()
+    assert result.evidence["alerts"]["fired"] == 0
+    assert {v.name for v in result.report.verdicts} >= {"zero_incidents"}
+
+
+# ---------------------------------------------------------------------------
+# serve CLI e2e
+# ---------------------------------------------------------------------------
+
+def _serve_stats(capsys):
+    out = capsys.readouterr().out
+    return json.loads([l for l in out.splitlines()
+                       if l.startswith("{")][-1])
+
+
+def test_serve_cli_alerts_chaos_fires_and_records(tmp_path, capsys):
+    from fraud_detection_tpu.app.serve import main as serve_main
+
+    inc = tmp_path / "incidents"
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps([
+        {"name": "dlq_rate", "kind": "burn_rate", "num": "dead_lettered",
+         "den": "processed", "limit": 0.0005, "fast_s": 5, "slow_s": 10},
+        {"name": "fence_events", "kind": "delta",
+         "path": "rebalanced_commits", "op": ">=", "limit": 1,
+         "fast_s": 5, "slow_s": 10},
+        {"name": "restart_churn", "kind": "delta",
+         "path": "supervisor.restarts", "op": ">=", "limit": 1,
+         "severity": "warning", "fast_s": 5, "slow_s": 10}]))
+    rc = serve_main(["--model", "synthetic", "--demo", "2000",
+                     "--batch-size", "256", "--max-wait", "0.01",
+                     "--chaos", "--chaos-seed", "5", "--dlq",
+                     "--alert-rules", str(rules),
+                     "--incident-dir", str(inc),
+                     "--alert-interval", "0.05"])
+    assert rc == 0
+    stats = _serve_stats(capsys)
+    alerts = stats["alerts"]
+    assert alerts["fired"] >= 1, alerts       # the chaos was detected
+    assert alerts["fired"] == alerts["resolved"] + alerts["still_firing"]
+    lines = [json.loads(l) for l in
+             (inc / "incidents.jsonl").read_text().splitlines()]
+    assert lines and all(l["event"] in ("fired", "resolved")
+                         for l in lines)
+    first = next(l for l in lines if l["event"] == "fired")
+    bundle = json.loads(
+        (inc / first["id"] / "bundle.json").read_text())
+    assert bundle["rule"]["name"] == first["rule"]
+    assert bundle["health"] is not None
+
+
+def test_serve_cli_clean_run_zero_incidents(tmp_path, capsys):
+    """The false-positive gate: the DEFAULT pack on a clean demo run
+    must end with zero incidents and no incident log."""
+    from fraud_detection_tpu.app.serve import main as serve_main
+
+    inc = tmp_path / "incidents"
+    rc = serve_main(["--model", "synthetic", "--demo", "2000",
+                     "--batch-size", "256", "--max-wait", "0.01",
+                     "--alerts", "--incident-dir", str(inc),
+                     "--alert-interval", "0.05"])
+    assert rc == 0
+    stats = _serve_stats(capsys)
+    assert stats["alerts"]["fired"] == 0, stats["alerts"]
+    assert stats["alerts"]["evaluations"] >= 1
+    assert not (inc / "incidents.jsonl").exists()
+
+
+def test_serve_cli_alert_flag_validation(tmp_path):
+    from fraud_detection_tpu.app.serve import main as serve_main
+
+    with pytest.raises(SystemExit, match="alert-interval"):
+        serve_main(["--model", "synthetic", "--demo", "10", "--alerts",
+                    "--alert-interval", "0"])
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([{"name": "x", "kind": "wat"}]))
+    with pytest.raises(SystemExit, match="bad --alert-rules"):
+        serve_main(["--model", "synthetic", "--demo", "10",
+                    "--alert-rules", str(bad)])
