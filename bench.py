@@ -168,6 +168,18 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
         "scenarios": ({name: s.get("ok") for name, s in
                        ((line.get("scenarios") or {}).get("scenarios")
                         or {}).items()} or None),
+        # Closed-loop learning (ISSUE 15, docs/online_learning.md):
+        # retrain wall, drift-onset -> promotion latency in virtual
+        # seconds, and the label join-hit ratio, so a slow retrain or a
+        # leaky label lane diffs in the trend file.
+        "learn": (lambda ln: ({
+            "ok": ln.get("ok"),
+            "promoted": ln.get("promoted"),
+            "retrain_wall_s": ln.get("retrain_wall_s"),
+            "promotion_latency_virtual_s": ln.get(
+                "promotion_latency_virtual_s"),
+            "join_hit_ratio": ln.get("join_hit_ratio"),
+        } if ln and "error" not in ln else None))(line.get("learn") or {}),
         # Sentinel evidence (ISSUE 14, docs/observability.md): per-fault
         # detection latency in virtual seconds + the paired evaluation-
         # overhead ratio, so a detection regression or a hot sentinel
@@ -1138,6 +1150,52 @@ def scenario_bench(pipe) -> dict:
     return out
 
 
+def learn_bench() -> dict:
+    """Closed-loop online learning evidence (docs/online_learning.md): the
+    seeded ``drift_shift`` game day — a novel-vocabulary campaign the live
+    model scores benign, caught by delayed labels, fixed by a
+    warm-started windowed retrain, auto-promoted through the
+    PSI/agreement/health gates. Committed: retrain wall time, drift-onset
+    -> promotion latency in VIRTUAL seconds, the label join-hit ratio,
+    and the exact-accounting bit — so a slow retrain, a leaky join, or a
+    loop that stops promoting diffs in the artifact and the trend file."""
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    seed = int(os.environ.get("BENCH_LEARN_SEED", "11"))
+    scale = float(os.environ.get("BENCH_LEARN_SCALE", "0.4"))
+    gd = get_scenario("drift_shift", seed, scale=scale)
+    t0 = time.perf_counter()
+    result = run_gameday(gd)     # builds its own xgb pipeline (gd.model)
+    ev = result.evidence
+    learn = ev.get("learn") or {}
+    window = learn.get("window") or {}
+    out = {
+        "ok": result.ok, "seed": seed, "scale": scale,
+        "rows": ev.get("planned"),
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "published": learn.get("published"),
+        "promoted": learn.get("promoted"),
+        "retrain_wall_s": learn.get("last_retrain_wall_s"),
+        "promotion_latency_virtual_s": ev.get("learn_promotion_latency_s"),
+        "join_hit_ratio": (round(window["joined"] / window["labels_seen"], 4)
+                           if window.get("labels_seen") else None),
+        "labels_seen": window.get("labels_seen"),
+        "accounting_exact": window.get("accounting_exact"),
+        "primary_window_error_rate": learn.get("primary_window_error_rate"),
+        "candidate_window_error_rate": learn.get(
+            "candidate_window_error_rate"),
+        "verdicts": {v.name: bool(v.ok or v.skipped)
+                     for v in result.report.verdicts},
+    }
+    # In-leg gates (the CI bench-smoke re-asserts them from the artifact):
+    # the loop must actually have promoted and the accounting must be
+    # exact — a learn leg that "ran" without closing the loop is a
+    # regression, not a data point.
+    assert out["promoted"], out
+    assert out["accounting_exact"] is True, out
+    return out
+
+
 def tree_streaming_bench(texts, batch_size: int, depth: int,
                          n_msgs: int = 10_000, lr_pipe=None) -> dict:
     """Streaming throughput for the tree families through the raw-JSON path
@@ -2102,6 +2160,13 @@ def main() -> int:
             "scenarios",
             lambda scratch: scenario_bench(pipe_or_raise()),
             fraction=0.35)
+
+    if os.environ.get("BENCH_LEARN", "1") != "0":
+        # Closed-loop learning evidence (docs/online_learning.md): the
+        # drift_shift game day — retrain wall, drift->promotion virtual
+        # latency, join-hit ratio, exact accounting (asserted in-leg).
+        harness.section("learn", lambda scratch: learn_bench(),
+                        fraction=0.35)
 
     if os.environ.get("BENCH_ALERTS", "1") != "0":
         # Sentinel evidence (ISSUE 14, docs/observability.md): detection

@@ -83,6 +83,14 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     # CLI's --alert-interval cadence (fleet/worker sentinels evaluate on
     # the monitor/poll threads instead — no extra thread there).
     ("obs/sentinel/engine.py", "loop"),
+    # Closed learning loop (learn/, docs/online_learning.md): ONE
+    # learn-lane worker owning window ingestion, label joins, windowed
+    # retrains, registry publishes, and shadow replays.
+    ("learn/loop.py", "self._run"),
+    # Scenario ground-truth oracle (scenarios/labels.py): consumes the
+    # input topic and produces delayed feedback labels for drift game
+    # days.
+    ("scenarios/labels.py", "self._run"),
 })
 
 
@@ -176,6 +184,16 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
                "slot-state arrays and the SlotDecoder are worker-only by "
                "the class's role map, waiters block on per-request "
                "events"),
+    # Learn lane: the one closed-loop worker; the region also guards the
+    # inline tick() test driver (learn/loop.py).
+    EntryPoint("learn-lane", "learn/loop.py", "LearnLoop._run",
+               "LearnLoop.lane"),
+    EntryPoint("label-feeder", "scenarios/labels.py", "LabelFeeder._run",
+               None,
+               "single feeder by construction (one thread per start(), "
+               "never respawned); counters under _lock, the error field "
+               "is a documented write-once latch read after join(), "
+               "broker/consumer calls go through their own locks"),
     EntryPoint("sentinel", "obs/sentinel/engine.py", "loop", None,
                "single evaluator by construction (start_sentinel spawns "
                "one thread per call and serve calls it once); all rule/"
@@ -300,6 +318,29 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
                     "explain_rows", "snapshot", "drain", "close",
                     "set_rowtrace"),
         slotserve_lane=("_run",)),
+    # Learn loop (learn/loop.py, docs/online_learning.md): _run (and the
+    # ingestion/retrain/replay methods it reaches) executes on the one
+    # learn-lane worker; wants/submit come from the engine driver,
+    # on_transition from the lifecycle watcher, snapshot from health
+    # pollers — every shared counter under _lock; the window store has
+    # its own lock.
+    "learn/loop.py::LearnLoop": _spec(
+        any_thread=("wants", "submit", "snapshot", "on_transition",
+                    "bind_controller", "drain", "close"),
+        learn_lane=("_run", "tick")),
+    # Window store (learn/store.py): a blackboard — the learn lane
+    # inserts/joins/sweeps, health pollers snapshot; everything under
+    # the store's one lock.
+    "learn/store.py::WindowStore": _spec(
+        any_thread=("insert", "join", "sweep", "count_malformed",
+                    "labeled_rows", "error_stats", "error_by_version",
+                    "snapshot", "__len__")),
+    # Scenario label oracle (scenarios/labels.py): _run executes on the
+    # one label-feeder thread; stats/fed/stop/join are the cross-thread
+    # surface (counters under _lock, error is a write-once latch).
+    "scenarios/labels.py::LabelFeeder": _spec(
+        any_thread=("stats", "fed", "stop", "join"),
+        label_feeder=("_run", "_truth_of")),
     # Sentinel (obs/sentinel/, docs/observability.md): evaluate/prime run
     # on whichever single thread drives this sentinel (the serve
     # "sentinel" thread, the fleet monitor, a fleet worker's poll path,
@@ -365,6 +406,17 @@ OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
     "fleet/coordinator.py::FleetCoordinator.bus": ("FleetBus",),
     # Slotserve lane: the service drives its decoder from the lane thread.
     "explain/slotserve/service.py::SlotServeService._decoder": ("SlotDecoder",),
+    # Learn seams (learn/, docs/online_learning.md): the engine offers
+    # scored batches to the loop; the loop drives its window store, the
+    # registry, and the shadow scorer's encoded-replay surface.
+    "stream/engine.py::StreamingClassifier._learn": ("LearnLoop",),
+    "learn/loop.py::LearnLoop.store": ("WindowStore",),
+    "learn/loop.py::LearnLoop._shadow": ("ShadowScorer",),
+    "learn/loop.py::LearnLoop._registry": ("ModelRegistry",),
+    "learn/loop.py::LearnLoop._controller": ("LifecycleController",),
+    "learn/loop.py::LearnLoop._consumer": ("Consumer",),
+    "scenarios/labels.py::LabelFeeder._consumer": ("Consumer",),
+    "scenarios/labels.py::LabelFeeder._producer": ("Producer",),
     # Sentinel seams (obs/sentinel/): the engine/fleet surfaces hold a
     # sentinel whose snapshot they read; the sentinel drives its recorder.
     "stream/engine.py::StreamingClassifier._sentinel": ("Sentinel",),

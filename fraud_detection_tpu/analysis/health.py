@@ -76,6 +76,13 @@ CONTRACTS: Tuple[Contract, ...] = (
     # sub-object — rule states, firing lists, incident accounting.
     Contract("obs/sentinel/engine.py", "Sentinel.snapshot",
              "test_sentinel.py", "ALERTS_BLOCK_SCHEMA"),
+    # Closed learning loop (docs/online_learning.md): the engine's
+    # "learn" sub-object — window/join accounting, retrain triggers,
+    # published/promoted candidates.
+    Contract("learn/loop.py", "LearnLoop.snapshot",
+             "test_learn.py", "LEARN_BLOCK_SCHEMA"),
+    Contract("learn/store.py", "WindowStore.snapshot",
+             "test_learn.py", "LEARN_WINDOW_SCHEMA"),
 )
 
 

@@ -166,6 +166,28 @@ def main(argv=None) -> int:
     ap.add_argument("--shadow-queue", type=int, default=8,
                     help="bounded shadow queue depth; overflow drops + "
                          "counts, never blocks the primary (--shadow)")
+    ap.add_argument("--learn", action="store_true",
+                    help="close the learning loop (learn/, docs/"
+                         "online_learning.md): join feedback labels "
+                         "against a sliding window of scored rows, "
+                         "retrain boosted trees on drift, publish to "
+                         "--registry, auto-promote through the --shadow/"
+                         "--promote-policy gates (requires all three)")
+    ap.add_argument("--learn-feedback-topic", default=None, metavar="TOPIC",
+                    help="ground-truth label topic (stream/feedback.py "
+                         "records; default <input-topic>-feedback)")
+    ap.add_argument("--learn-window", type=int, default=8192,
+                    help="learn window capacity in rows (--learn)")
+    ap.add_argument("--learn-min-rows", type=int, default=256,
+                    help="labeled rows required before any retrain")
+    ap.add_argument("--learn-error-threshold", type=float, default=0.15,
+                    help="drift trigger: recent label-error rate of the "
+                         "live model above this fires a retrain")
+    ap.add_argument("--learn-rounds", type=int, default=8,
+                    help="warm-start boosting rounds per windowed retrain")
+    ap.add_argument("--learn-interval", type=float, default=0.0,
+                    help="retrain cadence in seconds (0 = drift/row "
+                         "triggers only)")
     ap.add_argument("--promote-policy", default=None, metavar="SPEC",
                     help="auto promote/reject the staged candidate, e.g. "
                          "'min_batches=5,min_rows=200,max_disagreement="
@@ -424,6 +446,19 @@ def main(argv=None) -> int:
     if args.promote_policy is not None and not args.shadow:
         raise SystemExit("--promote-policy needs --shadow (there is no "
                          "candidate to judge without shadow scoring)")
+    if args.learn:
+        # The loop's whole contract is publish -> stage -> shadow-judge ->
+        # auto-promote, so every leg must be wired explicitly.
+        if not (args.registry and args.watch and args.shadow
+                and args.promote_policy):
+            raise SystemExit(
+                "--learn closes the loop through the registry lifecycle: "
+                "it requires --registry, --watch, --shadow AND "
+                "--promote-policy (docs/online_learning.md)")
+        if args.learn_min_rows < 2 or args.learn_rounds < 1 \
+                or args.learn_window < 2:
+            raise SystemExit("--learn-min-rows/--learn-rounds/"
+                             "--learn-window must be positive")
     if args.watch_interval <= 0:
         raise SystemExit(
             f"--watch-interval must be > 0, got {args.watch_interval}")
@@ -871,6 +906,33 @@ def main(argv=None) -> int:
     else:
         raise SystemExit("choose --kafka or --demo N (no broker specified)")
 
+    learn_loop = None
+    if args.learn:
+        # Closed learning loop (learn/, docs/online_learning.md): the
+        # learn-lane thread joins feedback labels against the scored-row
+        # window and publishes drift-corrected candidates into the SAME
+        # registry the --watch lifecycle promotes from.
+        from fraud_detection_tpu.learn import LearnConfig, LearnLoop
+
+        feedback_topic = (args.learn_feedback_topic
+                          or f"{args.input_topic}-feedback")
+        if args.kafka:
+            from fraud_detection_tpu.stream.kafka import KafkaConsumer
+
+            feedback_consumer = KafkaConsumer([feedback_topic])
+        else:
+            feedback_consumer = broker.consumer([feedback_topic], "learn")
+        learn_loop = LearnLoop(
+            feedback_consumer=feedback_consumer, registry=registry,
+            hotswap=pipe, shadow=shadow,
+            config=LearnConfig(
+                window=args.learn_window,
+                min_labeled=args.learn_min_rows,
+                error_threshold=args.learn_error_threshold,
+                refresh_rounds=args.learn_rounds,
+                interval_s=(args.learn_interval
+                            if args.learn_interval > 0 else None)))
+
     fault_plan = None
     if args.chaos:
         # One plan shared by every incarnation: the single seeded rng stream
@@ -1102,6 +1164,7 @@ def main(argv=None) -> int:
                                 breaker=breaker,
                                 explain_service=explain_service,
                                 shadow=shadow,
+                                learn=learn_loop,
                                 scheduler=scheduler,
                                 async_dispatch=args.async_dispatch,
                                 rowtrace=rowtrace_for(worker),
@@ -1162,13 +1225,22 @@ def main(argv=None) -> int:
             registry, pipe, shadow=shadow, policy=promote_policy,
             batch_size=args.batch_size,
             health_fn=lambda: (engines_built[-1].health()
-                               if engines_built else None))
+                               if engines_built else None),
+            on_transition=(learn_loop.on_transition
+                           if learn_loop is not None else None))
+        if learn_loop is not None:
+            learn_loop.bind_controller(lifecycle)
         _watch_thread, watch_stop = lifecycle.run_in_thread(
             args.watch_interval)
 
     def finish_lifecycle():
-        """Stop the watcher + shadow worker; returns the audit-event list
-        for the stats JSON (None when not serving from a registry)."""
+        """Stop the learn lane + watcher + shadow worker; returns the
+        audit-event list for the stats JSON (None when not serving from a
+        registry). The learn lane closes FIRST (a retrain mid-flight
+        finishes and its publish is still picked up by the final watcher
+        state below)."""
+        if learn_loop is not None:
+            learn_loop.close(timeout=30.0)
         if watch_stop is not None:
             watch_stop.set()
             _watch_thread.join(timeout=5.0)
@@ -1176,10 +1248,13 @@ def main(argv=None) -> int:
             shadow.close(timeout=5.0)
         if registry is None:
             return None
-        return {"active_version": pipe.active_version,
-                "staged_version": pipe.staged_version,
-                "swaps": pipe.swaps,
-                "events": lifecycle.events if lifecycle is not None else []}
+        out = {"active_version": pipe.active_version,
+               "staged_version": pipe.staged_version,
+               "swaps": pipe.swaps,
+               "events": lifecycle.events if lifecycle is not None else []}
+        if learn_loop is not None:
+            out["learn"] = learn_loop.snapshot()
+        return out
 
     print(f"serving: model={model_desc} in={args.input_topic} out={args.output_topic} "
           f"batch={args.batch_size} workers={args.workers}", flush=True)

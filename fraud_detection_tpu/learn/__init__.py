@@ -1,0 +1,27 @@
+"""Driftloop — closed-loop online learning beside the serving engine.
+
+Four pieces (docs/online_learning.md):
+
+* the **label lane**: delayed ground-truth labels on a feedback topic
+  (stream/feedback.py format, any Consumer transport), joined against a
+  bounded keyed sliding window of recently scored rows
+  (:class:`~fraud_detection_tpu.learn.store.WindowStore` — packed encoded
+  features retained, never text; every label joined, expired, or counted);
+* the **incremental trainer**: windowed warm-started boosted-tree refresh
+  through the device histogram kernels
+  (models/train_trees.py ``refresh_gradient_boosting``), producing a
+  registry-publishable candidate with lineage + window metadata;
+* the **loop controller**: the registered "learn-lane" thread
+  (:class:`~fraud_detection_tpu.learn.loop.LearnLoop`) joining labels,
+  triggering retrains on row-count/time/drift signals, publishing to the
+  registry — promotion rides the EXISTING ``LifecycleController``
+  stage→shadow→judge→promote path and its PSI/agreement/health gates;
+* **closed-loop verification**: the seeded ``drift_shift`` game day
+  (scenarios/gameday.py) gating detection→retrain→promotion latency,
+  exact label-join accounting, and zero-loss/zero-dup through the swap.
+"""
+
+from fraud_detection_tpu.learn.loop import LearnConfig, LearnLoop
+from fraud_detection_tpu.learn.store import StoredRow, WindowStore
+
+__all__ = ["LearnConfig", "LearnLoop", "StoredRow", "WindowStore"]

@@ -630,6 +630,52 @@ class ServingPipeline:
         batch = self.predict([text])
         return int(batch.labels[0]), float(batch.probabilities[0])
 
+    def predict_encoded(self, ids: np.ndarray,
+                        counts: np.ndarray) -> PredictionBatch:
+        """Score ALREADY-ENCODED rows: (B, L) hashed feature ids + term
+        counts, exactly the packed form the featurizer emits and the learn
+        window retains (learn/store.py). The shadow replay path scores a
+        staged candidate on the window's rows through this — the rows'
+        text was deliberately never kept, and re-featurizing is both
+        impossible and unnecessary: padding slots (id 0, count 0) are
+        inert on every scoring path, so the stored arrays score exactly
+        as the original batch did. Rides the same dispatch entries
+        (packed upload, fused LR / encoded tree traversal) as live
+        serving; rows chunk and pad to the pipeline's compiled shapes."""
+        from fraud_detection_tpu.featurize.tfidf import EncodedBatch
+
+        ids = np.asarray(ids)
+        counts = np.asarray(counts)
+        if ids.shape != counts.shape or ids.ndim != 2:
+            raise ValueError(
+                f"ids {ids.shape} / counts {counts.shape} must be equal "
+                "2-D (B, L) arrays")
+        tree_binary = self._tree_is_binary()
+        parts: List[Tuple[object, int]] = []
+        threshold = 0.5
+        argmax = False
+        for start in range(0, ids.shape[0], self.batch_size):
+            chunk_ids = ids[start : start + self.batch_size]
+            chunk_counts = counts[start : start + self.batch_size]
+            n = chunk_ids.shape[0]
+            rows = self._pad_rows(n)
+            if rows != n:
+                chunk_ids = np.concatenate(
+                    [chunk_ids, np.zeros((rows - n, ids.shape[1]),
+                                         ids.dtype)])
+                chunk_counts = np.concatenate(
+                    [chunk_counts, np.zeros((rows - n, counts.shape[1]),
+                                            counts.dtype)])
+            enc = EncodedBatch(ids=chunk_ids, counts=chunk_counts)
+            if self._fused_model is not None:
+                parts.append((self._dispatch_fused(enc), n))
+                threshold = self._fused_model.threshold
+            else:
+                parts.append((self._dispatch_tree(enc, tree_binary), n))
+                argmax = not tree_binary
+        return PendingPrediction(parts, threshold=threshold,
+                                 argmax=argmax).resolve()
+
 
 @partial(jax.jit, static_argnames=("binary",))
 def _tree_prob_encoded(ensemble: TreeEnsemble, ids, counts, idf, binary: bool):

@@ -1027,6 +1027,146 @@ def _update_margin(margin, row_node, values):
     return margin + values[row_node]
 
 
+#: Row-count padding ladder for the windowed refresh trainer: every retrain
+#: pads its window to the smallest rung that fits, so repeated retrains of
+#: drifting window sizes reuse the SAME compiled program shapes (XLA
+#: compiles stay off the learn lane's steady state, the same bucket
+#: discipline the serving ladder applies to micro-batches).
+REFRESH_ROW_BUCKETS: Tuple[int, ...] = (512, 1024, 2048, 4096, 8192,
+                                        16384, 32768)
+
+
+def refresh_row_bucket(n: int,
+                       buckets: Tuple[int, ...] = REFRESH_ROW_BUCKETS) -> int:
+    """Smallest configured rung >= n (the top rung caps: larger windows
+    must be subsampled by the caller, never silently grown into a fresh
+    compile shape per retrain)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def refresh_gradient_boosting(
+    ensemble: TreeEnsemble, X, y, *, n_rounds: int = 8,
+    config: Optional[TreeTrainConfig] = None,
+    row_buckets: Tuple[int, ...] = REFRESH_ROW_BUCKETS,
+    sample_weight: Optional[np.ndarray] = None,
+) -> Tuple[TreeEnsemble, dict]:
+    """Warm-started incremental boosting: keep every tree of ``ensemble``
+    and fit ``n_rounds`` NEW regression trees on the recent window's
+    (grad, hess) statistics, starting from the live model's margins.
+
+    This is the learn loop's retrain primitive (learn/loop.py,
+    docs/online_learning.md): the window is small (thousands of rows), the
+    existing trees already explain the stationary part of the traffic, and
+    the new rounds only have to explain what DRIFTED — the gradients of
+    rows the live model already scores correctly are near zero, so the new
+    trees spend their splits on the drifted region. Each round rides the
+    same fused ``_boost_round`` program (device histogram kernels on TPU,
+    segment-sum elsewhere) as offline training.
+
+    Shapes are BUCKETED: the window pads (weight-0 rows) to the smallest
+    ``row_buckets`` rung that fits, so a steady retrain cadence reuses one
+    compiled program instead of compiling per window size; windows larger
+    than the top rung keep their most recent rows. Returns
+    ``(new_ensemble, info)`` — info carries the padded rung, per-round
+    shapes, and the window metadata the registry manifest records.
+    """
+    if ensemble.kind != "xgboost":
+        raise ValueError(
+            f"refresh_gradient_boosting warm-starts xgboost ensembles; got "
+            f"kind {ensemble.kind!r} (gini forests have no additive margin "
+            "to resume from — retrain those offline)")
+    cfg = resolve_config(config, None, criterion="xgb")
+    if cfg.criterion != "xgb":
+        cfg = TreeTrainConfig(**{**cfg.__dict__, "criterion": "xgb"})
+    if cfg.max_depth != ensemble.max_depth:
+        # Node-array layouts must agree for the concat below; a different
+        # depth would also silently change the candidate's latency class.
+        cfg = TreeTrainConfig(**{**cfg.__dict__,
+                                 "max_depth": ensemble.max_depth})
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    if X.ndim != 2 or X.shape[0] != y.shape[0]:
+        raise ValueError(f"X {X.shape} / y {y.shape} mismatch")
+    if X.shape[0] < 2:
+        raise ValueError("refresh needs at least 2 labeled rows")
+    bucket = refresh_row_bucket(X.shape[0], tuple(row_buckets))
+    if X.shape[0] > bucket:
+        # Over the top rung: keep the most RECENT rows (callers pass the
+        # window oldest-first) — the window semantics, made explicit.
+        X, y = X[-bucket:], y[-bucket:]
+        if sample_weight is not None:
+            sample_weight = sample_weight[-bucket:]
+    n = X.shape[0]
+    weights = (np.ones(n, np.float32) if sample_weight is None
+               else np.asarray(sample_weight, np.float32))
+
+    # Window-local quantile edges from the REAL rows (pads excluded): the
+    # new trees' thresholds come from the drifted window's distribution.
+    edges = quantile_bin_edges(X, cfg.n_bins)
+
+    pad = bucket - n
+    if pad:
+        X = np.concatenate([X, np.zeros((pad, X.shape[1]), np.float32)])
+        y = np.concatenate([y, np.zeros(pad, np.float32)])
+        weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+
+    # Warm start: the live ensemble's margins on the window (padded rows
+    # get the margin of an all-zero row — inert under weight 0).
+    from fraud_detection_tpu.models import trees as trees_mod
+
+    margin = trees_mod.predict_margin(ensemble, jnp.asarray(X))
+
+    bins = apply_bins(jnp.asarray(X), jnp.asarray(edges))
+    # Tile-align once, like _prepare_inputs (the Pallas wrapper would
+    # otherwise re-pad the matrix on every level of every round).
+    from fraud_detection_tpu.ops.histogram import FEATURE_TILE, ROW_TILE
+
+    pad_n = (-bins.shape[0]) % ROW_TILE
+    pad_f = (-bins.shape[1]) % FEATURE_TILE
+    if pad_n or pad_f:
+        bins = jnp.pad(bins, ((0, pad_n), (0, pad_f)))
+        y = np.concatenate([y, np.zeros(pad_n, np.float32)])
+        weights = np.concatenate([weights, np.zeros(pad_n, np.float32)])
+        margin = jnp.pad(margin, (0, pad_n))
+    yd = jnp.asarray(y)
+    wd = jnp.asarray(weights)
+
+    feats, sbins, lefts, rights, leaf_vals = [], [], [], [], []
+    for _ in range(n_rounds):
+        f_, b_, l_, r_, values, values2, row_leaf = _boost_round(
+            margin, bins, yd, wd, cfg)
+        margin = _update_margin(margin, row_leaf, values)
+        feats.append(f_); sbins.append(b_)
+        lefts.append(l_); rights.append(r_)
+        leaf_vals.append(values2)
+    jax.device_get(margin)  # one sync: rounds above stayed on device
+    new = _assemble(feats, sbins, lefts, rights, leaf_vals, edges,
+                    np.ones(n_rounds), "xgboost", cfg, bias=ensemble.bias)
+
+    refreshed = TreeEnsemble(
+        feature=jnp.concatenate([ensemble.feature, new.feature]),
+        threshold=jnp.concatenate([ensemble.threshold, new.threshold]),
+        left=jnp.concatenate([ensemble.left, new.left]),
+        right=jnp.concatenate([ensemble.right, new.right]),
+        leaf=jnp.concatenate([ensemble.leaf, new.leaf]),
+        tree_weights=jnp.concatenate([ensemble.tree_weights,
+                                      new.tree_weights]),
+        kind="xgboost", max_depth=ensemble.max_depth, bias=ensemble.bias)
+    info = {
+        "window_rows": n,
+        "padded_rows": bucket,
+        "rounds": n_rounds,
+        "base_trees": int(ensemble.num_trees),
+        "total_trees": int(refreshed.num_trees),
+        "n_bins": cfg.n_bins,
+        "max_depth": cfg.max_depth,
+    }
+    return refreshed, info
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def _boost_round(margin, bins, yf, weights, cfg: TreeTrainConfig):
     """One boosting round as a single program: gradients, tree build, leaf

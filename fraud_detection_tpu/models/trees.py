@@ -219,6 +219,25 @@ def predict(ensemble: TreeEnsemble, x: jax.Array) -> tuple[jax.Array, jax.Array]
     return jnp.argmax(proba, axis=-1).astype(jnp.int32), proba[..., 1]
 
 
+def predict_margin(ensemble: TreeEnsemble, x: jax.Array) -> jax.Array:
+    """(B, F) dense features -> (B,) raw boosting margin (bias + weighted
+    leaf sum) for boosted ensembles — the warm-start seed the incremental
+    refresh trainer resumes from (models/train_trees.py
+    ``refresh_gradient_boosting``). ``sigmoid(margin)`` (xgboost kind)
+    equals ``predict_proba(...)[:, 1]`` exactly; pinned in test_learn.py."""
+    if ensemble.kind not in ("gbt", "xgboost"):
+        raise ValueError(
+            f"predict_margin applies to boosted ensembles, not "
+            f"{ensemble.kind!r} (classification forests carry class "
+            "stats, not additive margins)")
+    idx = _leaf_indices(x, ensemble.feature, ensemble.threshold,
+                        ensemble.left, ensemble.right, ensemble.max_depth)
+    payload = jnp.take_along_axis(
+        ensemble.leaf[None], idx[:, :, None, None], axis=2)[:, :, 0, 0]
+    return ensemble.bias + jnp.sum(
+        payload * ensemble.tree_weights[None, :], axis=1)
+
+
 def feature_importances(ensemble_stage: TreeEnsembleStage, num_features: int) -> np.ndarray:
     """Spark-style gain-weighted feature importances (normalized to sum 1).
 

@@ -304,7 +304,8 @@ def load_rules(path: str) -> Tuple[AlertRule, ...]:
 def default_rule_pack(*, fast_s: float = 30.0, slow_s: float = 120.0,
                       for_s: float = 0.0, resolve_s: float = 10.0,
                       shed_limit: float = 0.05, dlq_limit: float = 0.02,
-                      p99_ms: float = 2000.0, stall_s: float = 10.0
+                      p99_ms: float = 2000.0, stall_s: float = 10.0,
+                      shadow_disagreement_limit: float = 0.05
                       ) -> Tuple[AlertRule, ...]:
     """The first-party pack over the engine's ``health()`` block — one rule
     per failure mode the codebase models end to end. Paths are
@@ -372,6 +373,20 @@ def default_rule_pack(*, fast_s: float = 30.0, slow_s: float = 120.0,
                   slow_s=slow_s, resolve_s=resolve_s,
                   description="commits fenced by rebalance/zombie fencing "
                               "(docs/fleet.md)"),
+        # Shadow disagreement burning: the staged candidate (or, with the
+        # learn loop, a drift-corrected retrain) diverges from the primary
+        # on RECENT traffic — a two-window burn over the shadow scorer's
+        # cumulative disagreement/row counters, so model drift is an
+        # INCIDENT even when the learn loop is disabled
+        # (docs/online_learning.md; abstains without a shadow block).
+        AlertRule("shadow_disagreement_burn", "burn_rate",
+                  num="model.shadow.disagreed", den="model.shadow.rows",
+                  op=">", limit=shadow_disagreement_limit,
+                  severity="warning", fast_s=fast_s, slow_s=slow_s,
+                  for_s=for_s, resolve_s=resolve_s, min_den=16,
+                  description="shadow candidate disagreement burning over "
+                              "recent windows — model drift "
+                              "(docs/online_learning.md)"),
         # Restart churn: the supervisor rebuilt the engine twice inside
         # the window — a crash loop, not a one-off blip. Only judgeable
         # through a chain-cumulative source (ChainedHealthSource adds the

@@ -141,7 +141,8 @@ class LifecycleController:
     def __init__(self, registry: ModelRegistry, hotswap, *,
                  shadow=None, policy: Optional[PromotionPolicy] = None,
                  batch_size: int = 256, mesh=None,
-                 health_fn: Optional[Callable[[], Optional[dict]]] = None):
+                 health_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 on_transition: Optional[Callable[[dict], None]] = None):
         self.registry = registry
         self.hotswap = hotswap
         self.shadow = shadow
@@ -149,6 +150,14 @@ class LifecycleController:
         self.batch_size = batch_size
         self.mesh = mesh
         self.health_fn = health_fn
+        # Observer hook: called with EVERY audit record this controller
+        # emits (stage/promote/reject/rollback/load_failed), synchronously
+        # on the transitioning thread — the learn loop (learn/loop.py)
+        # tracks its candidates' fates through this. Must be fast and
+        # non-reentrant (it runs inside the watch region); exceptions are
+        # swallowed with a log line — an observer must never veto or kill
+        # a lifecycle transition.
+        self.on_transition = on_transition
         # Cursor: adopt everything NEWER than the active version (a version
         # published before the watcher started must still be picked up).
         # Seeding from latest() instead would silently skip it.
@@ -169,6 +178,11 @@ class LifecycleController:
     def _audit(self, event: str, **fields) -> dict:
         record = self.registry.audit(event, **fields)
         self.events.append(record)
+        if self.on_transition is not None:
+            try:
+                self.on_transition(record)
+            except Exception as e:  # noqa: BLE001 — observers never veto
+                log.warning("lifecycle on_transition observer failed: %s", e)
         return record
 
     def tick(self) -> List[dict]:

@@ -87,14 +87,24 @@ class ShadowScorer:
     """
 
     def __init__(self, *, max_queue: int = 8, sample: float = 1.0,
-                 n_bins: int = N_BINS, clock=time.monotonic,
+                 n_bins: int = N_BINS, window_batches: int = 64,
+                 clock=time.monotonic,
                  rng: Optional[random.Random] = None):
         if not 0.0 < sample <= 1.0:
             raise ValueError(f"sample must be in (0, 1], got {sample}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if window_batches < 1:
+            raise ValueError(
+                f"window_batches must be >= 1, got {window_batches}")
         self.sample = sample
         self.n_bins = n_bins
+        # Windowed divergence (docs/online_learning.md): per-batch stat
+        # tuples for the most recent ``window_batches`` scored batches, so
+        # a long-running shadow exposes RECENT agreement/PSI beside the
+        # cumulative ones — early agreement must not mask late drift
+        # (pinned in tests/test_learn.py).
+        self.window_batches = window_batches
         self._rng = rng if rng is not None else random.Random()
         self._clock = clock
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
@@ -124,6 +134,11 @@ class ShadowScorer:
         self._sampled_out = 0
         self._primary_hist = np.zeros(self.n_bins, np.float64)
         self._candidate_hist = np.zeros(self.n_bins, np.float64)
+        # Recent-window ring: (rows, agree, |dp| sum, p_hist, c_hist) per
+        # scored batch, newest last (deque.maxlen drops the oldest).
+        from collections import deque
+
+        self._window = deque(maxlen=self.window_batches)
         self._started_at = self._clock()
 
     # ------------------------------------------------------------------
@@ -184,6 +199,28 @@ class ShadowScorer:
                 self._dropped += 1
             return False
 
+    def submit_encoded(self, ids, counts, labels, probs) -> bool:
+        """Queue a batch of ALREADY-ENCODED rows ((B, L) hashed ids + term
+        counts — the learn window's retained form, learn/store.py) for
+        candidate comparison. The worker scores them through the
+        candidate's ``predict_encoded``, so a freshly staged candidate can
+        be judged against the RECENT WINDOW immediately instead of waiting
+        for future traffic to sample — what makes warp-speed game days
+        (and fast drift response) possible. Same non-blocking bounded-
+        queue contract as ``submit``."""
+        cand = self._candidate
+        if cand is None:
+            return False
+        try:
+            self._queue.put_nowait(
+                (cand, (np.asarray(ids), np.asarray(counts)),
+                 np.asarray(labels), np.asarray(probs), "encoded", ""))
+            return True
+        except queue.Full:
+            with self._lock:
+                self._dropped += 1
+            return False
+
     # ------------------------------------------------------------------
     # worker
     # ------------------------------------------------------------------
@@ -209,6 +246,17 @@ class ShadowScorer:
         (version, pipeline), payloads, labels, probs, raw, text_field = item
         if self._candidate is None or self._candidate[0] != version:
             return  # candidate was cleared/replaced while queued: stale
+        if raw == "encoded":
+            # Window-replay batch (submit_encoded): score the candidate on
+            # the stored packed rows directly — no text exists to decode.
+            ids, counts = payloads
+            if ids.shape[0] == 0:
+                return
+            cand = pipeline.predict_encoded(ids, counts)
+            self._accumulate(version, np.asarray(labels), np.asarray(probs),
+                             np.asarray(cand.labels),
+                             np.asarray(cand.probabilities, np.float64))
+            return
         if raw:
             texts: List[str] = []
             keep: List[int] = []
@@ -230,23 +278,31 @@ class ShadowScorer:
         if not texts:
             return
         cand = pipeline.predict(texts)
-        c_labels = np.asarray(cand.labels)
-        c_probs = np.asarray(cand.probabilities, np.float64)
+        self._accumulate(version, np.asarray(labels), np.asarray(probs),
+                         np.asarray(cand.labels),
+                         np.asarray(cand.probabilities, np.float64))
+
+    def _accumulate(self, version, labels, probs, c_labels, c_probs) -> None:
+        """Fold one scored batch into the cumulative AND windowed stats
+        (shared by the live-traffic and encoded-replay paths)."""
         p_probs = np.asarray(probs, np.float64)
         p_hist = score_histogram(p_probs, self.n_bins)
         c_hist = score_histogram(c_probs, self.n_bins)
+        n = int(labels.shape[0])
+        agree = int(np.sum(c_labels == labels))
+        abs_dp = float(np.sum(np.abs(c_probs - p_probs)))
         with self._lock:
             if self._candidate is None or self._candidate[0] != version:
                 return
-            n = len(texts)
             self._batches += 1
             self._rows += n
-            self._agree += int(np.sum(c_labels == np.asarray(labels)))
-            self._abs_dp_sum += float(np.sum(np.abs(c_probs - p_probs)))
-            self._primary_flagged += int(np.sum(np.asarray(labels) != 0))
+            self._agree += agree
+            self._abs_dp_sum += abs_dp
+            self._primary_flagged += int(np.sum(labels != 0))
             self._candidate_flagged += int(np.sum(c_labels != 0))
             self._primary_hist += p_hist
             self._candidate_hist += c_hist
+            self._window.append((n, agree, abs_dp, p_hist, c_hist))
 
     # ------------------------------------------------------------------
     # observability / teardown
@@ -257,10 +313,33 @@ class ShadowScorer:
         with self._lock:
             rows = self._rows
             cand = self._candidate
+            # Windowed (recent-batch) divergence beside the cumulative
+            # stats: a month of early agreement must not mask an hour of
+            # drift (docs/online_learning.md; the learn-loop drift rules
+            # and the shadow_disagreement_burn sentinel read this).
+            w_rows = sum(t[0] for t in self._window)
+            w_agree = sum(t[1] for t in self._window)
+            w_dp = sum(t[2] for t in self._window)
+            if self._window:
+                w_p_hist = np.sum([t[3] for t in self._window], axis=0)
+                w_c_hist = np.sum([t[4] for t in self._window], axis=0)
+            else:
+                w_p_hist = w_c_hist = np.zeros(self.n_bins, np.float64)
+            window = {
+                "batches": len(self._window),
+                "max_batches": self.window_batches,
+                "rows": w_rows,
+                "agreement_rate": (w_agree / w_rows) if w_rows else None,
+                "mean_abs_dp": (w_dp / w_rows) if w_rows else None,
+                "psi": population_stability_index(w_p_hist, w_c_hist)
+                       if w_rows else None,
+            }
             snap = {
                 "candidate_version": cand[0] if cand is not None else None,
                 "batches": self._batches,
                 "rows": rows,
+                "disagreed": rows - self._agree,
+                "window": window,
                 "agreement_rate": (self._agree / rows) if rows else None,
                 "mean_abs_dp": (self._abs_dp_sum / rows) if rows else None,
                 "flag_rate_primary": (self._primary_flagged / rows) if rows else None,

@@ -25,10 +25,12 @@ from fraud_detection_tpu.scenarios.clock import ScenarioClock, derive_seed
 from fraud_detection_tpu.scenarios.gameday import (CATALOG, ChaosSpec,
                                                    ExpectedDetection,
                                                    GameDay, GameDayResult,
-                                                   KillSpec, SentinelSpec,
+                                                   KillSpec, LearnSpec,
+                                                   SentinelSpec,
                                                    get_scenario,
                                                    parse_scenario_ref,
                                                    run_gameday)
+from fraud_detection_tpu.scenarios.labels import LabelFeeder
 from fraud_detection_tpu.scenarios.record import (dump_tracer,
                                                   load_recording,
                                                   render_recording)
@@ -36,16 +38,18 @@ from fraud_detection_tpu.scenarios.replay import run_replay
 from fraud_detection_tpu.scenarios.slo import (SloReport, SloSpec, evaluate,
                                                parse_slo)
 from fraud_detection_tpu.scenarios.traffic import (CampaignWave, DiurnalLoad,
-                                                   FlashCrowd, SteadyLoad,
+                                                   DriftCampaign, FlashCrowd,
+                                                   SteadyLoad,
                                                    TimelineAction,
                                                    TrafficEvent,
                                                    TrafficFeeder, TrafficSpec,
                                                    compose, generate)
 
 __all__ = [
-    "CATALOG", "CampaignWave", "ChaosSpec", "DiurnalLoad",
+    "CATALOG", "CampaignWave", "ChaosSpec", "DiurnalLoad", "DriftCampaign",
     "ExpectedDetection", "FlashCrowd", "GameDay", "GameDayResult",
-    "KillSpec", "ScenarioClock", "SentinelSpec", "SloReport",
+    "KillSpec", "LabelFeeder", "LearnSpec", "ScenarioClock", "SentinelSpec",
+    "SloReport",
     "SloSpec", "SteadyLoad", "TimelineAction", "TrafficEvent",
     "TrafficFeeder", "TrafficSpec", "compose", "derive_seed", "dump_tracer",
     "evaluate", "generate", "get_scenario", "load_recording", "parse_slo",

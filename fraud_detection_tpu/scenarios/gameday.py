@@ -55,8 +55,10 @@ from typing import List, Optional, Sequence, Tuple
 from fraud_detection_tpu.scenarios.clock import ScenarioClock
 from fraud_detection_tpu.scenarios.slo import (SloReport, SloSpec, evaluate,
                                                parse_slo)
-from fraud_detection_tpu.scenarios.traffic import (CampaignWave, DiurnalLoad,
-                                                   FlashCrowd, SteadyLoad,
+from fraud_detection_tpu.scenarios.traffic import (CampaignWave,
+                                                   DiurnalLoad,
+                                                   DriftCampaign, FlashCrowd,
+                                                   SteadyLoad,
                                                    TimelineAction,
                                                    TrafficFeeder, TrafficSpec,
                                                    compose)
@@ -65,6 +67,7 @@ INPUT_TOPIC = "scenario-in"
 OUTPUT_TOPIC = "scenario-out"
 DLQ_TOPIC = "scenario-dlq"
 ANNOTATIONS_TOPIC = "scenario-out-annotations"
+FEEDBACK_TOPIC = "scenario-feedback"
 
 
 class FlakyExplainBackend:
@@ -157,6 +160,37 @@ class SentinelSpec:
 
 
 @dataclass(frozen=True)
+class LearnSpec:
+    """The closed learning loop, declared as scenario data
+    (learn/, docs/online_learning.md). The runner publishes the pipeline
+    as v1 in a fresh registry, wires the label lane (the
+    scenarios/labels.py ground-truth oracle feeds ``feedback_topic``),
+    runs the learn-lane beside the engine, and rides the REAL
+    ``LifecycleController`` stage→shadow→judge→promote path — ``policy``
+    is the PR 2 ``PromotionPolicy`` spec string the auto-promotion gates
+    run with (a drift-correcting candidate legitimately disagrees with
+    the drifted primary, so the drift-tuned defaults allow more
+    disagreement than a like-for-like rollout would)."""
+
+    min_labeled: int = 120          # evidence floor before any retrain
+    min_new_labels: int = 32
+    error_threshold: float = 0.12   # drift trigger (recent label error)
+    error_window: int = 256
+    refresh_rounds: int = 6
+    window: int = 8192
+    label_delay_s: float = 0.2      # virtual label latency
+    policy: str = ("min_batches=1,min_rows=128,max_disagreement=0.7,"
+                   "max_psi=50.0,max_flag_rate_delta=0.8")
+    drift_at_s: float = 0.0         # drift onset (promotion-latency origin)
+    promote_within_s: float = 60.0  # virtual drift->promotion bound
+    settle_s: float = 120.0         # wall bound for retrain+judge to land
+
+    def __post_init__(self):
+        if self.settle_s <= 0:
+            raise ValueError(f"settle_s must be > 0, got {self.settle_s}")
+
+
+@dataclass(frozen=True)
 class ChaosSpec:
     """Seeded broker-fault rates (stream/faults.py FaultPlan). The
     lethal kinds (poll errors, flush crashes) are single-engine only —
@@ -208,6 +242,13 @@ class GameDay:
     # fault class — or the zero-incident false-positive gate on the clean
     # control arm (docs/observability.md "Detection-latency gates").
     sentinel: Optional[SentinelSpec] = None
+    # The closed learning loop (learn/, docs/online_learning.md): window
+    # store + label lane + windowed retrain + auto shadow->promote
+    # through the registry lifecycle — single-engine only, and the
+    # pipeline must be a boosted-tree model (the warm-start refresh's
+    # input): ``model`` picks the demo family.
+    learn: Optional[LearnSpec] = None
+    model: str = "lr"
     lease_ttl: float = 1.0
     supervise: int = 25
     idle_timeout: float = 1.0
@@ -246,6 +287,19 @@ class GameDay:
             raise ValueError(
                 f"game day {self.name!r}: explain_slots must be >= 1, "
                 f"got {self.explain_slots}")
+        if self.learn is not None:
+            if self.fleet_mode:
+                raise ValueError(
+                    f"game day {self.name!r}: the learn loop is "
+                    "single-engine only (one registry/lifecycle per run)")
+            if self.hot_swap_at is not None:
+                raise ValueError(
+                    f"game day {self.name!r}: learn owns the hot-swap "
+                    "path (promotion IS the swap) — drop hot_swap_at")
+            if self.model != "xgb":
+                raise ValueError(
+                    f"game day {self.name!r}: the learn loop warm-starts "
+                    f"boosted trees; set model='xgb' (got {self.model!r})")
         if self.sentinel is not None and self.sentinel.expect:
             known = {r.name for r in
                      self.sentinel.resolve_rules(self.fleet_mode)}
@@ -291,13 +345,14 @@ class GameDayResult:
         return head + "\n" + self.report.table()
 
 
-def _default_pipeline(batch_size: int, seed: int = 7):
+def _default_pipeline(batch_size: int, seed: int = 7, model: str = "lr"):
     from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
 
     # Separable corpus: scenario rows are drawn from the same families,
     # so flagged-row lanes (breaker, annotation) see real pressure.
     return synthetic_demo_pipeline(
         batch_size=batch_size, n=300, seed=seed, num_features=2048,
+        model=model,
         corpus_kwargs=dict(hard_fraction=0.0, label_noise=0.0))
 
 
@@ -338,6 +393,23 @@ def _swap_setup(gd: GameDay, pipeline, clock: ScenarioClock,
     return hot, hot
 
 
+def _learn_setup(gd: GameDay, pipeline, clock: ScenarioClock):
+    """Registry-backed serving for the learn loop: the pipeline publishes
+    as v1 in a fresh registry and every worker scores through ONE
+    HotSwapPipeline — promotion IS the run's zero-downtime hot swap."""
+    import tempfile
+
+    from fraud_detection_tpu.registry import ModelRegistry
+    from fraud_detection_tpu.registry.hotswap import HotSwapPipeline
+
+    root = tempfile.mkdtemp(prefix="gameday-registry-")
+    registry = ModelRegistry(root)
+    registry.publish(pipeline.featurizer, pipeline.model,
+                     metrics={"origin": f"gameday:{gd.name}:v1"})
+    hot = HotSwapPipeline(pipeline, version=1)
+    return hot, hot, {"registry": registry, "root": root}
+
+
 def _wait_for_feed(feeder: TrafficFeeder, n: int, timeout: float = 30.0):
     """Block until the feeder has produced ``n`` rows (or finished/died):
     workers idle-exit on an empty topic, so traffic must visibly exist
@@ -363,8 +435,11 @@ def run_gameday(gd: GameDay, *, pipeline=None, time_scale: float = 0.0,
         raise ValueError(f"game day {gd.name!r} generated zero rows")
     actions: List[TimelineAction] = []
     if pipeline is None:
-        pipeline = _default_pipeline(gd.batch_size)
+        pipeline = _default_pipeline(gd.batch_size, model=gd.model)
     serving, hot = _swap_setup(gd, pipeline, clock, actions)
+    learn_ctx = None
+    if gd.learn is not None:
+        serving, hot, learn_ctx = _learn_setup(gd, pipeline, clock)
     broker = InProcessBroker(num_partitions=gd.partitions)
     feeder = TrafficFeeder(broker.producer(), INPUT_TOPIC, events, clock,
                            actions=actions)
@@ -374,7 +449,8 @@ def run_gameday(gd: GameDay, *, pipeline=None, time_scale: float = 0.0,
     if gd.fleet_mode:
         evidence = _run_fleet(gd, serving, broker, feeder, plan, clock)
     else:
-        evidence = _run_single(gd, serving, broker, feeder, plan, clock)
+        evidence = _run_single(gd, serving, broker, feeder, plan, clock,
+                               learn_ctx)
     wall = time.perf_counter() - t0
 
     evidence.update({
@@ -507,7 +583,7 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
 
 
 def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
-                plan, clock: ScenarioClock) -> dict:
+                plan, clock: ScenarioClock, learn_ctx=None) -> dict:
     from fraud_detection_tpu.obs.trace import RowTracer
     from fraud_detection_tpu.stream.engine import (StreamingClassifier,
                                                    run_supervised)
@@ -559,6 +635,48 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
 
     dlq_attempts: dict = {}
     engines: list = []
+
+    # The closed learning loop (learn/, docs/online_learning.md): label
+    # oracle -> feedback topic -> learn-lane window joins -> windowed
+    # warm-started retrain -> registry publish -> the REAL
+    # LifecycleController stages, shadow-judges, and auto-promotes.
+    learn_loop = None
+    shadow = None
+    controller = None
+    label_feeder = None
+    watch_stop = None
+    watch_thread = None
+    if learn_ctx is not None:
+        from fraud_detection_tpu.learn import LearnConfig, LearnLoop
+        from fraud_detection_tpu.registry import (LifecycleController,
+                                                  PromotionPolicy,
+                                                  ShadowScorer)
+        from fraud_detection_tpu.scenarios.labels import LabelFeeder
+
+        ls = gd.learn
+        shadow = ShadowScorer(max_queue=64, sample=1.0, window_batches=32)
+        learn_loop = LearnLoop(
+            feedback_consumer=broker.consumer([FEEDBACK_TOPIC], "learn"),
+            registry=learn_ctx["registry"], hotswap=serving, shadow=shadow,
+            config=LearnConfig(
+                window=ls.window, min_labeled=ls.min_labeled,
+                min_new_labels=ls.min_new_labels,
+                error_threshold=ls.error_threshold,
+                error_window=ls.error_window,
+                refresh_rounds=ls.refresh_rounds, cooldown_s=1.0),
+            now_fn=clock.now)
+        controller = LifecycleController(
+            learn_ctx["registry"], serving, shadow=shadow,
+            policy=PromotionPolicy.parse(ls.policy),
+            batch_size=gd.batch_size,
+            health_fn=lambda: (engines[-1].health() if engines else None),
+            on_transition=learn_loop.on_transition)
+        learn_loop.bind_controller(controller)
+        label_feeder = LabelFeeder(
+            broker.consumer([INPUT_TOPIC], "scenario-labels"),
+            broker.producer(), FEEDBACK_TOPIC, clock=clock,
+            delay_s=ls.label_delay_s).start()
+        watch_thread, watch_stop = controller.run_in_thread(interval=0.05)
 
     # The watchdog (obs/sentinel/): ONE sentinel shared across the
     # supervised incarnation chain (like the tracer and the poison
@@ -616,7 +734,8 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
             annotations_queue=gd.explain_queue,
             explain_service=explain_service,
             dlq_topic=dlq_topic, dlq_attempts=dlq_attempts,
-            scheduler=scheduler, rowtrace=tracer, sentinel=sentinel)
+            scheduler=scheduler, rowtrace=tracer, sentinel=sentinel,
+            shadow=shadow, learn=learn_loop)
         engines.append(engine)
         if sentinel_source is not None:
             sentinel_source.attach(engine)
@@ -655,6 +774,11 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
                 and broker.group_lag("gameday", [INPUT_TOPIC]) <= 0):
             break
     feeder.join(timeout=120.0)
+    learn_out: Optional[dict] = None
+    if learn_ctx is not None:
+        learn_out = _settle_learn(gd, broker, learn_loop, shadow,
+                                  controller, label_feeder, watch_stop,
+                                  watch_thread, serving, learn_ctx)
     # Stop the watchdog with a FINAL evaluation pass, so a condition that
     # only became judgeable at the very end of the drain still transitions
     # before the verdict reads the snapshot.
@@ -675,7 +799,7 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
                           + annotations["drop_records"])
                          / max(1, annotations["submitted"]), 6)
     health = engines[-1].health() if engines else {}
-    return {
+    out = {
         "stats": total.as_dict(),
         "health": health,
         "sched": scheduler.snapshot() if scheduler is not None else None,
@@ -694,6 +818,80 @@ def _run_single(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         "traces": [tracer.snapshot()],
         "alerts": sentinel.snapshot() if sentinel is not None else None,
         "errors": errors,
+    }
+    if learn_out is not None:
+        out.update(learn_out)
+    return out
+
+
+def _settle_learn(gd: GameDay, broker, learn_loop, shadow, controller,
+                  label_feeder, watch_stop, watch_thread, serving,
+                  learn_ctx) -> dict:
+    """Bounded post-traffic drain of the closed loop: let the label oracle
+    catch up with the input topic, the lane consume its queues, the
+    windowed retrain land, and the controller judge the candidate — then
+    stop every learn-side thread and assemble the verdict evidence. A run
+    whose policy refuses promotion converges here too (the state goes
+    stable without a promote), so the negative CI arm terminates fast
+    instead of burning the whole settle budget."""
+    ls = gd.learn
+    deadline = time.monotonic() + ls.settle_s
+    # The ground-truth oracle must see every input row before it stops.
+    while time.monotonic() < deadline and \
+            broker.group_lag("scenario-labels", [INPUT_TOPIC]) > 0 and \
+            label_feeder.error is None:
+        time.sleep(0.02)
+    time.sleep(0.05)           # let the last due labels produce
+    label_feeder.join(timeout=30.0)
+    stable = None
+    stable_since = time.monotonic()
+    while time.monotonic() < deadline:
+        snap = learn_loop.snapshot()
+        staged = serving.staged_version
+        state = (snap["published"], snap["promoted"], snap["rejected"],
+                 snap["rolled_back"], snap["in_flight"], staged,
+                 shadow.snapshot()["rows"])
+        if snap["promoted"] >= 1 and staged is None \
+                and not snap["in_flight"]:
+            break
+        if state != stable:
+            stable, stable_since = state, time.monotonic()
+        elif (time.monotonic() - stable_since > 6.0
+              and not snap["in_flight"] and snap["queue_depth"] == 0
+              and broker.group_lag("learn", [FEEDBACK_TOPIC]) <= 0):
+            break   # converged without a promotion (e.g. policy refused)
+        time.sleep(0.05)
+    if watch_stop is not None:
+        watch_stop.set()
+        watch_thread.join(timeout=10.0)
+    learn_loop.close(timeout=120.0)
+    shadow.close(timeout=30.0)
+    snap = learn_loop.snapshot()
+    events = list(controller.events)
+    staged_versions = {e.get("version") for e in events
+                       if e.get("event") == "stage"}
+    judged = sum(1 for e in events if e.get("event") in
+                 ("promote", "reject", "rollback"))
+    audit_ok = (set(snap["published_versions"]) <= staged_versions
+                and (not snap["published_versions"] or judged >= 1)
+                and len(learn_ctx["registry"].read_audit()) >= len(events))
+    promoted_at = snap["promoted_at_s"]
+    latency = (round(promoted_at - ls.drift_at_s, 3)
+               if promoted_at is not None else None)
+    return {
+        "learn": snap,
+        "labels": label_feeder.stats(),
+        "lifecycle": {
+            "events": [{k: e.get(k) for k in ("event", "version",
+                                              "reasons")}
+                       for e in events],
+            "active_version": serving.active_version,
+            "staged_version": serving.staged_version,
+            "swaps": serving.swaps,
+            "audit_ok": audit_ok,
+        },
+        "learn_promotion_latency_s": latency,
+        "registry_root": learn_ctx["root"],
     }
 
 
@@ -790,7 +988,12 @@ def _campaign_kill_swap(seed: int, scale: float) -> GameDay:
         kills=KillSpec(kills=1, modes=("graceful", "crash"), min_polls=2,
                        max_polls=6),
         hot_swap_at=1.2,
-        lease_ttl=0.8,
+        # Short lease: a crash-mode kill is only OBSERVED at lease
+        # expiry, and the worker_absence while-gate needs committed work
+        # to remain at that instant — on a fast host a warp-fed run can
+        # otherwise drain past the blind spot before the expiry lands
+        # (the row count below sizes the drain for the same reason).
+        lease_ttl=0.5,
         # The fleet watchdog must see the kill: membership shrank while
         # committed work remained (the while-gate separates the death
         # from the clean drain exit). Kill timing is poll-count-seeded,
@@ -799,10 +1002,10 @@ def _campaign_kill_swap(seed: int, scale: float) -> GameDay:
             ExpectedDetection("worker_absence", fault_at_s=0.0,
                               within_s=60.0),)),
         traffic=(
-            SteadyLoad(name="baseline", rate=200 * scale, duration_s=3.0,
+            SteadyLoad(name="baseline", rate=260 * scale, duration_s=4.0,
                        scam_fraction=0.15),
-            CampaignWave(name="campaign", at_s=0.6, duration_s=2.4,
-                         wave_rate=700 * scale, waves=2, wave_s=0.6,
+            CampaignWave(name="campaign", at_s=0.6, duration_s=2.9,
+                         wave_rate=900 * scale, waves=2, wave_s=0.7,
                          gap_s=0.5),
         ),
         slos=(
@@ -850,6 +1053,79 @@ def _campaign_explain(seed: int, scale: float) -> GameDay:
                     op="==", limit=True),
             SloSpec("explain_p99_ms", path="explain.latency_ms.p99",
                     op="<=", limit=60000.0),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+def _drift_shift(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="drift_shift",
+        description="THE closed-loop game day: a novel-vocabulary fraud "
+                    "campaign the live model scores benign hits mid-run; "
+                    "delayed ground-truth labels join the learn window, "
+                    "the drift trigger fires a warm-started retrain, the "
+                    "candidate publishes, shadow-scores, and "
+                    "auto-promotes through the PSI/agreement/health "
+                    "gates — with exact join accounting and "
+                    "zero-loss/zero-dup through the hot swap.",
+        seed=seed,
+        model="xgb",
+        batch_size=128,
+        traffic=(
+            SteadyLoad(name="baseline", rate=140 * scale, duration_s=4.0,
+                       scam_fraction=0.15, emit_truth=True),
+            DriftCampaign(name="drift", at_s=1.0, duration_s=3.0,
+                          wave_rate=500 * scale, waves=2, wave_s=0.8,
+                          gap_s=0.4),
+        ),
+        learn=LearnSpec(min_labeled=96, min_new_labels=24,
+                        error_threshold=0.12, error_window=256,
+                        refresh_rounds=6, label_delay_s=0.2,
+                        drift_at_s=1.0, promote_within_s=60.0),
+        # Drift becomes an INCIDENT through the shadow lane: once the
+        # drift-corrected candidate stages, its disagreement with the
+        # drifted primary burns both sentinel windows.
+        sentinel=SentinelSpec(expect=(
+            ExpectedDetection("shadow_disagreement_burn", fault_at_s=1.0,
+                              within_s=60.0),)),
+        # The learn-evidence gates are scope="gameday": only the full
+        # game-day runner wires the label oracle + learn lane (a bare
+        # `serve --scenario drift_shift` replays the traffic shape and
+        # honestly skips them).
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            # Drift was REAL: the primary's label-error rate on the
+            # joined window shows the live model was wrong about recent
+            # ground truth.
+            SloSpec("drift_was_real",
+                    path="learn.primary_window_error_rate", op=">=",
+                    limit=0.08, scope="gameday"),
+            SloSpec("retrain_published", path="learn.published", op=">=",
+                    limit=1, scope="gameday"),
+            SloSpec("auto_promoted", path="learn.promoted", op=">=",
+                    limit=1, scope="gameday"),
+            SloSpec("promotion_within_s",
+                    path="learn_promotion_latency_s", op="<=",
+                    limit=60.0, scope="gameday"),
+            # Exact label-join accounting: joined + expired + missed +
+            # pending == labels_seen, and labels actually joined.
+            SloSpec("join_accounting_exact",
+                    path="learn.window.accounting_exact", op="==",
+                    limit=True, scope="gameday"),
+            SloSpec("labels_joined_bit", path="learn.window.joined",
+                    op=">=", limit=1, scope="gameday"),
+            # Post-promotion agreement recovery: the promoted candidate
+            # agrees with ground truth on the very window the primary
+            # failed (its label-error rate collapses).
+            SloSpec("agreement_recovery",
+                    path="learn.candidate_window_error_rate", op="<=",
+                    limit=0.1, scope="gameday"),
+            # The promotion landed as a zero-downtime swap, fully audited.
+            SloSpec("hot_swap_landed", path="swaps", op=">=", limit=1,
+                    scope="gameday"),
+            SloSpec("lifecycle_audited", path="lifecycle.audit_ok",
+                    op="==", limit=True, scope="gameday"),
             SloSpec("spans_exact", kind="spans_exact"),
             SloSpec("no_errors", kind="no_errors"),
         ))
@@ -927,6 +1203,7 @@ CATALOG: dict = {
     "campaign_kill_swap": _campaign_kill_swap,
     "chaos_storm": _chaos_storm,
     "diurnal_hotkey": _diurnal_hotkey,
+    "drift_shift": _drift_shift,
 }
 
 
@@ -969,6 +1246,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--slo", action="append", default=[], metavar="EXPR",
                     help="extra gate, e.g. 'stats.p99_batch_latency_sec"
                          "<=0.5' or a builtin name; repeatable")
+    ap.add_argument("--learn-policy", default=None, metavar="SPEC",
+                    help="override a learn scenario's PromotionPolicy "
+                         "spec (registry/promote.py parse syntax) — the "
+                         "CI learn-smoke proves an impossible policy "
+                         "REFUSES promotion and fails the gate")
     ap.add_argument("--json", action="store_true",
                     help="print only the machine-readable verdict line")
     ap.add_argument("--list", action="store_true",
@@ -984,6 +1266,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         extra = tuple(parse_slo(e) for e in args.slo)
         gd = get_scenario(args.name, args.seed, scale=args.scale)
+        if args.learn_policy is not None:
+            if gd.learn is None:
+                raise ValueError(
+                    f"--learn-policy: scenario {args.name!r} declares no "
+                    "learn loop")
+            import dataclasses
+
+            from fraud_detection_tpu.registry import PromotionPolicy
+
+            PromotionPolicy.parse(args.learn_policy)   # validate early
+            gd = dataclasses.replace(
+                gd, learn=dataclasses.replace(gd.learn,
+                                              policy=args.learn_policy))
     except (KeyError, ValueError) as e:
         raise SystemExit(str(e))
     result = run_gameday(gd, time_scale=args.time_scale, extra_slos=extra)

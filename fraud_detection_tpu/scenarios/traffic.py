@@ -78,6 +78,11 @@ class TrafficSpec:
     scam_fraction: float = 0.3
     hot_fraction: float = 0.0
     hot_keys: int = 4
+    # Ground-truth oracle field (docs/online_learning.md): when set, each
+    # payload carries ``"truth": 0|1`` — what the scenario label feeder
+    # (scenarios/labels.py) turns into delayed feedback records. OFF by
+    # default so every existing spec's payload bytes are unchanged.
+    emit_truth: bool = False
 
     def __post_init__(self):
         if self.duration_s <= 0:
@@ -176,6 +181,83 @@ class CampaignWave(TrafficSpec):
         return self.wave_rate if (rel_t % stride) < self.wave_s else 0.0
 
 
+@dataclass(frozen=True)
+class DriftCampaign(TrafficSpec):
+    """A NOVEL-vocabulary fraud campaign: burst shape like
+    :class:`CampaignWave`, but scam rows draw from a drifted text family
+    (:func:`drift_scam_pool` — crypto-wallet/airdrop templates sharing no
+    scam marker with the classic phone-scam corpus the serving model
+    trained on). The live model scores these benign; only the delayed
+    ground-truth labels reveal them — exactly the campaign-drift shape the
+    closed learning loop (learn/, docs/online_learning.md) exists to
+    catch. ``emit_truth`` defaults ON: a drift scenario without its label
+    oracle is undetectable by construction."""
+
+    wave_rate: float = 400.0
+    waves: int = 2
+    wave_s: float = 0.8
+    gap_s: float = 0.6
+    scam_fraction: float = 0.9
+    hot_fraction: float = 0.5
+    hot_keys: int = 3
+    emit_truth: bool = True
+
+    def rate_at(self, rel_t: float) -> float:
+        stride = self.wave_s + self.gap_s
+        if rel_t >= self.waves * stride:
+            return 0.0
+        return self.wave_rate if (rel_t % stride) < self.wave_s else 0.0
+
+
+# Drifted scam asks: a ROUTINE legitimate call transcript (the classic
+# corpus's own legit family) with a crypto-wallet ask spliced mid-call —
+# the appointment-pivot shape, drifted to a vocabulary ("wallet", "seed
+# phrase", "airdrop", "staking", ...) that occurs in NEITHER classic
+# family (data/synthetic.py). A model trained on the classic corpus reads
+# the legit register and scores these benign; only the delayed
+# ground-truth labels reveal the campaign. The loud classic markers
+# (urgent/suspended/gift cards/fees/verify) are deliberately absent.
+_DRIFT_ASKS = [
+    "Agent: While I have you, the airdrop is ready for pickup — please "
+    "connect your wallet and spell out the seed phrase so I can finish "
+    "the setup.\nCustomer: Okay, let me open the wallet app now.",
+    "Agent: One more thing, your staking rewards are scheduled — just "
+    "share the recovery words and we will move them over for you.\n"
+    "Customer: Sure, the twelve words are written on my card.",
+    "Agent: Also, the nft drop closes tonight — simply approve the "
+    "smart contract and tell me the passphrase while we are on the "
+    "line.\nCustomer: Alright, reading the passphrase now.",
+    "Agent: By the way, we migrated the exchange this week — kindly "
+    "sync your hardware wallet and share the recovery words with me.\n"
+    "Customer: Okay, syncing the hardware wallet now.",
+    "Agent: And the validator rebate is waiting — please open the "
+    "wallet app and tell me the twelve seed words so I can finish it "
+    "for you.\nCustomer: One moment, opening the app.",
+]
+
+
+def drift_scam_pool(seed: int, n: int = 64) -> List[str]:
+    """Seeded drifted-scam texts (deterministic: same seed, same pool):
+    legit-family transcripts with one crypto ask spliced mid-call."""
+    import random as _random
+
+    from fraud_detection_tpu.data import generate_corpus
+
+    rng = _random.Random(derive_seed(seed, "drift-pool"))
+    corpus = generate_corpus(n=2 * n + 32,
+                             seed=derive_seed(seed, "drift-base"),
+                             hard_fraction=0.0, label_noise=0.0)
+    legit = [d.text for d in corpus if d.label == 0]
+    out = []
+    for i in range(n):
+        base = legit[rng.randrange(len(legit))]
+        lines = base.split("\n")
+        mid = max(1, len(lines) // 2)
+        ask = _DRIFT_ASKS[rng.randrange(len(_DRIFT_ASKS))]
+        out.append("\n".join(lines[:mid] + [ask] + lines[mid:]))
+    return out
+
+
 def _text_pools(seed: int) -> Tuple[List[str], List[str]]:
     """(legit, scam) text pools from the synthetic corpus families —
     separable variants (hard_fraction=0) so campaign rows actually flag."""
@@ -198,6 +280,10 @@ def generate(spec: TrafficSpec, seed: int) -> List[TrafficEvent]:
 
     rng = _random.Random(rng_seed)
     legit_pool, scam_pool = _text_pools(derive_seed(rng_seed, "texts"))
+    if isinstance(spec, DriftCampaign):
+        # Drifted campaigns draw scam rows from the novel-vocabulary pool
+        # the serving model never trained on (see DriftCampaign).
+        scam_pool = drift_scam_pool(derive_seed(rng_seed, "texts"))
     events: List[TrafficEvent] = []
     acc = 0.0
     seq = 0
@@ -217,10 +303,11 @@ def generate(spec: TrafficSpec, seed: int) -> List[TrafficEvent]:
                 key = f"{spec.name}-hot{rng.randrange(spec.hot_keys)}"
             else:
                 key = f"{spec.name}-{seq}"
-            value = json.dumps(
-                {"text": text, "id": f"{spec.name}-{seq}",
-                 "scenario": spec.name},
-                sort_keys=True).encode()
+            payload = {"text": text, "id": f"{spec.name}-{seq}",
+                       "scenario": spec.name}
+            if spec.emit_truth:
+                payload["truth"] = 1 if scam else 0
+            value = json.dumps(payload, sort_keys=True).encode()
             events.append(TrafficEvent(round(t, 6), value, key.encode(),
                                        "scam" if scam else "legit"))
             seq += 1

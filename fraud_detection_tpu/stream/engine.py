@@ -241,6 +241,7 @@ class StreamingClassifier:
         breaker: Optional[object] = None,
         explain_service: Optional[object] = None,
         shadow: Optional[object] = None,
+        learn: Optional[object] = None,
         scheduler: Optional[object] = None,
         async_dispatch: bool = False,
         rowtrace: Optional[object] = None,
@@ -374,6 +375,13 @@ class StreamingClassifier:
         # pays one ``wants()`` gate per batch while a candidate is staged,
         # nothing when idle.
         self._shadow = shadow
+        # Optional learn.LearnLoop (docs/online_learning.md): each scored
+        # batch's source coordinates + payload references + primary
+        # results are offered to the closed-loop learner's bounded queue
+        # (non-blocking, drop + count on overflow — the ShadowScorer
+        # contract). Decode/encode/windowing all happen on the learn-lane
+        # thread; the hot loop pays one ``wants()`` gate per batch.
+        self._learn = learn
         # Optional obs.sentinel.Sentinel (anything with ``snapshot()``):
         # the alerting engine watching this worker. Same contract as the
         # breaker — health() surfaces its alert/incident block; evaluation
@@ -626,6 +634,9 @@ class StreamingClassifier:
         if preds is not None and self._shadow is not None:
             self._submit_shadow(inflight, preds)
 
+        if preds is not None and self._learn is not None:
+            self._submit_learn(inflight, preds)
+
         if inflight.splice is not None and preds is not None:
             wires = self._assemble_frames_native(inflight, preds)
             return self._deliver(inflight, wires, t1)
@@ -801,6 +812,32 @@ class StreamingClassifier:
         sh.submit(payloads, labels, probs, raw=inflight.raw,
                   text_field=self.text_field)
 
+    def _submit_learn(self, inflight: "_InFlight", preds) -> None:
+        """Offer this batch's valid rows + primary results to the learn
+        loop's window (learn/loop.py). Non-blocking by contract (bounded
+        queue, drop + count on overflow); payloads are REFERENCES —
+        decode/encode happen on the learn lane, never here. Host
+        conversion is batched (FC203), like every per-row loop on this
+        path."""
+        lr = self._learn
+        if not lr.wants():
+            return
+        valid = inflight.valid_idx
+        if not valid:
+            return
+        msgs = inflight.msgs
+        coords = [(msgs[i].topic, msgs[i].partition, msgs[i].offset)
+                  for i in valid]
+        if inflight.raw:
+            payloads = [msgs[i].value for i in valid]
+            labels = np.asarray(preds.labels)[valid]
+            probs = np.asarray(preds.probabilities)[valid]
+        else:
+            payloads = [inflight.texts[i] for i in valid]
+            labels, probs = preds.labels, preds.probabilities
+        lr.submit(coords, payloads, labels, probs, raw=inflight.raw,
+                  version=getattr(self.pipeline, "active_version", None))
+
     def _dead_letter(self, inflight: "_InFlight", msg: Message, reason: str,
                      error: str, attempts: Optional[int] = None) -> None:
         """Divert one row to the DLQ: its record rides THIS batch's delivery
@@ -910,6 +947,13 @@ class StreamingClassifier:
                         and hasattr(explain_service, "snapshot")
                         else None),
             "model": model,
+            # Closed-loop learning (learn/, docs/online_learning.md):
+            # window/join accounting, retrain triggers, published and
+            # promoted candidate versions.
+            "learn": (self._learn.snapshot()
+                      if self._learn is not None
+                      and hasattr(self._learn, "snapshot")
+                      else None),
             # Row-tracing accounting (obs/trace.py): span begun/ended
             # counters, ring depth/drops, per-stage latency quantiles.
             "trace": (self._rowtrace.snapshot()

@@ -59,6 +59,7 @@ INSTRUMENTED_REGIONS = frozenset({
     "ShadowScorer.worker",           # shadow-scoring worker (one thread)
     "LifecycleController.watch",     # hot-swap watch thread tick/rollback
     "FleetWorker.run",               # one thread drives a worker's engines
+    "LearnLoop.lane",                # closed-loop learn-lane worker
 })
 
 

@@ -1,0 +1,763 @@
+"""Closed-loop online learning (learn/, docs/online_learning.md).
+
+Covers the four Driftloop pieces end to end: the window store's exact
+label-join accounting (unit + hypothesis property), the warm-started
+boosted-tree refresh (margin parity, bucketed shapes, drift actually
+learned), the learn-lane loop (ingest -> join -> trigger -> publish ->
+shadow replay -> promote through the REAL LifecycleController), the shadow
+scorer's windowed divergence + encoded replay, the sentinel's
+shadow_disagreement_burn rule, and the seeded ``drift_shift`` game day —
+plus the FC301 health-schema contracts and the flightcheck thread
+registrations.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.learn import LearnConfig, LearnLoop, WindowStore
+from fraud_detection_tpu.stream.feedback import label_record, parse_label
+
+pytestmark = pytest.mark.learn
+
+IN = "learn-in"
+
+
+# ---------------------------------------------------------------------------
+# feedback record format
+# ---------------------------------------------------------------------------
+
+def test_label_record_roundtrip():
+    rec = parse_label(label_record("t", 3, 41, 1))
+    assert rec is not None
+    assert rec.key == ("t", 3, 41)
+    assert rec.label == 1
+
+
+@pytest.mark.parametrize("raw", [
+    b"not json",
+    b"[1, 2]",
+    b'{"label": 1}',                                     # no source
+    b'{"source": {"topic": "t", "partition": 0}, "label": 1}',  # no offset
+    b'{"source": {"topic": "t", "partition": 0, "offset": 1}, "label": "x"}',
+    b'{"source": {"topic": "t", "partition": 0, "offset": 1}, "label": true}',
+    b'{"source": {"topic": "t", "partition": "0", "offset": 1}, "label": 1}',
+])
+def test_label_record_malformed_returns_none(raw):
+    assert parse_label(raw) is None
+
+
+# ---------------------------------------------------------------------------
+# window store
+# ---------------------------------------------------------------------------
+
+def _row(i, partition=0, topic="in"):
+    return ((topic, partition, i), np.array([i % 7], np.int16),
+            np.array([1], np.uint16))
+
+
+def _invariant(snap):
+    return (snap["joined"] + snap["expired"] + snap["missed"]
+            + snap["pending_labels"] == snap["labels_seen"])
+
+
+def test_store_join_and_accounting():
+    store = WindowStore(capacity=100)
+    for i in range(10):
+        key, ids, counts = _row(i)
+        store.insert(key, ids, counts, pred_label=0, prob=0.1, version=1)
+    assert store.join(("in", 0, 3), 1) == "joined"
+    assert store.join(("in", 0, 3), 0) == "joined"   # latest verdict wins
+    assert store.join(("in", 0, 99), 1) == "pending"  # row not seen yet
+    snap = store.snapshot()
+    assert snap["rows"] == 10 and snap["labeled"] == 1
+    assert snap["joined"] == 2 and snap["pending_labels"] == 1
+    assert _invariant(snap) and snap["accounting_exact"]
+    labeled = store.labeled_rows()
+    assert len(labeled) == 1 and labeled[0].label == 0
+
+
+def test_store_pending_label_joins_when_row_arrives():
+    store = WindowStore(capacity=100)
+    assert store.join(("in", 0, 5), 1) == "pending"
+    key, ids, counts = _row(5)
+    store.insert(key, ids, counts, pred_label=0, prob=0.2, version=1)
+    snap = store.snapshot()
+    assert snap["joined"] == 1 and snap["pending_labels"] == 0
+    assert snap["labeled"] == 1 and _invariant(snap)
+    assert store.labeled_rows()[0].label == 1
+
+
+def test_store_capacity_eviction_classifies_expired():
+    store = WindowStore(capacity=4)
+    for i in range(8):
+        key, ids, counts = _row(i)
+        store.insert(key, ids, counts, 0, 0.1, 1)
+    assert len(store) == 4
+    snap = store.snapshot()
+    assert snap["evicted"] == 4
+    # A label for an evicted row is EXPIRED (we had it, the window moved
+    # on); a label for a never-seen offset beyond the watermark pends.
+    assert store.join(("in", 0, 1), 1) == "expired"
+    assert store.join(("in", 0, 100), 1) == "pending"
+    assert _invariant(store.snapshot())
+
+
+def test_store_age_eviction_and_pending_ageout():
+    t = {"now": 0.0}
+    store = WindowStore(capacity=100, max_age_s=10.0, clock=lambda: t["now"])
+    key, ids, counts = _row(0)
+    store.insert(key, ids, counts, 0, 0.1, 1)
+    store.join(("in", 0, 50), 1)        # pending, stamped t=0
+    t["now"] = 11.0
+    store.sweep()
+    snap = store.snapshot()
+    assert snap["rows"] == 0 and snap["evicted"] == 1
+    assert snap["pending_labels"] == 0 and snap["missed"] == 1
+    assert _invariant(snap)
+    # Late label for the aged-out row: expired, not missed.
+    assert store.join(("in", 0, 0), 1) == "expired"
+
+
+def test_store_duplicate_insert_keeps_label():
+    store = WindowStore(capacity=100)
+    key, ids, counts = _row(7)
+    store.insert(key, ids, counts, 0, 0.1, 1)
+    store.join(key, 1)
+    store.insert(key, ids, counts, 0, 0.1, 1)   # at-least-once replay
+    snap = store.snapshot()
+    assert snap["labeled"] == 1 and snap["rows"] == 1
+    assert store.labeled_rows()[0].label == 1
+
+
+def test_store_error_stats_by_version():
+    store = WindowStore(capacity=100)
+    for i in range(6):
+        key, ids, counts = _row(i)
+        store.insert(key, ids, counts, pred_label=0, prob=0.1,
+                     version=1 if i < 4 else 2)
+        store.join(key, 1 if i < 4 else 0)   # v1 rows all wrong, v2 right
+    labeled, errors = store.error_stats()
+    assert (labeled, errors) == (6, 4)
+    by_v = store.error_by_version()
+    assert by_v["1"]["error_rate"] == 1.0
+    assert by_v["2"]["error_rate"] == 0.0
+
+
+def test_store_property_join_accounting():
+    """Hypothesis property: ANY interleaving of inserts, joins, and
+    sweeps keeps the label-accounting invariant exact and the bounds
+    honored (the ISSUE's pinned invariant)."""
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    ops = st.lists(st.tuples(st.sampled_from(["insert", "join", "sweep"]),
+                             st.integers(0, 30)), max_size=120)
+
+    @settings(max_examples=60, deadline=None)
+    @given(ops=ops, capacity=st.integers(1, 8))
+    def check(ops, capacity):
+        t = {"now": 0.0}
+        store = WindowStore(capacity=capacity, max_age_s=5.0,
+                            clock=lambda: t["now"])
+        for op, i in ops:
+            t["now"] += 0.5
+            if op == "insert":
+                key, ids, counts = _row(i)
+                store.insert(key, ids, counts, i % 2, 0.5, 1)
+            elif op == "join":
+                store.join(("in", 0, i), i % 2)
+            else:
+                store.sweep()
+            snap = store.snapshot()
+            assert _invariant(snap), snap
+            assert snap["rows"] <= capacity
+            assert snap["labeled"] <= snap["rows"]
+            assert snap["pending_labels"] <= capacity
+
+    check()
+
+
+# ---------------------------------------------------------------------------
+# warm-start refresh trainer
+# ---------------------------------------------------------------------------
+
+def _separable(n, seed, drift=False):
+    """Synthetic dense rows: feature 0 => scam, feature 1 => legit; the
+    DRIFT regime moves the scam signal to feature 2 (unseen by the base
+    model)."""
+    rng = np.random.default_rng(seed)
+    X = np.zeros((n, 8), np.float32)
+    y = (np.arange(n) % 2).astype(np.float32)
+    noise = rng.uniform(0.0, 0.1, (n, 8)).astype(np.float32)
+    X += noise
+    scam_col = 2 if drift else 0
+    X[y == 1, scam_col] += 3.0
+    X[y == 0, 1] += 3.0
+    return X, y
+
+
+def test_predict_margin_matches_proba():
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.train_trees import fit_gradient_boosting
+
+    X, y = _separable(128, 0)
+    model = fit_gradient_boosting(X, y, n_rounds=4)
+    margin = np.asarray(trees_mod.predict_margin(model, X))
+    proba = np.asarray(trees_mod.predict_proba(model, X))[:, 1]
+    assert np.allclose(1.0 / (1.0 + np.exp(-margin)), proba, atol=1e-6)
+
+
+def test_predict_margin_rejects_non_boosted():
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.train_trees import fit_decision_tree
+
+    X, y = _separable(64, 1)
+    dt = fit_decision_tree(X, y)
+    with pytest.raises(ValueError, match="boosted"):
+        trees_mod.predict_margin(dt, X)
+
+
+def test_refresh_rejects_non_xgb():
+    from fraud_detection_tpu.models.train_trees import (
+        fit_random_forest, refresh_gradient_boosting)
+
+    X, y = _separable(64, 2)
+    rf = fit_random_forest(X, y, n_trees=3)
+    with pytest.raises(ValueError, match="xgboost"):
+        refresh_gradient_boosting(rf, X, y)
+
+
+def test_refresh_learns_drift_and_keeps_base_behavior():
+    from fraud_detection_tpu.models import trees as trees_mod
+    from fraud_detection_tpu.models.train_trees import (
+        fit_gradient_boosting, refresh_gradient_boosting)
+
+    X0, y0 = _separable(256, 3)
+    base = fit_gradient_boosting(X0, y0, n_rounds=6)
+    Xd, yd = _separable(256, 4, drift=True)
+    # The base model is blind to the drifted signal...
+    p_base = np.asarray(trees_mod.predict_proba(base, Xd))[:, 1]
+    base_err = np.mean((p_base > 0.5) != (yd > 0.5))
+    assert base_err > 0.2
+    refreshed, info = refresh_gradient_boosting(base, Xd, yd, n_rounds=6)
+    # ...the refreshed one learned it from the window...
+    p_new = np.asarray(trees_mod.predict_proba(refreshed, Xd))[:, 1]
+    assert np.mean((p_new > 0.5) != (yd > 0.5)) < 0.05
+    # ...without forgetting the base regime.
+    p_old = np.asarray(trees_mod.predict_proba(refreshed, X0))[:, 1]
+    assert np.mean((p_old > 0.5) != (y0 > 0.5)) < 0.1
+    assert info["base_trees"] == 6 and info["total_trees"] == 12
+    assert info["window_rows"] == 256
+
+
+def test_refresh_buckets_padded_rows():
+    """Bucketed batch shapes: windows in the same rung pad to ONE shape,
+    so a steady retrain cadence reuses one compiled program."""
+    from fraud_detection_tpu.models.train_trees import (
+        fit_gradient_boosting, refresh_gradient_boosting,
+        refresh_row_bucket)
+
+    X, y = _separable(256, 5)
+    base = fit_gradient_boosting(X, y, n_rounds=2)
+    _, info_a = refresh_gradient_boosting(base, X[:300 // 2], y[:150],
+                                          n_rounds=1)
+    _, info_b = refresh_gradient_boosting(base, X[:200], y[:200],
+                                          n_rounds=1)
+    assert info_a["padded_rows"] == info_b["padded_rows"] == 512
+    assert refresh_row_bucket(1) == 512
+    assert refresh_row_bucket(513) == 1024
+    assert refresh_row_bucket(10 ** 9) == 32768   # top rung caps
+
+
+# ---------------------------------------------------------------------------
+# encoded scoring + shadow windowed divergence / replay
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def xgb_pipe():
+    from fraud_detection_tpu.scenarios.gameday import _default_pipeline
+
+    return _default_pipeline(64, model="xgb")
+
+
+def test_predict_encoded_matches_predict(xgb_pipe):
+    from fraud_detection_tpu.data import generate_corpus
+
+    texts = [d.text for d in generate_corpus(n=32, seed=5)]
+    enc = xgb_pipe.featurizer.encode(texts, batch_size=len(texts))
+    by_enc = xgb_pipe.predict_encoded(np.asarray(enc.ids),
+                                      np.asarray(enc.counts))
+    by_text = xgb_pipe.predict(texts)
+    np.testing.assert_array_equal(by_enc.labels, by_text.labels)
+    np.testing.assert_allclose(by_enc.probabilities,
+                               by_text.probabilities, atol=1e-6)
+
+
+def test_shadow_window_unmasks_late_drift():
+    """The satellite pin: a long-running shadow whose EARLY batches agree
+    must still show RECENT divergence in the windowed stats — cumulative
+    agreement alone would mask it."""
+    from fraud_detection_tpu.registry.shadow import ShadowScorer
+
+    class Flip:
+        def __init__(self, flip):
+            self.flip = flip
+
+        def predict(self, texts):
+            from fraud_detection_tpu.models.pipeline import PredictionBatch
+
+            n = len(texts)
+            labels = np.full(n, 1 if self.flip else 0, np.int32)
+            return PredictionBatch(labels,
+                                   np.full(n, 0.9 if self.flip else 0.1,
+                                           np.float32))
+
+    shadow = ShadowScorer(max_queue=64, window_batches=4)
+    try:
+        cand = Flip(flip=False)
+        shadow.set_candidate(cand, version=2)
+        # 16 agreeing batches...
+        for _ in range(16):
+            shadow.submit(["t"] * 8, np.zeros(8, np.int32),
+                          np.full(8, 0.1), raw=False)
+        assert shadow.drain(10.0)
+        # ...then the candidate starts disagreeing (drift): 4 batches.
+        cand.flip = True
+        for _ in range(4):
+            shadow.submit(["t"] * 8, np.zeros(8, np.int32),
+                          np.full(8, 0.1), raw=False)
+        assert shadow.drain(10.0)
+        snap = shadow.snapshot()
+        assert snap["agreement_rate"] == pytest.approx(16 / 20)  # masked
+        assert snap["window"]["rows"] == 32
+        assert snap["window"]["agreement_rate"] == 0.0           # unmasked
+        assert snap["window"]["psi"] > 1.0
+        assert snap["disagreed"] == 32
+    finally:
+        shadow.close(10.0)
+
+
+def test_shadow_submit_encoded_scores_candidate(xgb_pipe):
+    from fraud_detection_tpu.data import generate_corpus
+    from fraud_detection_tpu.registry.shadow import ShadowScorer
+
+    texts = [d.text for d in generate_corpus(n=16, seed=6)]
+    enc = xgb_pipe.featurizer.encode(texts, batch_size=len(texts))
+    primary = xgb_pipe.predict(texts)
+    shadow = ShadowScorer(max_queue=8)
+    try:
+        shadow.set_candidate(xgb_pipe, version=2)   # candidate == primary
+        assert shadow.submit_encoded(np.asarray(enc.ids),
+                                     np.asarray(enc.counts),
+                                     primary.labels, primary.probabilities)
+        assert shadow.drain(20.0)
+        snap = shadow.snapshot()
+        assert snap["rows"] == 16
+        assert snap["agreement_rate"] == 1.0        # same model agrees
+        assert snap["errors"] == 0
+    finally:
+        shadow.close(10.0)
+
+
+def test_sentinel_shadow_disagreement_burn_fires_without_learn_loop():
+    """The drift-is-an-incident satellite: the default-pack rule fires on
+    a drifting shadow even when the learn loop is disabled."""
+    from fraud_detection_tpu.obs.sentinel import Sentinel, default_rule_pack
+
+    state = {"rows": 0, "disagreed": 0}
+
+    def source():
+        return {"model": {"shadow": {"rows": state["rows"],
+                                     "disagreed": state["disagreed"]}}}
+
+    rules = [r for r in default_rule_pack(fast_s=2.0, slow_s=6.0,
+                                          resolve_s=1.0)
+             if r.name == "shadow_disagreement_burn"]
+    assert rules, "rule missing from the default pack"
+    s = Sentinel(source, rules, clock=iter(
+        float(i) for i in range(100)).__next__)
+    s.prime()
+    for _ in range(4):              # agreeing traffic: no alert
+        state["rows"] += 100
+        s.evaluate()
+    assert s.firing() == []
+    for _ in range(8):              # drift: 40% of new rows disagree
+        state["rows"] += 100
+        state["disagreed"] += 40
+        s.evaluate()
+    assert "shadow_disagreement_burn" in s.firing()
+
+
+# ---------------------------------------------------------------------------
+# the learn loop (inline tick driver)
+# ---------------------------------------------------------------------------
+
+def _drift_loop_fixture(xgb_pipe, tmp_path, policy_spec=None):
+    """Build the full loop inline (no threads): broker, registry with v1,
+    hotswap, shadow, controller, LearnLoop(start=False)."""
+    from fraud_detection_tpu.registry import (HotSwapPipeline,
+                                              LifecycleController,
+                                              ModelRegistry,
+                                              PromotionPolicy, ShadowScorer)
+    from fraud_detection_tpu.stream import InProcessBroker
+
+    broker = InProcessBroker(num_partitions=2)
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    registry.publish(xgb_pipe.featurizer, xgb_pipe.model)
+    hot = HotSwapPipeline(xgb_pipe, version=1)
+    shadow = ShadowScorer(max_queue=64, window_batches=8)
+    loop = LearnLoop(
+        feedback_consumer=broker.consumer(["fb"], "learn"),
+        registry=registry, hotswap=hot, shadow=shadow,
+        config=LearnConfig(min_labeled=32, min_new_labels=8,
+                           error_threshold=0.2, error_window=64,
+                           refresh_rounds=3, cooldown_s=0.0),
+        start=False)
+    controller = LifecycleController(
+        registry, hot, shadow=shadow,
+        # The inline window is ALL drift rows, so the candidate disagrees
+        # with the drifted primary on every one — thresholds of 1.0 admit
+        # exactly that (the game day's mixed window uses tighter ones).
+        policy=PromotionPolicy.parse(
+            policy_spec or "min_batches=1,min_rows=16,"
+                           "max_disagreement=1.0,max_psi=50.0,"
+                           "max_flag_rate_delta=1.0"),
+        on_transition=loop.on_transition)
+    loop.bind_controller(controller)
+    return broker, registry, hot, shadow, loop, controller
+
+
+@pytest.mark.learn
+def test_learn_loop_closes_the_loop_inline(xgb_pipe, tmp_path):
+    """Scored drift rows + labels -> drift trigger -> publish -> stage ->
+    encoded window replay -> auto-promote, all through the REAL
+    controller, every transition audited."""
+    from fraud_detection_tpu.scenarios.traffic import drift_scam_pool
+    from fraud_detection_tpu.stream.feedback import label_record
+
+    broker, registry, hot, shadow, loop, controller = _drift_loop_fixture(
+        xgb_pipe, tmp_path)
+    try:
+        pool = drift_scam_pool(3, 48)
+        # The "engine": score drifted rows with the primary and submit.
+        preds = hot.predict(pool)
+        assert np.mean(preds.labels) < 0.2    # primary is blind to drift
+        coords = [(IN, 0, i) for i in range(len(pool))]
+        assert loop.submit(coords, pool, preds.labels, preds.probabilities,
+                           raw=False, version=1)
+        # Ground truth arrives on the feedback topic.
+        fb = broker.producer()
+        for _, p, o in coords:
+            fb.produce("fb", label_record(IN, p, o, 1))
+        fb.flush()
+        loop.tick()                            # ingest + join + retrain
+        snap = loop.snapshot()
+        assert snap["window"]["joined"] == len(pool)
+        assert snap["published"] == 1 and snap["last_trigger"] == "drift"
+        mv = registry.latest()
+        assert mv.version == 2
+        assert mv.manifest["learn"]["trigger"] == "drift"
+        assert mv.manifest["learn"]["warm_started_from"] == 1
+        assert mv.manifest["parent"] == 1
+        # The controller adopts + stages; the loop replays the window to
+        # the shadow; the next tick promotes through the gates.
+        controller.tick()
+        assert hot.staged_version == 2
+        assert loop.tick()                     # window replay to shadow
+        assert shadow.drain(30.0)
+        controller.tick()
+        assert hot.active_version == 2 and hot.staged_version is None
+        snap = loop.snapshot()
+        assert snap["promoted"] == 1
+        assert snap["promoted_at_s"] is not None
+        assert snap["candidate_window_error_rate"] < 0.1
+        events = [e["event"] for e in controller.events]
+        assert events.count("stage") == 1 and events.count("promote") == 1
+        # The promoted model actually flags the drifted campaign.
+        assert np.mean(hot.predict(pool).labels) > 0.9
+    finally:
+        loop.close(10.0)
+        shadow.close(10.0)
+
+
+@pytest.mark.learn
+def test_learn_loop_impossible_policy_refuses(xgb_pipe, tmp_path):
+    """The gate provably gates: an impossible promotion policy leaves the
+    candidate staged forever — published but never promoted."""
+    from fraud_detection_tpu.scenarios.traffic import drift_scam_pool
+    from fraud_detection_tpu.stream.feedback import label_record
+
+    broker, registry, hot, shadow, loop, controller = _drift_loop_fixture(
+        xgb_pipe, tmp_path, policy_spec="min_batches=100000")
+    try:
+        pool = drift_scam_pool(3, 48)
+        preds = hot.predict(pool)
+        coords = [(IN, 0, i) for i in range(len(pool))]
+        loop.submit(coords, pool, preds.labels, preds.probabilities,
+                    raw=False, version=1)
+        fb = broker.producer()
+        for _, p, o in coords:
+            fb.produce("fb", label_record(IN, p, o, 1))
+        fb.flush()
+        loop.tick()
+        controller.tick()
+        loop.tick()
+        assert shadow.drain(30.0)
+        controller.tick()
+        snap = loop.snapshot()
+        assert snap["published"] == 1 and snap["promoted"] == 0
+        assert hot.active_version == 1 and hot.staged_version == 2
+    finally:
+        loop.close(10.0)
+        shadow.close(10.0)
+
+
+def test_learn_loop_counts_malformed_and_encode_errors(xgb_pipe, tmp_path):
+    from fraud_detection_tpu.registry import HotSwapPipeline, ModelRegistry
+    from fraud_detection_tpu.stream import InProcessBroker
+
+    broker = InProcessBroker()
+    registry = ModelRegistry(str(tmp_path / "reg2"))
+    hot = HotSwapPipeline(xgb_pipe, version=1)
+    loop = LearnLoop(feedback_consumer=broker.consumer(["fb"], "learn"),
+                     registry=registry, hotswap=hot,
+                     config=LearnConfig(min_labeled=10 ** 6), start=False)
+    fb = broker.producer()
+    fb.produce("fb", b"not a label")
+    fb.flush()
+    # Raw-mode payloads that fail JSON decode are skipped, not fatal.
+    loop.submit([(IN, 0, 0)], [b"\xff bad"], np.array([0]),
+                np.array([0.5]), raw=True, version=1)
+    loop.tick()
+    snap = loop.snapshot()
+    assert snap["window"]["malformed_labels"] == 1
+    assert snap["window"]["rows"] == 0
+    assert snap["labels_polled"] == 1
+
+
+# ---------------------------------------------------------------------------
+# engine wiring + health contract
+# ---------------------------------------------------------------------------
+
+LEARN_WINDOW_SCHEMA = {
+    "rows": (int,),
+    "labeled": (int,),
+    "capacity": (int,),
+    "inserted": (int,),
+    "evicted": (int,),
+    "evicted_labeled": (int,),
+    "labels_seen": (int,),
+    "joined": (int,),
+    "expired": (int,),
+    "missed": (int,),
+    "pending_labels": (int,),
+    "malformed_labels": (int,),
+    "accounting_exact": (bool,),
+}
+
+LEARN_BLOCK_SCHEMA = {
+    "window": (dict,),
+    "queue_depth": (int,),
+    "submitted": (int,),
+    "dropped": (int,),
+    "sampled_out": (int,),
+    "encode_errors": (int,),
+    "labels_polled": (int,),
+    "triggered": (int,),
+    "published": (int,),
+    "failed": (int,),
+    "in_flight": (bool,),
+    "promoted": (int,),
+    "rejected": (int,),
+    "rolled_back": (int,),
+    "published_versions": (list,),
+    "last_trigger": (type(None), str),
+    "first_trigger_at_s": (type(None), int, float),
+    "promoted_at_s": (type(None), int, float),
+    "last_retrain_wall_s": (type(None), int, float),
+    "retrain_wall_s_total": (int, float),
+    "recent_error_rate": (type(None), int, float),
+    "primary_window_error_rate": (type(None), int, float),
+    "candidate_window_error_rate": (type(None), int, float),
+    "error_by_version": (dict,),
+}
+
+
+def _assert_schema(obj, schema, where):
+    assert set(obj) == set(schema), (
+        f"{where}: keys changed — update the schema test AND the docs/"
+        f"pollers (extra: {set(obj) - set(schema)}, "
+        f"missing: {set(schema) - set(obj)})")
+    for key, types in schema.items():
+        assert isinstance(obj[key], types), (where, key, type(obj[key]))
+
+
+@pytest.mark.learn
+def test_engine_learn_block_contract(xgb_pipe, tmp_path):
+    """FC301 contract: the engine's health()["learn"] block + the nested
+    window block pin their exact key sets, and the engine actually feeds
+    the loop's window from scored batches."""
+    from fraud_detection_tpu.registry import HotSwapPipeline, ModelRegistry
+    from fraud_detection_tpu.stream import InProcessBroker
+    from fraud_detection_tpu.stream.engine import StreamingClassifier
+
+    broker = InProcessBroker()
+    feeder = broker.producer()
+    for i in range(16):
+        feeder.produce(IN, json.dumps({"text": f"hello row {i}"}).encode(),
+                       key=str(i).encode())
+    registry = ModelRegistry(str(tmp_path / "reg3"))
+    hot = HotSwapPipeline(xgb_pipe, version=1)
+    loop = LearnLoop(feedback_consumer=broker.consumer(["fb"], "learn"),
+                     registry=registry, hotswap=hot,
+                     config=LearnConfig(min_labeled=10 ** 6), start=False)
+    engine = StreamingClassifier(
+        hot, broker.consumer([IN], "g"), broker.producer(), "out",
+        batch_size=16, learn=loop)
+    engine.run(max_messages=16, idle_timeout=2.0)
+    loop.tick()
+    h = engine.health()
+    _assert_schema(h["learn"], LEARN_BLOCK_SCHEMA, "learn")
+    _assert_schema(h["learn"]["window"], LEARN_WINDOW_SCHEMA,
+                   "learn.window")
+    assert h["learn"]["window"]["rows"] == 16
+    assert h["learn"]["submitted"] >= 1
+    json.dumps(h)
+    loop.close(10.0)
+
+
+# ---------------------------------------------------------------------------
+# scenario pieces: label oracle + the drift_shift game day
+# ---------------------------------------------------------------------------
+
+def test_label_feeder_oracle():
+    from fraud_detection_tpu.scenarios.clock import ScenarioClock
+    from fraud_detection_tpu.scenarios.labels import LabelFeeder
+
+    from fraud_detection_tpu.stream import InProcessBroker
+
+    broker = InProcessBroker(num_partitions=2)
+    prod = broker.producer()
+    for i in range(12):
+        payload = {"text": "x"}
+        if i % 3 != 2:
+            payload["truth"] = i % 2
+        prod.produce(IN, json.dumps(payload).encode(), key=str(i).encode())
+    clock = ScenarioClock(0, time_scale=0.0)
+    clock.start()
+    lf = LabelFeeder(broker.consumer([IN], "labels"), broker.producer(),
+                     "fb", clock=clock, delay_s=0.1).start()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and lf.fed < 8:
+        time.sleep(0.02)
+    lf.join(timeout=10.0)
+    stats = lf.stats()
+    assert stats == {"fed": 8, "skipped": 4, "malformed": 0}
+    labels = [parse_label(m.value) for m in broker.messages("fb")]
+    assert len(labels) == 8 and all(r is not None for r in labels)
+    # Every label names a real input coordinate.
+    coords = {(m.topic, m.partition, m.offset)
+              for m in broker.messages(IN)}
+    assert all(r.key in coords for r in labels)
+
+
+@pytest.mark.learn
+def test_drift_shift_gameday_closes_the_loop():
+    """THE acceptance pin: seeded mid-run distribution shift -> sentinel
+    fires -> warm-started retrain publishes -> shadow-scores ->
+    auto-promotes within bounded virtual seconds — zero-loss/zero-dup
+    through the swap, exact join accounting, agreement recovery gated,
+    every transition audited."""
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    gd = get_scenario("drift_shift", seed=11, scale=0.3)
+    result = run_gameday(gd)
+    assert result.ok, result.table()
+    ev = result.evidence
+    learn = ev["learn"]
+    assert learn["published"] >= 1 and learn["promoted"] >= 1
+    w = learn["window"]
+    assert w["accounting_exact"] is True
+    assert (w["joined"] + w["expired"] + w["missed"] + w["pending_labels"]
+            == w["labels_seen"])
+    assert ev["swaps"] >= 1
+    assert ev["learn_promotion_latency_s"] is not None
+    assert ev["learn_promotion_latency_s"] <= 60.0
+    assert ev["lifecycle"]["audit_ok"] is True
+    assert learn["primary_window_error_rate"] >= 0.08
+    assert learn["candidate_window_error_rate"] <= 0.1
+    alerts = ev["alerts"]
+    assert any(i["rule"] == "shadow_disagreement_burn"
+               for i in alerts["incidents"])
+
+
+def test_drift_campaign_traffic_is_deterministic_and_truth_carrying():
+    from fraud_detection_tpu.scenarios.clock import ScenarioClock
+    from fraud_detection_tpu.scenarios.traffic import (DriftCampaign,
+                                                       generate)
+
+    spec = DriftCampaign(name="d", wave_rate=100, waves=1, wave_s=0.5,
+                         gap_s=0.1)
+    a = generate(spec, 42)
+    b = generate(spec, 42)
+    assert a == b and a
+    scam = [e for e in a if e.kind == "scam"]
+    assert scam
+    for e in a:
+        payload = json.loads(e.value)
+        assert payload["truth"] == (1 if e.kind == "scam" else 0)
+    # Classic specs' payload bytes are UNCHANGED (no truth field).
+    from fraud_detection_tpu.scenarios.traffic import SteadyLoad
+
+    ev = generate(SteadyLoad(name="s", rate=50, duration_s=0.5), 42)
+    assert all("truth" not in json.loads(e.value) for e in ev)
+
+
+# ---------------------------------------------------------------------------
+# flightcheck registration pins
+# ---------------------------------------------------------------------------
+
+def test_learn_lane_registered_with_flightcheck():
+    from fraud_detection_tpu.analysis.entrypoints import (
+        CONCURRENT_CLASSES, OBJECT_BINDINGS, THREAD_ENTRY_POINTS,
+        THREAD_SITES)
+    from fraud_detection_tpu.utils.racecheck import INSTRUMENTED_REGIONS
+
+    assert ("learn/loop.py", "self._run") in THREAD_SITES
+    assert ("scenarios/labels.py", "self._run") in THREAD_SITES
+    eps = {(ep.module, ep.qualname): ep for ep in THREAD_ENTRY_POINTS}
+    ep = eps[("learn/loop.py", "LearnLoop._run")]
+    assert ep.thread == "learn-lane"
+    assert ep.racecheck == "LearnLoop.lane"
+    assert "LearnLoop.lane" in INSTRUMENTED_REGIONS
+    lf = eps[("scenarios/labels.py", "LabelFeeder._run")]
+    assert lf.thread == "label-feeder" and lf.why_uncovered
+    spec = CONCURRENT_CLASSES["learn/loop.py::LearnLoop"]
+    assert "_run" in spec.workers["learn_lane"]
+    assert "submit" in spec.any_thread and "snapshot" in spec.any_thread
+    assert OBJECT_BINDINGS[
+        "stream/engine.py::StreamingClassifier._learn"] == ("LearnLoop",)
+
+
+def test_learn_health_contract_registered():
+    from fraud_detection_tpu.analysis.health import CONTRACTS
+
+    pairs = {(c.module, c.schema_var) for c in CONTRACTS}
+    assert ("learn/loop.py", "LEARN_BLOCK_SCHEMA") in pairs
+    assert ("learn/store.py", "LEARN_WINDOW_SCHEMA") in pairs
+
+
+# ---------------------------------------------------------------------------
+# serve CLI validation
+# ---------------------------------------------------------------------------
+
+def test_serve_learn_flag_validation():
+    from fraud_detection_tpu.app.serve import main
+
+    with pytest.raises(SystemExit, match="--learn"):
+        main(["--model", "synthetic", "--demo", "10", "--learn"])

@@ -384,6 +384,7 @@ ENGINE_HEALTH_SCHEMA = {
     "breaker": (type(None), dict),
     "explain": (type(None), dict),
     "model": (type(None), dict),
+    "learn": (type(None), dict),
     "trace": (type(None), dict),
     "alerts": (type(None), dict),
 }
@@ -420,6 +421,8 @@ SHADOW_BLOCK_SCHEMA = {
     "candidate_version": (type(None), int),
     "batches": (int,),
     "rows": (int,),
+    "disagreed": (int,),
+    "window": (dict,),
     "agreement_rate": (type(None), int, float),
     "mean_abs_dp": (type(None), int, float),
     "flag_rate_primary": (type(None), int, float),
