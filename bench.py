@@ -203,6 +203,12 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
             "scaling_x": fleet.get("scaling_x"),
             "global_watermark_sheds": (fleet.get("global_shed")
                                        or {}).get("sheds"),
+            # Coordinator succession (ISSUE 16): wall-clock failover
+            # latency + control-lane losses, so a slow election or a
+            # leaky control lane diffs in the trend file.
+            "failover_s": (fleet.get("failover") or {}).get("failover_s"),
+            "failover_control_lost": (fleet.get("failover")
+                                      or {}).get("control_lost"),
         } if fleet and "workers" in fleet else None),
     }
     trend = []
@@ -992,7 +998,8 @@ def int8_stream_bench(fp32_pipe, texts, batch_size: int, depth: int,
 
 def _fleet_drain(pipe, texts, batch_size: int, n_msgs: int, n_workers: int,
                  *, sched_config=None, dlq_topic=None, death_plan=None,
-                 num_partitions: int = 4):
+                 num_partitions: int = 4, candidates: int = 1,
+                 role_ttl=None, coordinator_kill=None):
     """One fleet drain run: fresh broker, n_msgs preloaded, N partition-
     owning workers under the lease coordinator (fraud_detection_tpu/fleet/).
     Returns (fleet result dict, output keys incl. DLQ) for rate + exact
@@ -1011,7 +1018,8 @@ def _fleet_drain(pipe, texts, batch_size: int, n_msgs: int, n_workers: int,
         broker, pipe, "customer-dialogues-raw", "dialogues-classified",
         n_workers, batch_size=batch_size, max_wait=0.01,
         sched_config=sched_config, dlq_topic=dlq_topic,
-        death_plan=death_plan, lease_ttl=1.0)
+        death_plan=death_plan, lease_ttl=1.0, candidates=candidates,
+        role_ttl=role_ttl, coordinator_kill=coordinator_kill)
     result = fleet.run(idle_timeout=0.5, join_timeout=300.0)
     keys = [m.key for m in broker.messages("dialogues-classified")]
     if dlq_topic is not None:
@@ -1053,6 +1061,33 @@ def fleet_bench(pipe, texts, batch_size: int, n_msgs: int) -> dict:
         "lease_expirations": chaos["lease_expirations"],
     }
 
+    # Coordinator succession (ISSUE 16, docs/fleet.md "Coordinator
+    # succession"): a crash-killed coordinator mid-drain — the failover
+    # latency (role_ttl vacancy detection + election + state
+    # reconstruction from the control lane) committed as artifact
+    # evidence, with the same exact key-set accounting held across the
+    # interregnum and zero control records lost on the in-process wire.
+    from fraud_detection_tpu.stream.faults import CoordinatorKillSpec
+
+    ckill = CoordinatorKillSpec(seed=11, kills=1, min_ticks=2,
+                                max_ticks=6, modes=("crash",))
+    fo_res, fo_keys = _fleet_drain(pipe, texts, batch_size, n, workers,
+                                   candidates=2, role_ttl=0.5,
+                                   coordinator_kill=ckill)
+    succ = fo_res.get("succession") or {}
+    handoffs = succ.get("handoffs") or []
+    failover = {
+        "candidates": 2,
+        "role_ttl_s": 0.5,
+        "elections": succ.get("elections"),
+        "term": succ.get("term"),
+        "failover_s": (handoffs[0].get("failover_s")
+                       if handoffs else None),
+        "control_lost": (succ.get("control") or {}).get("lost"),
+        "lost_keys": len(expect - set(fo_keys)),
+        "duplicated_keys": len(fo_keys) - len(set(fo_keys)),
+    }
+
     # Global-watermark shedding: a deliberately over-committed preload
     # against a small max_queue; every worker sheds against the FLEET's
     # aggregated backlog (sched/scheduler.py fleet_backlog), every shed row
@@ -1083,6 +1118,7 @@ def fleet_bench(pipe, texts, batch_size: int, n_msgs: int) -> dict:
                       if single["msgs_per_sec"] else None),
         "rebalances": multi["rebalances"],
         "kill": kill,
+        "failover": failover,
         "global_shed": global_shed,
     }
 

@@ -37,8 +37,9 @@ from fraud_detection_tpu.analysis.core import (RULES, resolve_roots,
 
 
 def model_main(argv=None) -> int:
-    from fraud_detection_tpu.analysis.checker import (MUTATIONS, CheckConfig,
-                                                      check)
+    from fraud_detection_tpu.analysis.checker import (MUTATIONS,
+                                                      SUCCESSION_CONFIG,
+                                                      CheckConfig, check)
     from fraud_detection_tpu.analysis import traces
 
     parser = argparse.ArgumentParser(
@@ -53,6 +54,21 @@ def model_main(argv=None) -> int:
     parser.add_argument("--max-lapses", type=int, default=1,
                         help="live-worker lease lapses (the zombie-stall "
                              "adversary budget)")
+    parser.add_argument("--candidates", type=int, default=1,
+                        help="coordinator candidates contending on the "
+                             "role lease (>= 2 enables the succession "
+                             "environment)")
+    parser.add_argument("--coord-crashes", type=int, default=0,
+                        help="coordinator crash budget")
+    parser.add_argument("--coord-lapses", type=int, default=0,
+                        help="coordinator role-lease lapses (the zombie-"
+                             "coordinator / delayed-decision adversary "
+                             "budget)")
+    parser.add_argument("--succession", action="store_true",
+                        help="use the headline succession configuration "
+                             "(W=3/P=3, one coordinator crash + one "
+                             "coordinator lapse on a lossy control lane); "
+                             "overrides the topology flags")
     parser.add_argument("--mutate", default=None,
                         help="comma-separated protocol mutations to seed "
                              f"(known: {', '.join(MUTATIONS)})")
@@ -79,12 +95,18 @@ def model_main(argv=None) -> int:
     mutations = frozenset(
         m.strip() for m in (args.mutate or "").split(",") if m.strip())
     try:
-        cfg = CheckConfig(
+        topology = dict(
             workers=args.workers, partitions=args.partitions,
             keys_per_partition=args.keys, max_crashes=args.max_crashes,
-            max_lapses=args.max_lapses, mutations=mutations,
+            max_lapses=args.max_lapses, candidates=args.candidates,
+            max_coord_crashes=args.coord_crashes,
+            max_coord_lapses=args.coord_lapses)
+        if args.succession:
+            topology = dict(SUCCESSION_CONFIG)
+        cfg = CheckConfig(
+            mutations=mutations,
             max_states=args.max_states, max_seconds=args.max_seconds,
-            symmetry=not args.no_symmetry)
+            symmetry=not args.no_symmetry, **topology)
         cfg.validate()
     except ValueError as e:
         print(f"flightcheck model: {e}", file=sys.stderr)

@@ -60,6 +60,52 @@ which is the checker's own regression guard:
   alone, dropping a still-draining owner's hold (the TRUE POSITIVE this
   checker found in ``FleetCoordinator._rebalance_locked``; fixed in-tree,
   kept here as the regression mutant).
+
+**Succession environment** (PR 16, docs/fleet.md "Coordinator
+succession"). The coordinator itself is a leased role contended by
+``candidates`` identical candidates over a lossy control lane, and the
+model gains a coordinator dimension with its own fault budget:
+
+* ``coord_crash`` — the leading candidate dies mid-flight (bounded by
+  ``max_coord_crashes``); the control plane is leaderless until ``elect``.
+* ``coord_lapse`` — the leading candidate stalls past its role lease
+  (bounded by ``max_coord_lapses``): it becomes a ZOMBIE coordinator that
+  still believes it leads, and its last assignment decision survives as an
+  arbitrarily-delayed, duplicable control record.
+* ``elect`` — a standby candidate wins the role lease at ``term + 1`` and
+  reconstructs members/target/pending from the compacted control topic —
+  crucially INHERITING the in-flight revoke-barrier holds and fence state.
+* ``stale_assign`` — the zombie's delayed assignment record finally
+  arrives; the term fence accepts it only while its term is still
+  current, so post-succession it is REJECTED (the epoch-stamped fence).
+
+During an interregnum (no leader) the data plane continues — polls and
+commits ride existing leases and the materialized fence — while control
+decisions (join/sync/ack/leave/expiry scans) wait for a successor, whose
+election is always enabled (``max_coord_crashes + max_coord_lapses <
+candidates`` keeps a survivor, mirroring ``max_crashes < workers``).
+
+**Control-lane fault mapping.** Control messages are idempotent,
+seq/term-stamped records, so the classic message faults reduce to moves
+the model already explores: a LOST or DELAYED worker->coordinator request
+is an RPC edge simply not (yet) taken — every such schedule is a BFS
+interleaving; a DUPLICATED idempotent record re-applies to a fixed point
+(tests/test_succession.py pins per-kind idempotency in the real
+transport); and the one *dangerous* delay/duplicate — a superseded
+coordinator's assignment decision landing late — is modeled explicitly as
+``stale_assign`` against the term fence. The succession mutations
+re-introduce the failover bugs the choreography prevents:
+
+* ``drop_coordinator_lease`` — successors claim leadership WITHOUT
+  winning the role lease, so the term never advances and the fence cannot
+  tell the zombie's delayed decision from the successor's (a same-term
+  two-leader split; the stale re-deal resurrects released holds/grants);
+* ``stale_term_fence_accepted`` — terms advance but the fence ignores
+  them: a zombie coordinator's stale-term decision is applied after
+  succession;
+* ``forget_holds_on_failover`` — the successor rebuilds assignment state
+  from the target map alone, dropping in-flight revoke-barrier holds (the
+  failover twin of ``forget_barrier_holds``).
 """
 
 from __future__ import annotations
@@ -72,6 +118,8 @@ from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
 MUTATIONS: Tuple[str, ...] = (
     "drop_fence", "skip_revoke_barrier", "ack_before_drain",
     "expire_before_renew", "forget_barrier_holds",
+    "drop_coordinator_lease", "stale_term_fence_accepted",
+    "forget_holds_on_failover",
 )
 
 INVARIANTS: Tuple[str, ...] = (
@@ -95,8 +143,19 @@ ACTION_IMPLEMENTS: Dict[str, Tuple[str, ...]] = {
               "Bus.retract"),
     "crash": ("Worker.crash",),
     "lapse": ("Environment.lapse",),
-    "tick": ("Coordinator.tick", "Bus.aggregate"),
+    "tick": ("Coordinator.tick", "Bus.aggregate", "Candidate.lead"),
+    "coord_crash": ("Candidate.crash",),
+    "coord_lapse": ("Candidate.lapse",),
+    "elect": ("Candidate.elect", "Candidate.restore"),
+    "stale_assign": ("Candidate.fence",),
 }
+
+#: The actions only a succession configuration (``candidates >= 2`` with a
+#: coordinator fault budget) can exercise; the coverage pin unions the
+#: default and succession runs (tests/test_model_checker.py).
+SUCCESSION_ACTIONS: Tuple[str, ...] = (
+    "coord_crash", "coord_lapse", "elect", "stale_assign",
+)
 
 
 @dataclass(frozen=True)
@@ -106,6 +165,14 @@ class CheckConfig:
     keys_per_partition: int = 2
     max_crashes: int = 1
     max_lapses: int = 1
+    #: succession dimension: identical candidates contending on the
+    #: coordinator role lease. The defaults (one immortal candidate, zero
+    #: coordinator fault budget) collapse the coordinator component to a
+    #: constant, so the explored state space is byte-identical to the
+    #: pre-succession model.
+    candidates: int = 1
+    max_coord_crashes: int = 0
+    max_coord_lapses: int = 0
     mutations: FrozenSet[str] = frozenset()
     max_states: int = 400_000
     max_seconds: float = 120.0
@@ -126,10 +193,36 @@ class CheckConfig:
                 "max_crashes must leave at least one surviving worker "
                 f"(got {self.max_crashes} with {self.workers} workers): "
                 "the zero-loss guarantee is conditioned on a survivor")
+        if self.candidates < 1 or self.candidates > 4:
+            raise ValueError(
+                f"candidates must be 1..4, got {self.candidates}")
+        if self.max_coord_crashes < 0 or self.max_coord_lapses < 0:
+            raise ValueError("coordinator fault budgets must be >= 0")
+        if self.max_coord_crashes + self.max_coord_lapses \
+                >= self.candidates:
+            raise ValueError(
+                "max_coord_crashes + max_coord_lapses must leave at least "
+                f"one never-failing candidate (got "
+                f"{self.max_coord_crashes}+{self.max_coord_lapses} with "
+                f"{self.candidates} candidates): liveness of the control "
+                "plane is conditioned on a survivor, like max_crashes")
         unknown = set(self.mutations) - set(MUTATIONS)
         if unknown:
             raise ValueError(f"unknown mutations {sorted(unknown)} "
                              f"(known: {list(MUTATIONS)})")
+
+
+#: The headline succession configuration (CI's failover-smoke, the
+#: ``--succession`` CLI preset): W=3/P=3 with a coordinator crash AND a
+#: coordinator lapse (so the zombie/stale-delivery edges are explored) on
+#: top of one worker crash. ``keys_per_partition=1`` keeps the data plane
+#: minimal and ``max_lapses=0`` leaves the worker-stall adversary to the
+#: default configuration — the succession interleavings (coordinator
+#: death racing join/crash-driven rebalances), not the row volume, are
+#: what this configuration exists to cover. Verifies in ~176k states.
+SUCCESSION_CONFIG = dict(workers=3, partitions=3, keys_per_partition=1,
+                         max_crashes=1, max_lapses=0, candidates=3,
+                         max_coord_crashes=1, max_coord_lapses=1)
 
 
 @dataclass(frozen=True)
@@ -165,7 +258,7 @@ class CheckResult:
 # state encoding
 #
 # state = (members, stale, target, pending, committed, workers,
-#          crashes, lapses)
+#          crashes, lapses, coord)
 #   members:  tuple[int]      membership in JOIN ORDER (the assignor's key)
 #   stale:    tuple[int]      members whose lease ttl has elapsed, sorted
 #   target:   tuple[int]*P    authoritative owner per partition (-1 none)
@@ -183,6 +276,21 @@ class CheckResult:
 #                     are the DOCUMENTED at-least-once duplicates, exempt
 #                     from the committed-coverage dup accounting
 #   crashes, lapses: environment budget spent
+#   coord:    (leading, standby, zombie, term, ccrashes, clapses)
+#             leading: 1 while a live candidate holds the coordinator
+#                     role lease, 0 during an interregnum
+#             standby: count of standby candidates (candidates are
+#                     identical, so only the COUNT matters — a sound
+#                     symmetry by construction; elections resolve
+#                     deterministically to "some standby wins")
+#             zombie: None, or (zterm, ztarget, zpending) — a lapsed
+#                     leader's identity plus the assignment decision it
+#                     may still deliver late (the delayed/duplicated
+#                     control record); one-shot, spent by stale_assign
+#             term:   the epoch the authoritative term fence currently
+#                     accepts; elect advances it (Kafka controller-epoch
+#                     style), so a zombie's zterm < term is rejectable
+#             ccrashes, clapses: coordinator fault budget spent
 #
 # Delivery accounting rides ``committed`` alone: a success commit covers
 # exactly the rows it newly advances past (each row exactly once, by
@@ -199,6 +307,9 @@ _INIT, _RUN, _DRAIN, _CRASH, _LEFT = "i", "r", "d", "c", "l"
 def _initial_state(cfg: CheckConfig):
     P = cfg.partitions
     worker = (_INIT, (), (-1,) * P, (-1,) * P, False)
+    # Candidate 0 holds the role lease from the start (the bootstrap
+    # election is uncontended); the rest stand by.
+    coord = (1, cfg.candidates - 1, None, 0, 0, 0)
     return (
         (),                       # members
         (),                       # stale
@@ -207,17 +318,27 @@ def _initial_state(cfg: CheckConfig):
         (0,) * P,                 # committed
         tuple(worker for _ in range(cfg.workers)),
         0, 0,
+        coord,
     )
 
 
 def _relabel(state, perm):
     """Apply worker permutation ``perm`` (old id -> new id). Join order is
     positional, so the members tuple keeps its order with ids mapped —
-    relabeling is an automorphism of the deterministic assignor."""
-    members, stale, target, pending, committed, workers, cr, la = state
+    relabeling is an automorphism of the deterministic assignor. The
+    coordinator component names no worker ids except inside the zombie's
+    captured assignment, which must relabel with the rest."""
+    (members, stale, target, pending, committed, workers, cr, la,
+     coord) = state
     inv = [0] * len(perm)
     for old, new in enumerate(perm):
         inv[new] = old
+    leading, standby, zombie, term, ccr, cla = coord
+    if zombie is not None and zombie[1] is not None:
+        zterm, ztarget, zpending = zombie
+        zombie = (zterm,
+                  tuple(perm[w] if w >= 0 else -1 for w in ztarget),
+                  tuple(perm[w] if w >= 0 else -1 for w in zpending))
     return (
         tuple(perm[w] for w in members),
         tuple(sorted(perm[w] for w in stale)),
@@ -226,6 +347,7 @@ def _relabel(state, perm):
         committed,
         tuple(workers[inv[new]] for new in range(len(workers))),
         cr, la,
+        (leading, standby, zombie, term, ccr, cla),
     )
 
 
@@ -384,27 +506,36 @@ class FleetModel:
         """Yield (step, next_state, violation). A violation ends the
         search; its step is included in the trace."""
         (members, stale, target, pending, committed, workers,
-         crashes, lapses) = state
+         crashes, lapses, coord) = state
         cfg, P, K = self.cfg, self.cfg.partitions, self.cfg.keys_per_partition
+        leading, standby, czombie, term, ccrashes, clapses = coord
+        # Control-plane RPCs (join/sync/ack/leave, the expiry scan) need a
+        # live leader; the data plane (poll/commit on existing leases, the
+        # materialized fence) rides out an interregnum. A lost or delayed
+        # control request is indistinguishable from the RPC edge not yet
+        # being scheduled, so message loss/delay on the control lane is
+        # covered by the interleavings themselves.
+        have_leader = leading == 1
 
         for wid, worker in enumerate(workers):
             wstate, lease, pos, base, zombie = worker
             actor = f"w{wid}"
 
-            # ---- join: init -> running ---------------------------------
+            # ---- join: init -> running (waits out an interregnum) ------
             if wstate == _INIT:
-                m2, s2, t2, p2, expired, self_exp = _coord_sync(
-                    members, stale, target, pending, wid, self.mut)
-                w2 = _mark_zombies(workers, expired)
-                granted, _ = _granted(t2, p2, wid)
-                w2 = list(w2)
-                w2[wid] = self._rebuild_worker(committed, granted)
-                nxt = (m2, s2, t2, p2, committed, tuple(w2),
-                       crashes, lapses)
-                yield (Step(actor, "join",
-                            f"joins; lease {{{_pp(granted)}}} (consumer "
-                            f"resumes from committed offsets)"),
-                       nxt, None)
+                if have_leader:
+                    m2, s2, t2, p2, expired, self_exp = _coord_sync(
+                        members, stale, target, pending, wid, self.mut)
+                    w2 = _mark_zombies(workers, expired)
+                    granted, _ = _granted(t2, p2, wid)
+                    w2 = list(w2)
+                    w2[wid] = self._rebuild_worker(committed, granted)
+                    nxt = (m2, s2, t2, p2, committed, tuple(w2),
+                           crashes, lapses, coord)
+                    yield (Step(actor, "join",
+                                f"joins; lease {{{_pp(granted)}}} (consumer "
+                                f"resumes from committed offsets)"),
+                           nxt, None)
                 continue
 
             if wstate in (_CRASH, _LEFT):
@@ -414,15 +545,17 @@ class FleetModel:
                 if wstate == _CRASH and wid in members and wid not in stale:
                     s2 = tuple(sorted(set(stale) | {wid}))
                     nxt = (members, s2, target, pending, committed,
-                           workers, crashes, lapses)
+                           workers, crashes, lapses, coord)
                     yield (Step(actor, "lapse",
                                 f"lease ttl elapses for dead {actor}"),
                            nxt, None)
                 continue
 
             # ---- sync: heartbeat + lease refresh (running only; a
-            # draining engine no longer polls) -----------------------------
-            if wstate == _RUN:
+            # draining engine no longer polls). During an interregnum the
+            # heartbeat goes unanswered: the worker keeps its current
+            # lease and the data plane carries on below. -------------------
+            if wstate == _RUN and have_leader:
                 m2, s2, t2, p2, expired, self_exp = _coord_sync(
                     members, stale, target, pending, wid, self.mut)
                 w2 = list(_mark_zombies(workers, expired))
@@ -449,9 +582,10 @@ class FleetModel:
                 else:
                     w2[wid] = (_RUN, lease, pos, base, zombie)
                 nxt = (m2, s2, t2, p2, committed, tuple(w2),
-                       crashes, lapses)
+                       crashes, lapses, coord)
                 yield Step(actor, "sync", detail), nxt, violation
 
+            if wstate == _RUN:
                 # ---- poll: one row from one granted partition ----------
                 for p in lease:
                     if pos[p] >= K:
@@ -483,7 +617,7 @@ class FleetModel:
                     pos2[p] += 1
                     w2[wid] = (_RUN, lease, tuple(pos2), base, zombie)
                     nxt = (members, stale, target, pending, committed,
-                           tuple(w2), crashes, lapses)
+                           tuple(w2), crashes, lapses, coord)
                     yield (Step(actor, "poll",
                                 f"polls p{p} offset {pos[p]}"),
                            nxt, violation)
@@ -517,7 +651,7 @@ class FleetModel:
                         # carries on (rebalanced_commits) and the rows
                         # stand as documented at-least-once duplicates.
                         nxt = (members, stale, target, pending, committed,
-                               tuple(w2), crashes, lapses)
+                               tuple(w2), crashes, lapses, coord)
                         yield (Step(actor, "commit",
                                     f"commit of {span} FENCED (lease "
                                     f"revoked for "
@@ -565,27 +699,28 @@ class FleetModel:
                             committed2[p] = max(committed2[p], q)
                         nxt = (members, stale, target, pending,
                                tuple(committed2),
-                               tuple(w2), crashes, lapses)
+                               tuple(w2), crashes, lapses, coord)
                         yield (Step(actor, "commit",
                                     f"delivers + commits {span}"),
                                nxt, violation)
 
             # ---- ack: drain complete -> release barrier, rebuild -------
-            if wstate == _DRAIN and not self._read_ahead(worker):
+            if wstate == _DRAIN and have_leader \
+                    and not self._read_ahead(worker):
                 p2 = _release_holds(pending, wid)
                 s2 = tuple(x for x in stale if x != wid)   # ack renews
                 granted, _ = _granted(target, p2, wid)
                 w2 = list(workers)
                 w2[wid] = self._rebuild_worker(committed, granted)
                 nxt = (members, s2, target, p2, committed,
-                       tuple(w2), crashes, lapses)
+                       tuple(w2), crashes, lapses, coord)
                 yield (Step(actor, "ack",
                             f"drained + committed: acks the barrier, "
                             f"rebuilds on lease {{{_pp(granted)}}}"),
                        nxt, None)
 
             # ---- leave: drain-run idle exit ----------------------------
-            if wstate == _RUN \
+            if wstate == _RUN and have_leader \
                     and all(pos[p] >= K and base[p] == pos[p]
                             for p in lease) \
                     and all(c >= K for c in committed):
@@ -597,7 +732,7 @@ class FleetModel:
                 w2 = list(workers)
                 w2[wid] = (_LEFT, (), (-1,) * P, (-1,) * P, False)
                 nxt = (m2, s2, t2, p2, committed, tuple(w2),
-                       crashes, lapses)
+                       crashes, lapses, coord)
                 yield (Step(actor, "leave",
                             "input idle and group lag 0: leaves "
                             "gracefully (partitions reassign immediately)"),
@@ -609,7 +744,7 @@ class FleetModel:
             # worker's own fenced-away rows, or a dead peer's partitions —
             # so it rebuilds a FRESH consumer resuming from the committed
             # offsets instead of leaving. The at-least-once recovery.)
-            if wstate == _RUN \
+            if wstate == _RUN and have_leader \
                     and all(pos[p] >= K and base[p] == pos[p]
                             for p in lease) \
                     and any(c < K for c in committed):
@@ -621,7 +756,7 @@ class FleetModel:
                     w2 = list(workers)
                     w2[wid] = self._rebuild_worker(committed, granted)
                     nxt = (members, s2, target, p2, committed,
-                           tuple(w2), crashes, lapses)
+                           tuple(w2), crashes, lapses, coord)
                     yield (Step(actor, "ack",
                                 f"incarnation idle but group lag remains: "
                                 f"acks + rebuilds a fresh consumer on "
@@ -634,25 +769,28 @@ class FleetModel:
                 w2 = list(workers)
                 w2[wid] = (_CRASH, lease, pos, base, zombie)
                 nxt = (members, stale, target, pending, committed,
-                       tuple(w2), crashes + 1, lapses)
+                       tuple(w2), crashes + 1, lapses, coord)
                 yield (Step(actor, "crash",
                             "KILLED (crash mode): stops heartbeating; "
                             "read-ahead dies with it; lease must expire"),
                        nxt, None)
-                # graceful death: the plan releases the lease NOW
-                m2 = tuple(m for m in members if m != wid)
-                s2 = tuple(x for x in stale if x != wid)
-                t2, p2 = target, _release_holds(pending, wid)
-                if wid in members:
-                    t2, p2 = _rebalance(m2, t2, p2, P, self.mut)
-                w2 = list(workers)
-                w2[wid] = (_CRASH, (), (-1,) * P, (-1,) * P, False)
-                nxt = (m2, s2, t2, p2, committed, tuple(w2),
-                       crashes + 1, lapses)
-                yield (Step(actor, "crash",
-                            "KILLED (graceful mode): leaves the group; "
-                            "partitions reassign immediately"),
-                       nxt, None)
+                # graceful death: the plan releases the lease NOW (the
+                # leave RPC needs a leader; leaderless, only the hard
+                # crash above is possible)
+                if have_leader:
+                    m2 = tuple(m for m in members if m != wid)
+                    s2 = tuple(x for x in stale if x != wid)
+                    t2, p2 = target, _release_holds(pending, wid)
+                    if wid in members:
+                        t2, p2 = _rebalance(m2, t2, p2, P, self.mut)
+                    w2 = list(workers)
+                    w2[wid] = (_CRASH, (), (-1,) * P, (-1,) * P, False)
+                    nxt = (m2, s2, t2, p2, committed, tuple(w2),
+                           crashes + 1, lapses, coord)
+                    yield (Step(actor, "crash",
+                                "KILLED (graceful mode): leaves the group; "
+                                "partitions reassign immediately"),
+                           nxt, None)
 
             # ---- lapse: a LIVE worker stalls past its ttl (the zombie
             # adversary, budgeted; dead workers' lapse is handled above) --
@@ -660,28 +798,129 @@ class FleetModel:
                     and lapses < cfg.max_lapses:
                 s2 = tuple(sorted(set(stale) | {wid}))
                 nxt = (members, s2, target, pending, committed,
-                       workers, crashes, lapses + 1)
+                       workers, crashes, lapses + 1, coord)
                 yield (Step(actor, "lapse",
                             f"lease ttl elapses for {actor} (stalled; "
                             f"expiry races its renewal)"),
                        nxt, None)
 
-        # ---- tick: the monitor thread's expiry scan ---------------------
+        # ---- tick: the monitor thread's expiry scan (leader-only) -------
         expired = [m for m in members if m in stale]
-        if expired:
+        if expired and have_leader:
             m2 = tuple(m for m in members if m not in expired)
             p2 = pending
             for e in expired:
                 p2 = _release_holds(p2, e)
             t2, p2 = _rebalance(m2, target, p2, P, self.mut)
             w2 = _mark_zombies(workers, expired)
-            nxt = (m2, (), t2, p2, committed, w2, crashes, lapses)
+            nxt = (m2, (), t2, p2, committed, w2, crashes, lapses, coord)
             yield (Step("coord", "tick",
                         f"monitor tick expires "
                         f"{', '.join(f'w{e}' for e in expired)}: leases "
                         f"released, partitions re-dealt (expiry IS the "
                         f"dead owner's barrier)"),
                    nxt, None)
+
+        # ---- the succession environment ---------------------------------
+        # coord_crash: the leading candidate dies mid-flight.
+        if have_leader and ccrashes < cfg.max_coord_crashes:
+            c2 = (0, standby, czombie, term, ccrashes + 1, clapses)
+            nxt = (members, stale, target, pending, committed, workers,
+                   crashes, lapses, c2)
+            yield (Step("coord", "coord_crash",
+                        "coordinator CRASHES mid-flight: beacons stop, "
+                        "the control plane is leaderless until a "
+                        "successor claims the role lease"),
+                   nxt, None)
+
+        # coord_lapse: the leading candidate stalls past its role lease.
+        # It becomes a zombie that still believes it leads; its last
+        # assignment decision is captured as the delayed control record it
+        # may still deliver (stale_assign below). SNAPSHOT REDUCTION: with
+        # an intact fence, the record is accepted only while its term is
+        # current, i.e. before any elect — and leaderless, no control edge
+        # can change target/pending, so the captured decision provably
+        # equals the live one and need not be carried in the state. Only
+        # the fence-breaking mutations make the snapshot observable.
+        if have_leader and clapses < cfg.max_coord_lapses:
+            if self.mut & {"drop_coordinator_lease",
+                           "stale_term_fence_accepted"}:
+                snap = (term, target, pending)
+            else:
+                snap = (term, None, None)
+            c2 = (0, standby, snap, term, ccrashes, clapses + 1)
+            nxt = (members, stale, target, pending, committed, workers,
+                   crashes, lapses, c2)
+            yield (Step("coord", "coord_lapse",
+                        f"coordinator stalls past its role lease at term "
+                        f"{term}: it is now a ZOMBIE leader whose last "
+                        f"assignment decision may still arrive late"),
+                   nxt, None)
+
+        # elect: a standby candidate claims the role lease and
+        # reconstructs assignment state from the compacted control topic —
+        # inheriting members, target AND the in-flight revoke-barrier
+        # holds/fence state. Winning the lease advances the term, so the
+        # fence can reject the superseded leader's late decisions
+        # (drop_coordinator_lease skips the lease CAS: no term advance;
+        # forget_holds_on_failover drops the inherited holds).
+        if not have_leader and standby > 0:
+            term2 = term if "drop_coordinator_lease" in self.mut \
+                else term + 1
+            p2 = pending
+            detail = (f"standby candidate wins the coordinator lease at "
+                      f"term {term2}; restores members/target/pending "
+                      f"from the compacted control topic (barrier holds "
+                      f"and fence state INHERITED)")
+            if "forget_holds_on_failover" in self.mut:
+                p2 = (-1,) * P
+                detail = (f"standby candidate wins the coordinator lease "
+                          f"at term {term2}; restores from the target map "
+                          f"alone — DROPS the in-flight revoke-barrier "
+                          f"holds")
+            if "drop_coordinator_lease" in self.mut:
+                detail = (f"standby candidate seizes leadership WITHOUT "
+                          f"the role lease: the term stays {term2}, so "
+                          f"the fence cannot tell its decisions from the "
+                          f"old leader's")
+            c2 = (1, standby - 1, czombie, term2, ccrashes, clapses)
+            nxt = (members, stale, target, p2, committed, workers,
+                   crashes, lapses, c2)
+            yield Step("coord", "elect", detail), nxt, None
+
+        # stale_assign: the zombie's delayed assignment record arrives.
+        # The term fence accepts it only while its term is still current
+        # (pre-succession it is a harmless no-op republish; post-
+        # succession zterm < term and it is REJECTED) — unless
+        # stale_term_fence_accepted breaks the fence, or
+        # drop_coordinator_lease left the terms indistinguishable.
+        if czombie is not None:
+            zterm, ztarget, zpending = czombie
+            spent = (leading, standby, None, term, ccrashes, clapses)
+            if zterm >= term or "stale_term_fence_accepted" in self.mut:
+                # With no snapshot carried (clean model), the accepted
+                # record provably republishes the live assignment — apply
+                # is the identity (see the reduction note at coord_lapse).
+                t2 = target if ztarget is None else ztarget
+                p2 = pending if zpending is None else zpending
+                nxt = (members, stale, t2, p2, committed,
+                       workers, crashes, lapses, spent)
+                yield (Step("coord", "stale_assign",
+                            f"the zombie coordinator's term-{zterm} "
+                            f"assignment decision arrives late and the "
+                            f"fence APPLIES it (current term {term}) — "
+                            f"target/pending revert to the superseded "
+                            f"deal"),
+                       nxt, None)
+            else:
+                nxt = (members, stale, target, pending, committed,
+                       workers, crashes, lapses, spent)
+                yield (Step("coord", "stale_assign",
+                            f"the zombie coordinator's term-{zterm} "
+                            f"assignment decision arrives late and the "
+                            f"term fence REJECTS it (current term "
+                            f"{term})"),
+                       nxt, None)
 
     # -- terminal loss check ----------------------------------------------
 

@@ -62,6 +62,10 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     # worker, plus the monitor thread ticking the lease coordinator.
     ("fleet/fleet.py", "self._worker_main"),
     ("fleet/fleet.py", "self._monitor_loop"),
+    # Coordinator succession (fleet/control.py, docs/fleet.md "Coordinator
+    # succession"): one standby-candidate thread per candidate id, each
+    # watching for role vacancy and contending in the term election.
+    ("fleet/fleet.py", "self._candidate_main"),
     # Sanitizer workload driver: hammer threads racing the shard ABI on
     # purpose — TSan is the detector there, not racecheck.
     ("native/san_driver.py", "hammer"),
@@ -156,6 +160,13 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
                "coordinator state lives under FleetCoordinator._lock and "
                "the bus under FleetBus._lock; the tick never touches "
                "engine/consumer state"),
+    EntryPoint("fleet-candidate", "fleet/fleet.py", "Fleet._candidate_main",
+               None,
+               "succession state lives under SuccessionCoordinator._lock "
+               "(elections additionally serialize on _elect_lock, the term "
+               "fence under TermGate._lock, the control lane under "
+               "ControlBus._lock); step() never touches engine/consumer "
+               "state"),
     EntryPoint("san-hammer", "native/san_driver.py", "hammer", None,
                "deliberately racing workload — the sanitizer runtime "
                "(ASan/TSan) is the detector"),
@@ -294,13 +305,38 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     "fleet/worker.py::FleetWorker": _spec(
         any_thread=("stop", "result", "health"),
         fleet_worker=("run", "_on_poll", "_publish")),
-    # Fleet facade: run() on the caller's thread, monitor/worker threads
-    # spawned by it; stop/fleet_health are cross-thread (Event + reads of
-    # monitor-safe surfaces).
+    # Fleet facade: run() on the caller's thread, monitor/worker/candidate
+    # threads spawned by it; stop/fleet_health are cross-thread (Event +
+    # reads of monitor-safe surfaces).
     "fleet/fleet.py::Fleet": _spec(
         any_thread=("stop", "fleet_health"),
         fleet_monitor=("_monitor_loop", "_write_health_file"),
-        fleet_worker=("_worker_main",)),
+        fleet_worker=("_worker_main",),
+        fleet_candidate=("_candidate_main",)),
+    # Succession coordinator (fleet/control.py, docs/fleet.md "Coordinator
+    # succession"): same worker-facing surface contract as the plain
+    # coordinator (workers call from their own threads), the monitor ticks
+    # the incumbent, candidate threads step the vacancy watch/election;
+    # all state under _lock, elections serialized on _elect_lock, and
+    # control.stats() is only ever called OUTSIDE the lock (acyclic lock
+    # graph, same rule as FleetCoordinator).
+    "fleet/control.py::SuccessionCoordinator": _spec(
+        any_thread=("join", "sync", "ack", "leave", "fence_lost",
+                    "assignments", "committed_lag", "last_view",
+                    "succession_report"),
+        fleet_monitor=("tick",),
+        fleet_candidate=("step",)),
+    # Control bus: a compacted-log blackboard like FleetBus — every surface
+    # callable from any thread, ordering/dedup state under ControlBus._lock
+    # (transport produce/flush happens outside it: chaos loss must not
+    # serialize publishers).
+    "fleet/control.py::ControlBus": _spec(
+        any_thread=("publish", "retry", "poll", "replay", "lamport",
+                    "lost", "stats")),
+    # Term fence: a monotonic CAS — candidates advance, everyone accepts;
+    # one lock, any thread.
+    "fleet/control.py::TermGate": _spec(
+        any_thread=("current", "try_advance", "accept")),
     # Scenario feeder (docs/scenarios.md): _run/_fire execute on the one
     # feeder thread; stats/fed/alive are the cross-thread surface
     # (counters under _lock; the error field is a write-once latch read
@@ -397,13 +433,25 @@ OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
     # Fleet seams (docs/fleet.md): the worker drives the coordinator + bus
     # from the poll path, and its consumer wrapper forwards to the
     # manual-assignment transport.
-    "fleet/worker.py::FleetWorker.coordinator": ("FleetCoordinator",),
+    "fleet/worker.py::FleetWorker.coordinator": ("FleetCoordinator",
+                                                 "SuccessionCoordinator"),
     "fleet/worker.py::FleetWorker.bus": ("FleetBus",),
     "fleet/worker.py::_FleetConsumer.inner": ("Consumer",),
     "fleet/worker.py::_FleetConsumer._worker": ("FleetWorker",),
-    "fleet/fleet.py::Fleet.coordinator": ("FleetCoordinator",),
+    "fleet/fleet.py::Fleet.coordinator": ("FleetCoordinator",
+                                          "SuccessionCoordinator"),
     "fleet/fleet.py::Fleet.bus": ("FleetBus",),
     "fleet/coordinator.py::FleetCoordinator.bus": ("FleetBus",),
+    # Succession seams (fleet/control.py): the leased-role wrapper drives
+    # the REAL coordinator it incarnates, its control lane, and the term
+    # fence; the control lane rides the broker Protocol pair.
+    "fleet/control.py::SuccessionCoordinator.coordinator":
+        ("FleetCoordinator",),
+    "fleet/control.py::SuccessionCoordinator.control": ("ControlBus",),
+    "fleet/control.py::SuccessionCoordinator.gate": ("TermGate",),
+    "fleet/control.py::SuccessionCoordinator._fleet_bus": ("FleetBus",),
+    "fleet/control.py::ControlBus._producer": ("Producer",),
+    "fleet/control.py::ControlBus._consumer": ("Consumer",),
     # Slotserve lane: the service drives its decoder from the lane thread.
     "explain/slotserve/service.py::SlotServeService._decoder": ("SlotDecoder",),
     # Learn seams (learn/, docs/online_learning.md): the engine offers
@@ -623,6 +671,66 @@ FLEET_PROTOCOLS: Tuple[RoleSpec, ...] = (
            ("fleet/coordinator.py::FleetCoordinator.tick",),
            ("bus.snapshots", "bus.publish_fleet")),
     )),
+    # Coordinator succession (fleet/control.py, docs/fleet.md "Coordinator
+    # succession"): the coordinator ROLE as a leased machine. Candidates
+    # stand by, win term elections into leadership, relay the worker
+    # surface to the incumbent coordinator they incarnate while leading,
+    # and fall back to standby (zombie demotion on a newer term) or dead
+    # (seeded kill). The `flightcheck model --succession` configuration
+    # explores exactly this machine — the Candidate.* qualnames below are
+    # the checker's ACTION_IMPLEMENTS vocabulary (analysis/checker.py).
+    RoleSpec("Candidate", "fleet/control.py::SuccessionCoordinator",
+             ("standby", "leading", "dead"), "standby", (
+        # Win the vacancy: strictly-greater term CAS, then replay the
+        # compacted control topic and reconstruct the coordinator.
+        _t("elect", "standby", "leading",
+           ("fleet/control.py::SuccessionCoordinator._elect",),
+           ("gate.try_advance", "control.replay", "_reconstruct")),
+        # State reconstruction: snapshot restore plus replay of the ops
+        # past its watermark drives the fresh incumbent through the REAL
+        # worker surface (the successor inherits barrier holds — see the
+        # restore-inherits-holds obligation below).
+        _t("restore", "standby", "leading",
+           ("fleet/control.py::SuccessionCoordinator._reconstruct",),
+           ("coordinator.join", "coordinator.ack", "coordinator.leave")),
+        # Leading: every worker-surface call relays to the incumbent.
+        _t("lead", "leading", "leading",
+           ("fleet/control.py::SuccessionCoordinator.join",),
+           ("coordinator.join",)),
+        _t("lead", "leading", "leading",
+           ("fleet/control.py::SuccessionCoordinator.sync",),
+           ("coordinator.sync",)),
+        _t("lead", "leading", "leading",
+           ("fleet/control.py::SuccessionCoordinator.ack",),
+           ("coordinator.ack",)),
+        _t("lead", "leading", "leading",
+           ("fleet/control.py::SuccessionCoordinator.leave",),
+           ("coordinator.leave",)),
+        _t("lead", "leading", "leading",
+           ("fleet/control.py::SuccessionCoordinator.tick",),
+           ("coordinator.tick",)),
+        _t("lead", "leading", "leading",
+           ("fleet/control.py::SuccessionCoordinator.committed_lag",),
+           ("coordinator.committed_lag",)),
+        # The stale-term fence: commit fencing relays to the incumbent
+        # (and answers from the granted∪held cache during an
+        # interregnum), and replay rejects snapshots from older terms.
+        _t("fence", "leading", "leading",
+           ("fleet/control.py::SuccessionCoordinator.fence_lost",),
+           ("coordinator.fence_lost",)),
+        _t("fence", "leading", "leading",
+           ("fleet/control.py::ControlBus.replay",)),
+        # Seeded leader death (stream/faults.py CoordinatorKillSpec).
+        _t("crash", "leading", "dead",
+           ("fleet/control.py::SuccessionCoordinator.tick",),
+           ("kill.tick",)),
+        # Role-lease lapse: a zombie leader discovers a newer term via
+        # the fence and demotes itself WITHOUT publishing (see the
+        # zombie-demotes-before-publish obligation below).
+        _t("lapse", "leading", "standby",
+           ("fleet/control.py::SuccessionCoordinator.tick",),
+           ("gate.accept",)),
+    )),
     # Environment: no code anchor — lease ttl elapsing is the adversary.
     RoleSpec("Environment", None, ("world",), "world", (
         _t("lapse", "world", "world"),
@@ -694,6 +802,32 @@ FLEET_BARRIER_OBLIGATIONS: Tuple[BarrierObligation, ...] = (
         first="store:_position", then="store:_committed",
         why="construction must seed positions from the group-durable "
             "offsets before anything consumes — the zero-loss handoff"),
+    BarrierObligation(
+        "restore-inherits-holds",
+        "fleet/coordinator.py::FleetCoordinator.restore_state",
+        first="store:_pending",
+        why="a successor rebuilding from a snapshot must inherit the "
+            "in-flight revoke-barrier holds, or a mid-rebalance failover "
+            "re-grants a partition its old owner is still draining "
+            "(checker invariant revoke_barrier, mutation "
+            "forget_holds_on_failover)"),
+    BarrierObligation(
+        "term-fence-before-install",
+        "fleet/control.py::SuccessionCoordinator._elect",
+        first="call:gate.try_advance", then="call:_install",
+        why="the term CAS must be won BEFORE the reconstructed "
+            "coordinator installs — two candidates racing one vacancy "
+            "otherwise both lead and double-grant (checker invariant "
+            "no_loss under mutation drop_coordinator_lease)"),
+    BarrierObligation(
+        "zombie-demotes-before-publish",
+        "fleet/control.py::SuccessionCoordinator.tick",
+        first="call:gate.accept", then="call:control.publish",
+        why="a paused-and-resumed leader must consult the term fence "
+            "BEFORE publishing beacons/snapshots stamped with its old "
+            "term — a zombie that publishes first reasserts a dead term "
+            "over the live one (checker invariant no_loss, mutation "
+            "stale_term_fence_accepted)"),
 )
 
 

@@ -83,6 +83,12 @@ CONTRACTS: Tuple[Contract, ...] = (
              "test_learn.py", "LEARN_BLOCK_SCHEMA"),
     Contract("learn/store.py", "WindowStore.snapshot",
              "test_learn.py", "LEARN_WINDOW_SCHEMA"),
+    # Coordinator succession (docs/fleet.md "Coordinator succession"):
+    # the fleet view's "coordinator" sub-object — term/leader/handoff
+    # identity, the tick pulse the coordinator_absence rule watches, and
+    # the control-lane delivery accounting.
+    Contract("fleet/coordinator.py", "FleetCoordinator._coordinator_block",
+             "test_succession.py", "COORDINATOR_BLOCK_SCHEMA"),
 )
 
 

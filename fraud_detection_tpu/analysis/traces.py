@@ -39,11 +39,15 @@ def render(result: CheckResult, cfg: CheckConfig) -> str:
     """Human-readable report for any checker outcome."""
     lines: List[str] = []
     muts = ",".join(sorted(cfg.mutations)) or "none"
-    lines.append(
-        f"flightcheck model: workers={cfg.workers} "
-        f"partitions={cfg.partitions} keys={cfg.keys_per_partition} "
-        f"crashes<={cfg.max_crashes} lapses<={cfg.max_lapses} "
-        f"mutations={muts}")
+    line = (f"flightcheck model: workers={cfg.workers} "
+            f"partitions={cfg.partitions} keys={cfg.keys_per_partition} "
+            f"crashes<={cfg.max_crashes} lapses<={cfg.max_lapses} "
+            f"mutations={muts}")
+    if cfg.candidates > 1:
+        line += (f" candidates={cfg.candidates} "
+                 f"coord_crashes<={cfg.max_coord_crashes} "
+                 f"coord_lapses<={cfg.max_coord_lapses}")
+    lines.append(line)
     lines.append(
         f"  explored {result.states} states / {result.transitions} "
         f"transitions to depth {result.depth} in {result.elapsed:.2f}s")

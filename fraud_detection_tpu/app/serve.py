@@ -283,6 +283,13 @@ def main(argv=None) -> int:
                     help="periodically dump the aggregated fleet view + "
                          "every worker's health to this path (atomic "
                          "replace; --fleet)")
+    ap.add_argument("--fleet-candidates", type=int, metavar="K", default=1,
+                    help="coordinator succession (docs/fleet.md "
+                         "'Coordinator succession'): K candidates contend "
+                         "on the leased coordinator role over the control "
+                         "lane, so the fleet survives its own coordinator "
+                         "dying (K >= 2 arms standby successors; 1 = the "
+                         "classic single coordinator; --fleet)")
     ap.add_argument("--mesh", action="store_true",
                     help="mesh data-parallel scoring (parallel/serving.py "
                          "MeshServingPipeline): shard every micro-batch "
@@ -547,6 +554,11 @@ def main(argv=None) -> int:
                          "(hot-swap candidates would load single-device)")
     if args.fleet_health_file is not None and args.fleet == 0:
         raise SystemExit("--fleet-health-file needs --fleet N")
+    if args.fleet_candidates < 1:
+        raise SystemExit(f"--fleet-candidates must be >= 1, "
+                         f"got {args.fleet_candidates}")
+    if args.fleet_candidates > 1 and args.fleet == 0:
+        raise SystemExit("--fleet-candidates needs --fleet N")
     if args.workers > 1 and args.max_messages is not None:
         # Per-worker message caps can't split a global cap meaningfully —
         # refuse BEFORE the expensive pipeline build, like every other
@@ -1080,6 +1092,7 @@ def main(argv=None) -> int:
             async_dispatch=args.async_dispatch,
             sched_config=sched_config, dlq_topic=dlq_topic,
             health_file=args.fleet_health_file,
+            candidates=args.fleet_candidates,
             trace=args.trace, trace_sample=args.trace_sample,
             **fleet_sentinel_kw)
         if metrics_registry is not None:

@@ -5,12 +5,22 @@ explicit partition leases (stream/broker.py manual-assignment consumers),
 a coordinator rebalances them with a revoke->drain->commit->reassign
 barrier on membership change and lease expiry on worker death, health
 flows over an in-process/file-backed bus, and load shedding coordinates on
-the GLOBAL backlog watermark instead of per-worker guesses.
+the GLOBAL backlog watermark instead of per-worker guesses. The
+coordinator itself is a leased role (fleet/control.py): candidates
+contend on it over a faultable control bus and a successor inherits the
+assignment state — including in-flight revoke-barrier holds — so the
+fleet survives its own brain dying.
 """
 
 from fraud_detection_tpu.fleet.bus import FleetBus
+from fraud_detection_tpu.fleet.control import (ControlBus, ControlRecord,
+                                               KafkaControlBus,
+                                               SuccessionCoordinator,
+                                               TermGate)
 from fraud_detection_tpu.fleet.coordinator import FleetCoordinator, Lease
 from fraud_detection_tpu.fleet.fleet import Fleet
 from fraud_detection_tpu.fleet.worker import FleetWorker
 
-__all__ = ["Fleet", "FleetBus", "FleetCoordinator", "FleetWorker", "Lease"]
+__all__ = ["ControlBus", "ControlRecord", "Fleet", "FleetBus",
+           "FleetCoordinator", "FleetWorker", "KafkaControlBus", "Lease",
+           "SuccessionCoordinator", "TermGate"]

@@ -88,6 +88,20 @@ class FleetCoordinator:
         self.expirations = 0
         self._last_view: Optional[dict] = None
         self._peak_backlog = 0   # max global backlog any tick aggregated
+        # Succession identity (fleet/control.py): which term/leader this
+        # coordinator instance serves under. A standalone coordinator is
+        # its own term-1 incumbent; SuccessionCoordinator._install
+        # overwrites these on every failover, and export_state/
+        # restore_state carry the assignment state between incumbents.
+        self.term = 1
+        self.leader_id = "c0"
+        self.handoffs = 0
+        self.elections = 0
+        self._ticks = 0
+        self._last_tick_at: Optional[float] = None
+        # Optional control-lane stats callable (ControlBus.stats) merged
+        # into the view's coordinator block when succession is wired.
+        self.control_stats: Optional[Callable[[], dict]] = None
 
     # ------------------------------------------------------------------
     # membership (worker threads)
@@ -163,6 +177,61 @@ class FleetCoordinator:
                        if self._pending.get(p) in (None, worker_id)}
             return [p for p in pairs if tuple(p) not in granted
                     and tuple(p) not in held]
+
+    # ------------------------------------------------------------------
+    # succession state transfer (fleet/control.py)
+    # ------------------------------------------------------------------
+
+    def export_state(self) -> dict:
+        """JSON-safe snapshot of the assignment state a successor needs:
+        membership (join order preserved), the target map, and — the part
+        a naive rebuild-from-targets drops — the revoke-barrier holds
+        (``_pending``), so a mid-rebalance failover cannot re-grant a
+        pair its draining old owner still commits on (`flightcheck
+        model` mutation ``forget_holds_on_failover``)."""
+        with self._lock:
+            return {
+                "term": self.term,
+                "generation": self._generation,
+                "join_seq": self._join_seq,
+                "members": {w: info["joined"]
+                            for w, info in self._members.items()},
+                "target": {w: sorted([t, p] for (t, p) in pairs)
+                           for w, pairs in self._target.items()},
+                "pending": sorted(
+                    [[t, p], holder]
+                    for (t, p), holder in self._pending.items()),
+                "rebalances": self.rebalances,
+                "expirations": self.expirations,
+                "ticks": self._ticks,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt an exported snapshot (the successor's first act). Every
+        restored member gets a FRESH renewal stamp: the successor cannot
+        know how stale each lease was when the old incumbent died, and
+        guessing short would expire live workers en masse — a dead
+        worker just pays one extra ``lease_ttl`` before its partitions
+        move, which the committed offsets make safe."""
+        with self._lock:
+            now = self._clock()
+            members = state.get("members") or {}
+            self._members = {w: {"renewed": now, "joined": int(joined)}
+                             for w, joined in members.items()}
+            self._join_seq = max(
+                int(state.get("join_seq") or 0),
+                max((int(j) for j in members.values()), default=-1) + 1)
+            self._target = {
+                w: {(t, p) for t, p in pairs}
+                for w, pairs in (state.get("target") or {}).items()}
+            self._pending = {
+                (t, p): holder
+                for (t, p), holder in (state.get("pending") or [])
+                if holder in self._members}
+            self._generation = int(state.get("generation") or 0)
+            self.rebalances = int(state.get("rebalances") or 0)
+            self.expirations = int(state.get("expirations") or 0)
+            self._ticks = int(state.get("ticks") or 0)
 
     # ------------------------------------------------------------------
     # assignment internals (caller holds self._lock)
@@ -263,6 +332,8 @@ class FleetCoordinator:
         with self._lock:
             if self._expire_locked(self._clock()):
                 self._rebalance_locked()
+            self._ticks += 1
+            self._last_tick_at = self._clock()
             generation = self._generation
             members = set(self._members)
             assignments = {w: sorted(pairs)
@@ -336,12 +407,42 @@ class FleetCoordinator:
             # None when no worker is tracing.
             "stage_latency_ms": (fleet_stage_latency(stage_wires)
                                  if stage_wires else None),
+            # Who is coordinating, under what term, and how the control
+            # lane is faring — the block the sentinel's coordinator
+            # rules judge (a frozen ``ticks`` counter IS the absence
+            # signal: an interregnum keeps republishing the stale view).
+            "coordinator": self._coordinator_block(),
         }
         with self._lock:
             self._last_view = view
         if self.bus is not None:
             self.bus.publish_fleet(view)
         return view
+
+    def _coordinator_block(self) -> dict:
+        """The view's ``coordinator`` block (schema pinned by
+        tests/test_succession.py COORDINATOR_BLOCK_SCHEMA, FC301):
+        succession identity + liveness + control-lane delivery health."""
+        with self._lock:
+            ticks = self._ticks
+            last = self._last_tick_at
+        age = round(self._clock() - last, 6) if last is not None else None
+        stats_fn = self.control_stats
+        control = None
+        if stats_fn is not None:
+            try:
+                control = stats_fn()
+            except Exception:  # noqa: BLE001 — observability never kills
+                control = None
+        return {
+            "term": self.term,
+            "leader": self.leader_id,
+            "handoffs": self.handoffs,
+            "elections": self.elections,
+            "ticks": ticks,
+            "last_tick_age_s": age,
+            "control": control,
+        }
 
     def last_view(self) -> Optional[dict]:
         with self._lock:

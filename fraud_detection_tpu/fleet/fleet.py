@@ -51,6 +51,10 @@ class Fleet:
                  worker_sentinel_rules=None,
                  sentinel_clock=None,
                  sentinel_recorder=None,
+                 candidates: int = 1,
+                 role_ttl: Optional[float] = None,
+                 coordinator_kill=None,
+                 control=None,
                  worker_prefix: str = "w"):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
@@ -58,9 +62,32 @@ class Fleet:
             raise ValueError(
                 f"tick_interval must be > 0, got {tick_interval}")
         self.bus = bus if bus is not None else FleetBus()
-        self.coordinator = FleetCoordinator(
-            topics, num_partitions, bus=self.bus, lease_ttl=lease_ttl,
-            lag_fn=lag_fn)
+        # Coordinator succession (fleet/control.py, docs/fleet.md):
+        # ``candidates >= 2`` (or an injected coordinator kill) replaces
+        # the single FleetCoordinator with the leased-role proxy —
+        # candidate threads contend on the role lease and a successor
+        # reconstructs assignment state from the control bus. The plain
+        # single-coordinator path is untouched otherwise.
+        if candidates > 1 or coordinator_kill is not None:
+            from fraud_detection_tpu.fleet.control import \
+                SuccessionCoordinator
+
+            if coordinator_kill is not None \
+                    and coordinator_kill.kills >= candidates:
+                raise ValueError(
+                    f"coordinator_kill.kills ({coordinator_kill.kills}) "
+                    f"must be < candidates ({candidates}): someone has "
+                    f"to survive to coordinate")
+            self.coordinator = SuccessionCoordinator(
+                topics, num_partitions, bus=self.bus, control=control,
+                lease_ttl=lease_ttl, lag_fn=lag_fn,
+                candidates=candidates, role_ttl=role_ttl,
+                kill=coordinator_kill)
+        else:
+            self.coordinator = FleetCoordinator(
+                topics, num_partitions, bus=self.bus, lease_ttl=lease_ttl,
+                lag_fn=lag_fn)
+        self.coordinator_kill = coordinator_kill
         # Fleet alerting (obs/sentinel/, docs/observability.md):
         # ``sentinel_rules`` arms a COORDINATOR-level sentinel over the
         # aggregated fleet view (global watermark burn, worker absence,
@@ -155,7 +182,11 @@ class Fleet:
                    sentinel_rules=None,
                    worker_sentinel_rules=None,
                    sentinel_clock=None,
-                   sentinel_recorder=None) -> "Fleet":
+                   sentinel_recorder=None,
+                   candidates: int = 1,
+                   role_ttl: Optional[float] = None,
+                   coordinator_kill=None,
+                   control=None) -> "Fleet":
         """A fleet over an InProcessBroker: assigned consumers with the
         coordinator's commit fence, group-lag drain signal, one shared
         scoring pipeline, and (with ``sched_config``) a per-worker adaptive
@@ -230,7 +261,9 @@ class Fleet:
             sentinel_rules=sentinel_rules,
             worker_sentinel_rules=worker_sentinel_rules,
             sentinel_clock=sentinel_clock,
-            sentinel_recorder=sentinel_recorder)
+            sentinel_recorder=sentinel_recorder,
+            candidates=candidates, role_ttl=role_ttl,
+            coordinator_kill=coordinator_kill, control=control)
         fleet_holder["fleet"] = fleet
         return fleet
 
@@ -277,6 +310,19 @@ class Fleet:
                 self.sentinel.evaluate()
             self._write_health_file()
 
+    def _candidate_main(self, cid: str) -> None:
+        """One coordinator candidate's contention loop (fleet/control.py):
+        poll the role lease for vacancy (stale beacon past role_ttl, or
+        an abdication) and elect when it opens. Harmless while standby —
+        ``step`` is a no-op for a live incumbent."""
+        coordinator = self.coordinator
+        interval = max(0.01, coordinator.role_ttl / 8.0)
+        while not self._stop.wait(interval):
+            try:
+                coordinator.step(cid)
+            except Exception:  # noqa: BLE001 — candidates must keep running
+                log.exception("fleet candidate %s election pass failed", cid)
+
     def _worker_main(self, worker: FleetWorker,
                      idle_timeout: Optional[float]) -> None:
         try:
@@ -302,6 +348,14 @@ class Fleet:
         monitor = threading.Thread(target=self._monitor_loop,
                                    name="fleet-monitor", daemon=True)
         monitor.start()
+        candidate_threads: List[threading.Thread] = []
+        if hasattr(self.coordinator, "candidate_ids"):
+            candidate_threads = [
+                threading.Thread(target=self._candidate_main, args=(cid,),
+                                 name=f"fleet-candidate-{cid}", daemon=True)
+                for cid in self.coordinator.candidate_ids]
+            for t in candidate_threads:
+                t.start()
         self._threads = [
             threading.Thread(target=self._worker_main,
                              args=(w, idle_timeout),
@@ -321,6 +375,8 @@ class Fleet:
         finally:
             self._stop.set()
             monitor.join(timeout=5.0)
+            for t in candidate_threads:
+                t.join(timeout=5.0)
         wall = time.perf_counter() - t0
         try:
             final_view = self.coordinator.tick()   # post-run aggregate
@@ -348,6 +404,11 @@ class Fleet:
         }
         if self.death_plan is not None:
             out["death_plan"] = self.death_plan.report()
+        if hasattr(self.coordinator, "succession_report"):
+            succession = self.coordinator.succession_report()
+            if self.coordinator_kill is not None:
+                succession["kill_plan"] = self.coordinator_kill.report()
+            out["succession"] = succession
         if self.sentinel is not None:
             # Final pass AFTER the post-run tick above, so membership
             # drops and last-tick watermarks are judged before the

@@ -401,11 +401,23 @@ def default_rule_pack(*, fast_s: float = 30.0, slow_s: float = 120.0,
 
 def fleet_rule_pack(*, backlog_limit: float = 5000.0,
                     for_s: float = 0.0, resolve_s: float = 10.0,
-                    fast_s: float = 30.0, slow_s: float = 120.0
+                    fast_s: float = 30.0, slow_s: float = 120.0,
+                    stale_s: Optional[float] = None
                     ) -> Tuple[AlertRule, ...]:
     """Coordinator-level rules over the aggregated fleet view
     (``FleetCoordinator.tick``'s block under ``"fleet"``) plus the
-    per-worker alert states riding the bus."""
+    per-worker alert states riding the bus.
+
+    ``stale_s`` (default ``fast_s``) is the staleness window for
+    ``coordinator_absence`` alone. The two window kinds pull in opposite
+    directions: a DELTA rule's window is how long a one-off event (a
+    membership drop) stays observable, so wider is safer under sparse
+    sampling — but a STALE rule only fires once the counter sat frozen
+    for the WHOLE window, so it must stay shorter than the outage it
+    exists to catch (an interregnum lasts ~``role_ttl`` plus one
+    election; docs/fleet.md "Coordinator succession")."""
+    if stale_s is None:
+        stale_s = fast_s
     return (
         # The GLOBAL backlog watermark burning past the shed threshold's
         # neighborhood: the whole fleet is drowning, not one worker.
@@ -433,4 +445,29 @@ def fleet_rule_pack(*, backlog_limit: float = 5000.0,
                   resolve_s=resolve_s, fast_s=fast_s, slow_s=slow_s,
                   description="a worker-level sentinel is firing "
                               "(aggregated from the fleet bus)"),
+        # The coordinator's tick counter stopped WHILE committed work
+        # remains: the fleet's brain is dead (or partitioned off the
+        # control lane) mid-stream. Gated exactly like worker_absence —
+        # an interregnum after a clean drain is not an incident. During
+        # a real interregnum the succession proxy keeps republishing the
+        # dead incumbent's LAST view (fleet/control.py), so the frozen
+        # ``fleet.coordinator.ticks`` is precisely the absence signal;
+        # the coordinator_kill game day gates detects_within on this.
+        AlertRule("coordinator_absence", "stale",
+                  path="fleet.coordinator.ticks",
+                  while_path="fleet.committed_lag",
+                  severity="critical", fast_s=stale_s, slow_s=slow_s,
+                  resolve_s=resolve_s,
+                  description="coordinator ticks stalled while work "
+                              "remained — coordinator death or control-"
+                              "lane partition (docs/fleet.md)"),
+        # The role changed hands twice inside the window: an election
+        # storm (flapping incumbents, a term war), not a one-off
+        # failover — one clean succession must NOT fire this.
+        AlertRule("failover_churn", "delta",
+                  path="fleet.coordinator.handoffs", op=">=", limit=2,
+                  severity="warning", fast_s=fast_s, slow_s=slow_s,
+                  resolve_s=resolve_s,
+                  description="repeated coordinator handoffs inside the "
+                              "window — election churn (docs/fleet.md)"),
     )

@@ -103,6 +103,22 @@ class KillSpec:
 
 
 @dataclass(frozen=True)
+class CoordKillSpec:
+    """Seeded coordinator-leader deaths (stream/faults.py
+    CoordinatorKillSpec); the seed derives from the scenario clock. Kill
+    ticks count LEADER ticks, so a second kill lands on the successor —
+    ``kills=2`` scripts consecutive failovers. Crash mode leaves no
+    dying-breath snapshot: detection waits out ``role_ttl``, which is
+    why the catalog's crash scenarios keep role_ttl above the sentinel's
+    fast window (the stale rule must see frozen ticks span it)."""
+
+    kills: int = 1
+    modes: Tuple[str, ...] = ("graceful", "crash")
+    min_ticks: int = 3
+    max_ticks: int = 10
+
+
+@dataclass(frozen=True)
 class ExpectedDetection:
     """One seeded fault class and the alert that must catch it: the
     sentinel gate asserts rule ``rule`` FIRES within ``within_s``
@@ -152,8 +168,23 @@ class SentinelSpec:
         # artifacts (a warp feed enqueues the whole timeline at once, so
         # enqueue->produce latency legitimately reaches seconds).
         if fleet_mode:
-            return fleet_rule_pack(backlog_limit=20000.0, fast_s=2.0,
-                                   slow_s=8.0, resolve_s=1.0)
+            # fast_s is also the delta-observation window: a worker-death
+            # membership drop (-1) stays judgeable for fast_s virtual
+            # seconds. The sentinel samples from a plain Python thread,
+            # and on a 1-core host the GIL-releasing compute threads can
+            # starve it for whole wall-seconds mid-drain — a 2 s window
+            # can close between two samples while the while-gate's
+            # backlog still exists. 8 s keeps the drop in-window for the
+            # rest of a catalog run without loosening the gate itself
+            # (the clean-drain exit still never fires: its drop happens
+            # at committed_lag == 0, and the gate is judged at the
+            # CURRENT sample). coordinator_absence is the opposite kind
+            # of window — stale only fires once ticks sat frozen for the
+            # WHOLE span, so it must stay shorter than the interregnum
+            # it catches (~role_ttl); hence the separate stale_s.
+            return fleet_rule_pack(backlog_limit=20000.0, fast_s=8.0,
+                                   slow_s=16.0, resolve_s=1.0,
+                                   stale_s=2.0)
         return default_rule_pack(fast_s=1.0, slow_s=4.0, for_s=0.0,
                                  resolve_s=1.0, p99_ms=60000.0,
                                  stall_s=30.0, dlq_limit=0.0005)
@@ -226,6 +257,16 @@ class GameDay:
     sched: Optional[object] = None        # sched.SchedulerConfig
     dlq: bool = False
     kills: Optional[KillSpec] = None
+    # Coordinator succession (fleet/control.py, docs/fleet.md
+    # "Coordinator succession"): candidates >= 2 runs the fleet under a
+    # SuccessionCoordinator — the coordinator role itself is leased and
+    # coordinator_kills scripts the leader's death; a standby candidate
+    # must win the term election and inherit assignment state from the
+    # compacted control topic. role_ttl is the vacancy-detection window
+    # (defaults to lease_ttl / 2 inside the coordinator).
+    candidates: int = 1
+    role_ttl: Optional[float] = None
+    coordinator_kills: Optional[CoordKillSpec] = None
     chaos: Optional[ChaosSpec] = None
     hot_swap_at: Optional[float] = None   # virtual seconds
     breaker_threshold: Optional[int] = None
@@ -277,6 +318,25 @@ class GameDay:
             raise ValueError(
                 f"game day {self.name!r}: worker kills need the fleet "
                 "runner (workers >= 2)")
+        if self.candidates < 1:
+            raise ValueError(
+                f"candidates must be >= 1, got {self.candidates}")
+        if not self.fleet_mode and (self.candidates > 1
+                                    or self.coordinator_kills is not None):
+            raise ValueError(
+                f"game day {self.name!r}: coordinator succession needs "
+                "the fleet runner (workers >= 2)")
+        if self.coordinator_kills is not None:
+            if self.candidates < 2:
+                raise ValueError(
+                    f"game day {self.name!r}: killing the coordinator "
+                    "needs a standby to succeed it (candidates >= 2)")
+            if self.coordinator_kills.kills >= self.candidates:
+                raise ValueError(
+                    f"game day {self.name!r}: "
+                    f"{self.coordinator_kills.kills} coordinator kills "
+                    f"with {self.candidates} candidates leaves nobody to "
+                    "coordinate")
         if self.breaker_threshold is not None and self.explain_slots is not None:
             raise ValueError(
                 f"game day {self.name!r}: breaker_threshold scripts a DEAD "
@@ -526,7 +586,8 @@ def run_gameday(gd: GameDay, *, pipeline=None, time_scale: float = 0.0,
 def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
                plan, clock: ScenarioClock) -> dict:
     from fraud_detection_tpu.fleet import Fleet
-    from fraud_detection_tpu.stream.faults import WorkerDeathPlan
+    from fraud_detection_tpu.stream.faults import (CoordinatorKillSpec,
+                                                   WorkerDeathPlan)
 
     death_plan = None
     if gd.kills is not None:
@@ -534,6 +595,13 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         death_plan = WorkerDeathPlan(
             seed=clock.derive_seed("deaths"), kills=k.kills,
             min_polls=k.min_polls, max_polls=k.max_polls, modes=k.modes)
+    coord_kill = None
+    if gd.coordinator_kills is not None:
+        ck = gd.coordinator_kills
+        coord_kill = CoordinatorKillSpec(
+            seed=clock.derive_seed("coordinator_kills"), kills=ck.kills,
+            min_ticks=ck.min_ticks, max_ticks=ck.max_ticks,
+            modes=ck.modes)
     dlq_topic = DLQ_TOPIC if (gd.dlq or (
         gd.sched is not None and gd.sched.shed_policy != "none")) else None
     sentinel_kw = {}
@@ -553,6 +621,8 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         sched_config=gd.sched, dlq_topic=dlq_topic,
         death_plan=death_plan, lease_ttl=gd.lease_ttl,
         heartbeat_interval=0.02, tick_interval=0.02,
+        candidates=gd.candidates, role_ttl=gd.role_ttl,
+        coordinator_kill=coord_kill,
         fault_plan=plan, trace=True, trace_sample=1.0, **sentinel_kw)
     feeder.start()
     _wait_for_feed(feeder, n=min(64, len(feeder.events)))
@@ -579,6 +649,7 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         "traces": [t.snapshot() for t in fleet.tracers.values()],
         "alerts": out.get("alerts"),
         "worker_alerts": out.get("worker_alerts"),
+        "succession": out.get("succession"),
     }
 
 
@@ -1021,6 +1092,85 @@ def _campaign_kill_swap(seed: int, scale: float) -> GameDay:
         ))
 
 
+def _coordinator_kill(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="coordinator_kill",
+        description="The succession game day: a crash-mode coordinator "
+                    "kill mid-campaign — while a seeded worker crash "
+                    "holds committed work in flight — forces a standby "
+                    "candidate to win the term election and reconstruct "
+                    "assignment state from the compacted control topic; "
+                    "zero-loss/zero-dup accounting must hold across the "
+                    "interregnum and the coordinator_absence watchdog "
+                    "must catch the dead brain.",
+        seed=seed,
+        workers=3,
+        partitions=6,
+        candidates=3,
+        # Crash mode only: a graceful abdication leaves a dying-breath
+        # snapshot and a near-zero interregnum, which the stale rule
+        # cannot see. The crash leaves frozen coordinator ticks that the
+        # watchdog must notice the hard way — by waiting out role_ttl.
+        coordinator_kills=CoordKillSpec(kills=1, modes=("crash",),
+                                        min_ticks=3, max_ticks=10),
+        # A crash-killed WORKER keeps committed lag pinned above zero
+        # through the interregnum (its lease cannot expire while the
+        # coordinator is dead): that stuck lag is the while-gate
+        # separating "brain dead with work remaining" from a clean
+        # drain's legitimately idle coordinator. The pin must be
+        # STRUCTURAL, not lucky: the coordinator dies within its first
+        # few 20 ms ticks, long before the worker's ~1 s lease could
+        # expire, and the worker dies within its first 3 polls — at
+        # batch_size 64 that is at most 192 rows consumed against the
+        # ~290 its two partitions carry at gate scale, so it always
+        # leaves unreassignable backlog behind. Without that floor
+        # (e.g. at the default 256-row batches) a single early poll can
+        # drain the doomed worker's partitions entirely, the fleet
+        # finishes inside role_ttl, and the run exits with no election
+        # to judge.
+        kills=KillSpec(kills=1, modes=("crash",), min_polls=2,
+                       max_polls=3),
+        batch_size=64,
+        lease_ttl=1.0,
+        # The vacancy window must OUTLAST the sentinel's fast stale
+        # window (2.0 virtual s at game-day scaling): coordinator ticks
+        # stay frozen for the whole role_ttl, so the stale rule sees a
+        # genuinely spanned window before a successor revives the pulse.
+        role_ttl=2.8,
+        sentinel=SentinelSpec(expect=(
+            ExpectedDetection("coordinator_absence", fault_at_s=0.0,
+                              within_s=60.0),)),
+        traffic=(
+            SteadyLoad(name="baseline", rate=260 * scale, duration_s=4.0,
+                       scam_fraction=0.15),
+            CampaignWave(name="campaign", at_s=0.6, duration_s=2.9,
+                         wave_rate=800 * scale, waves=2, wave_s=0.7,
+                         gap_s=0.5),
+        ),
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            SloSpec("worker_killed", path="deaths", op="==", limit=1,
+                    scope="gameday"),
+            SloSpec("coordinator_killed",
+                    path="succession.kill_plan.killed.0.mode", op="==",
+                    limit="crash", scope="gameday"),
+            SloSpec("election_won", path="succession.elections", op=">=",
+                    limit=1, scope="gameday"),
+            SloSpec("term_advanced", path="succession.term", op=">=",
+                    limit=2, scope="gameday"),
+            # Wall-clock failover bound: vacancy detection (role_ttl)
+            # plus election plus state reconstruction, with generous
+            # headroom for slow CI hosts.
+            SloSpec("failover_bounded_s",
+                    path="succession.handoffs.0.failover_s", op="<=",
+                    limit=30.0, scope="gameday"),
+            SloSpec("control_zero_loss", path="succession.control.lost",
+                    op="==", limit=0, scope="gameday"),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
 def _campaign_explain(seed: int, scale: float) -> GameDay:
     return GameDay(
         name="campaign_explain",
@@ -1202,6 +1352,7 @@ CATALOG: dict = {
     "campaign_explain": _campaign_explain,
     "campaign_kill_swap": _campaign_kill_swap,
     "chaos_storm": _chaos_storm,
+    "coordinator_kill": _coordinator_kill,
     "diurnal_hotkey": _diurnal_hotkey,
     "drift_shift": _drift_shift,
 }

@@ -26,6 +26,9 @@ Consumer/Producer protocols and inject, on a reproducible schedule:
   ``ChaosProducer.lost`` for invariant accounting).
 * **flush crashes** — ``flush()`` raises ``ConnectionError`` with the whole
   buffer still undelivered (broker gone mid-batch).
+* **delivery reorder** — a flushed batch lands rotated out of publish
+  order (the control-lane adversary: fleet/control.py absorbs it with
+  per-sender sequences + lamport-ordered replay).
 * **commit fences** — ``CommitFailedError`` from commits (a group rebalance
   landing between produce and commit; the engine treats it as routine and
   the batch replays on the next incarnation).
@@ -76,6 +79,12 @@ class FaultPlan:
     flush_fail_rate: float = 0.0
     flush_crash_rate: float = 0.0
     commit_fence_rate: float = 0.0
+    # Delivery reorder: a flushed batch lands rotated (records delivered
+    # out of publish order). Harmless to the data lane's per-partition
+    # offsets; on the CONTROL lane (fleet/control.py) it is the
+    # out-of-order-records adversary the per-sender sequence numbers and
+    # lamport-ordered replay exist to absorb.
+    reorder_rate: float = 0.0
     max_faults: Optional[int] = None
     sleep: Callable[[float], None] = time.sleep
     injected: Dict[str, int] = field(default_factory=dict)
@@ -222,6 +231,78 @@ class WorkerDeathPlan:
                                for w, m, p in self.killed]}
 
 
+class CoordinatorKilled(RuntimeError):
+    """An injected death of the fleet's COORDINATOR (fleet/control.py).
+    Raised out of the incumbent's own ``tick`` path. ``mode`` is
+    "graceful" (dying breath: final snapshot + abdication record — the
+    successor elects immediately) or "crash" (the incumbent just stops
+    beaconing; candidates only deduce the vacancy after ``role_ttl`` of
+    silence — the detection delay a real deployment pays)."""
+
+    def __init__(self, coordinator_id: str, mode: str):
+        self.coordinator_id = coordinator_id
+        self.mode = mode
+        super().__init__(
+            f"chaos: coordinator {coordinator_id!r} killed ({mode})")
+
+
+@dataclass
+class CoordinatorKillSpec:
+    """A seeded schedule of coordinator deaths — :class:`WorkerDeathPlan`
+    for the fleet's brain. Each kill draws, deterministically from one
+    seeded rng, after how many LEADER ticks the incumbent dies and how
+    (graceful abdication vs crash). The tick counter resets after each
+    kill, so ``kills=2`` exercises consecutive failovers: the successor
+    runs its drawn span and then dies too."""
+
+    seed: int = 0
+    kills: int = 1
+    min_ticks: int = 5
+    max_ticks: int = 40
+    modes: tuple = ("graceful", "crash")
+
+    def __post_init__(self):
+        if self.kills < 0:
+            raise ValueError(f"kills must be >= 0, got {self.kills}")
+        if not 0 < self.min_ticks <= self.max_ticks:
+            raise ValueError(
+                f"need 0 < min_ticks <= max_ticks, got "
+                f"{self.min_ticks}/{self.max_ticks}")
+        if not self.modes:
+            raise ValueError("modes must not be empty")
+        self._rng = random.Random(self.seed)
+        self._ticks = 0
+        self._next: Optional[tuple] = None      # (at_tick, mode), lazy
+        self.killed: List[tuple] = []           # (coordinator, mode, at_tick)
+        self._lock = threading.Lock()
+
+    def tick(self, coordinator_id: str) -> None:
+        """One tick by the CURRENT incumbent; raises CoordinatorKilled at
+        the drawn tick (then re-draws for the next incumbent while kills
+        remain)."""
+        with self._lock:
+            if len(self.killed) >= self.kills:
+                return
+            if self._next is None:
+                at = self._rng.randint(self.min_ticks, self.max_ticks)
+                mode = self.modes[self._rng.randrange(len(self.modes))]
+                self._next = (at, mode)
+            self._ticks += 1
+            at, mode = self._next
+            if self._ticks < at:
+                return
+            self.killed.append((coordinator_id, mode, at))
+            self._next = None
+            self._ticks = 0
+        raise CoordinatorKilled(coordinator_id, mode)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {"kills_planned": self.kills,
+                    "killed": [{"coordinator": c, "mode": m, "at_tick": t}
+                               for c, m, t in self.killed]}
+
+
 def _corrupt(msg: Message) -> Message:
     """A copy of ``msg`` with an undecodable value and everything else —
     key, partition, offset — intact, so commit accounting and key-set
@@ -335,6 +416,12 @@ class ChaosProducer:
             self.inner.flush(timeout)
             return len(lost_idx)
         records, self._buffer = self._buffer, []
+        if len(records) > 1 and self.plan.fire("reorder",
+                                               self.plan.reorder_rate):
+            # Deterministic rotation: every record still arrives exactly
+            # once, just out of publish order.
+            k = 1 + self.plan.pick(len(records) - 1)
+            records = records[k:] + records[:k]
         self._deliver(records)
         return self.inner.flush(timeout)
 

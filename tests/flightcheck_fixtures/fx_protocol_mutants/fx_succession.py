@@ -1,0 +1,27 @@
+"""Protocol mutant: a successor coordinator forgetting the barrier holds.
+
+The checker mutation ``forget_holds_on_failover`` gives this shape its
+dynamic counterexample (invariant ``revoke_barrier``); statically, FC503's
+``restore-inherits-holds`` obligation must flag that state reconstruction
+rebuilds membership and targets but never repopulates the pending-hold
+map — a mid-rebalance failover would re-grant a partition its old owner
+is still draining."""
+
+
+class MutantCoordinator:
+    def __init__(self):
+        self._members = {}
+        self._target = {}
+        self._pending = {}
+
+    def restore_state(self, state):
+        # VIOLATION FC503 restore-inherits-holds: the snapshot's pending
+        # holds are dropped on the floor — the successor inherits who is
+        # where but not WHO IS STILL DRAINING WHAT, so the revoke barrier
+        # evaporates across the failover.
+        now = self._clock()
+        self._members = {w: {"joined": j, "renewed": now}
+                         for w, j in state["members"].items()}
+        self._target = {w: {tuple(p) for p in pairs}
+                        for w, pairs in state["target"].items()}
+        self._generation = state["generation"]

@@ -1,0 +1,628 @@
+"""Coordinator succession (fraud_detection_tpu/fleet/control.py, docs/fleet.md
+"Coordinator succession").
+
+Pins the subsystem's defining invariants:
+
+* the control lane: per-sender sequence dedup, honest loss accounting over
+  a genuinely lossy transport (ChaosProducer flush failures eat records for
+  real), reorder absorption via lamport-ordered replay, compacted-topic
+  semantics (winning snapshot + ops past its watermark), stale-term
+  snapshot rejection — and at-least-once redelivery staying idempotent;
+* the term fence: strictly-monotonic compare-and-swap elections, stale
+  terms refused;
+* the role lease: crash failover only after ``role_ttl`` of beacon
+  silence, graceful abdication electing immediately off the dying-breath
+  snapshot, the interregnum worker surface (cached leases, granted ∪ held
+  commit fences, ops that outlive the brain), revoke-barrier holds
+  inherited across the handoff, consecutive failovers, and the zombie
+  incumbent demoting WITHOUT publishing at a fenced term;
+* the fleet view's ``coordinator`` block schema (COORDINATOR_BLOCK_SCHEMA
+  — the FC301 contract for analysis/health.py);
+* the model checker's succession environment: every action (worker AND
+  coordinator chaos composed) fires under one small exhaustive config, and
+  the succession mutations die with counterexamples through the CLI;
+* live proof: the ``coordinator_kill`` game day passes end-to-end, its
+  clean control arm records zero incidents, and a real fleet run leaves
+  ``coordinator_absence`` in the incident flight recorder.
+"""
+
+import json
+from dataclasses import replace
+
+import pytest
+
+from fraud_detection_tpu.fleet import Fleet, FleetCoordinator
+from fraud_detection_tpu.fleet.control import (CANDIDATE_KINDS,
+                                               CONTROL_KINDS, WORKER_OPS,
+                                               ControlBus, ControlRecord,
+                                               SuccessionCoordinator,
+                                               TermGate)
+from fraud_detection_tpu.stream import InProcessBroker
+from fraud_detection_tpu.stream.faults import (ChaosProducer,
+                                               CoordinatorKillSpec,
+                                               FaultPlan, WorkerDeathPlan)
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# the FC301 contract: the fleet view's "coordinator" block
+# (analysis/health.py cross-checks FleetCoordinator._coordinator_block
+# against this dict literal — keep them in lockstep)
+# ---------------------------------------------------------------------------
+
+COORDINATOR_BLOCK_SCHEMA = {
+    "term": (int,),
+    "leader": (str, type(None)),
+    "handoffs": (int,),
+    "elections": (int,),
+    "ticks": (int,),
+    "last_tick_age_s": (int, float, type(None)),
+    "control": (dict, type(None)),
+}
+
+
+def assert_coordinator_block(block):
+    assert set(block) == set(COORDINATOR_BLOCK_SCHEMA)
+    for key, types in COORDINATOR_BLOCK_SCHEMA.items():
+        assert isinstance(block[key], types), (key, block[key])
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    return synthetic_demo_pipeline(batch_size=64, n=300, seed=3,
+                                   num_features=1024,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
+
+
+def feed(broker, n, topic="in"):
+    producer = broker.producer()
+    for i in range(n):
+        producer.produce(topic,
+                         json.dumps({"text": f"hello dialogue {i}",
+                                     "id": i}).encode(),
+                         key=str(i).encode())
+
+
+class _Clock:
+    """Deterministic monotonic clock for driving role-lease timeouts."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# control records + the in-memory wire
+# ---------------------------------------------------------------------------
+
+def test_control_record_roundtrip_and_rejects_garbage():
+    rec = ControlRecord("join", "w1", 4, 2, 17, {"a": 1})
+    assert rec.key() == "join:w1"
+    assert ControlRecord.from_dict(json.loads(json.dumps(rec.as_dict()))) \
+        == rec
+    assert ControlRecord.from_dict({"kind": "join"}) is None
+    assert ControlRecord.from_dict({"kind": "join", "sender": "w", "seq":
+                                    "x", "term": 0, "lamport": 1}) is None
+    assert set(WORKER_OPS) < set(CONTROL_KINDS)
+    assert set(CANDIDATE_KINDS) < set(CONTROL_KINDS)
+    with pytest.raises(ValueError, match="both"):
+        ControlBus(producer=object())
+
+
+def test_in_memory_publish_poll_dedup_and_stats():
+    bus = ControlBus()
+    recs = [bus.publish("sync", "w0", {"i": i}) for i in range(3)]
+    assert [r.seq for r in recs] == [1, 2, 3]
+    assert [r.lamport for r in recs] == [1, 2, 3]
+    accepted = bus.poll()
+    assert accepted == recs
+    # at-least-once redelivery: the per-sender seq drops the copy and
+    # keeps the counters honest.
+    bus.retry(recs[1])
+    bus.retry(recs[1])
+    assert bus.poll() == []
+    s = bus.stats()
+    assert set(s) == {"published", "delivered", "lost",
+                      "duplicates_dropped", "reordered",
+                      "stale_snapshots_rejected", "log", "compactions"}
+    assert s["published"] == 3 and s["delivered"] == 3
+    assert s["duplicates_dropped"] == 2 and s["lost"] == 0
+
+
+def test_replay_picks_newest_term_snapshot_and_rejects_stale():
+    bus = ControlBus()
+    bus.publish("join", "w0", term=1)
+    snap1_mark = bus.lamport()
+    bus.publish("snapshot", "c0", {"state": {"g": 1},
+                                   "watermark": snap1_mark}, term=1)
+    bus.publish("sync", "w0", term=1)
+    snap2_mark = bus.lamport()
+    snap2 = bus.publish("snapshot", "c1", {"state": {"g": 2},
+                                           "watermark": snap2_mark}, term=2)
+    # The zombie dying breath: an OLD term published LATE (higher
+    # lamport) must lose to the newer-term snapshot, and be counted.
+    bus.publish("snapshot", "c0", {"state": {"g": "zombie"},
+                                   "watermark": bus.lamport()}, term=1)
+    op = bus.publish("ack", "w0", term=2)
+    bus.poll()
+    best, ops = bus.replay()
+    assert best == snap2 and best.payload["state"] == {"g": 2}
+    assert ops == [op]          # only worker ops PAST the winning watermark
+    assert bus.stats()["stale_snapshots_rejected"] == 1
+
+
+def test_compaction_keeps_snapshot_plus_uncovered_ops():
+    bus = ControlBus()
+    for i in range(4200):
+        bus.publish("sync", f"w{i % 3}")
+    mark = bus.lamport()
+    bus.publish("snapshot", "c0", {"state": {"g": 9}, "watermark": mark},
+                term=1)
+    tail = [bus.publish("ack", f"w{i}") for i in range(3)]
+    bus.poll()
+    s = bus.stats()
+    assert s["compactions"] >= 1
+    assert s["log"] <= 4096 and s["delivered"] == 4204
+    best, ops = bus.replay()
+    assert best is not None and best.payload["state"] == {"g": 9}
+    assert ops == tail          # everything the snapshot covers compacted away
+    assert s["lost"] == 0
+
+
+def test_term_gate_cas_and_stale_fence():
+    gate = TermGate()
+    assert gate.current() == 0
+    assert gate.try_advance(1) and gate.current() == 1
+    assert not gate.try_advance(1)      # racing candidates elect once
+    assert gate.accept(1)
+    assert gate.try_advance(3)
+    assert not gate.accept(1) and not gate.accept(2)
+    assert gate.accept(3) and gate.accept(4)
+
+
+# ---------------------------------------------------------------------------
+# the control lane under chaos (the PR 1 vocabulary on the CONTROL plane)
+# ---------------------------------------------------------------------------
+
+def test_control_bus_over_lossy_broker_counts_loss_honestly():
+    broker = InProcessBroker(num_partitions=1)
+    plan = FaultPlan(seed=7, flush_fail_rate=0.4)
+    tx = ControlBus(producer=ChaosProducer(broker.producer(), plan),
+                    consumer=broker.consumer(["__fleet_control"], "tx"))
+    rx = ControlBus(producer=broker.producer(),
+                    consumer=broker.consumer(["__fleet_control"], "rx"))
+    for _ in range(60):
+        tx.publish("sync", "w0")        # losses swallowed: lossy is normal
+    rx.poll()
+    s = rx.stats()
+    assert s["delivered"] < 60          # the wire really ate records
+    assert s["lost"] >= 1               # gaps below the high watermark
+    assert s["delivered"] + s["lost"] <= 60
+    _, ops = rx.replay()
+    assert [r.seq for r in ops] == sorted(r.seq for r in ops)
+
+
+def test_control_bus_absorbs_delivery_reorder():
+    broker = InProcessBroker(num_partitions=1)
+    plan = FaultPlan(seed=3, reorder_rate=1.0, max_faults=1)
+    chaos = ChaosProducer(broker.producer(), plan)
+    stamper = ControlBus()              # stamps seq/lamport; wire unused
+    recs = [stamper.publish("sync", "w0", {"i": i}) for i in range(6)]
+    for r in recs:
+        chaos.produce("__fleet_control", json.dumps(r.as_dict()).encode(),
+                      key=r.key().encode())
+    chaos.flush()                       # one batch, delivered rotated
+    rx = ControlBus(producer=broker.producer(),
+                    consumer=broker.consumer(["__fleet_control"], "rx"))
+    got = rx.poll()
+    assert len(got) == 6 and rx.stats()["lost"] == 0
+    assert [r.seq for r in got] != [1, 2, 3, 4, 5, 6]
+    assert rx.stats()["reordered"] >= 1     # detected, accepted
+    _, ops = rx.replay()
+    # lamport-ordered replay restores publish order for the successor
+    assert [r.seq for r in ops] == [1, 2, 3, 4, 5, 6]
+
+
+def test_duplicate_delivery_over_broker_dropped():
+    broker = InProcessBroker(num_partitions=1)
+    tx = ControlBus(producer=broker.producer(),
+                    consumer=broker.consumer(["__fleet_control"], "tx"))
+    rx = ControlBus(producer=broker.producer(),
+                    consumer=broker.consumer(["__fleet_control"], "rx"))
+    recs = [tx.publish(kind, "w0") for kind in ("join", "sync", "ack")]
+    for r in recs:
+        tx.retry(r)                     # at-least-once: every record twice
+    got = rx.poll()
+    assert [(r.kind, r.seq) for r in got] == [("join", 1), ("sync", 2),
+                                              ("ack", 3)]
+    s = rx.stats()
+    assert s["duplicates_dropped"] == 3 and s["lost"] == 0
+    _, ops = rx.replay()
+    assert len(ops) == 3                # replay sees each op exactly once
+
+
+# ---------------------------------------------------------------------------
+# the leased role: SuccessionCoordinator
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_leader_and_coordinator_block_schema():
+    clock = _Clock()
+    sc = SuccessionCoordinator(["in"], 4, candidates=2, clock=clock,
+                               wall=clock)
+    sc.join("w0")
+    clock.advance(0.05)
+    block = sc.tick()["coordinator"]
+    assert_coordinator_block(block)
+    assert block["term"] == 1 and block["leader"] == "c0"
+    assert block["handoffs"] == 0 and isinstance(block["control"], dict)
+    # the plain single-coordinator fleet serves the SAME block shape
+    # (control None — no lane to account for)
+    fc = FleetCoordinator(["in"], 2)
+    fc.join("w0")
+    legacy = fc.tick()["coordinator"]
+    assert_coordinator_block(legacy)
+    assert legacy["control"] is None
+
+
+def test_crash_failover_reconstructs_state_and_inherits_holds():
+    clock = _Clock()
+    kill = CoordinatorKillSpec(seed=0, kills=1, min_ticks=2, max_ticks=2,
+                               modes=("crash",))
+    sc = SuccessionCoordinator(["in"], 2, lease_ttl=60.0, candidates=2,
+                               role_ttl=1.0, kill=kill, clock=clock,
+                               wall=clock)
+    l0 = sc.join("w0")
+    assert len(l0.partitions) == 2
+    l1 = sc.join("w1")                  # rebalance: one pair moves, held
+    assert l1.partitions == () and len(l1.pending) == 1
+    moved = tuple(l1.pending[0])
+    clock.advance(0.1)
+    sc.tick()                           # beacon + snapshot (holds inside)
+    clock.advance(0.1)
+    sc.tick()                           # CoordinatorKilled(crash) at tick 2
+    assert sc.coordinator is None and sc.leader_id is None
+    assert kill.report()["killed"][0]["mode"] == "crash"
+
+    # -- interregnum: the dead leader's last word stands, unmutated --
+    assert sc.step("c1") is False       # beacon not yet stale past role_ttl
+    cached = sc.sync("w0")
+    assert {tuple(p) for p in cached.partitions} >= {moved}
+    assert sc.fence_lost("w0", [moved]) == []       # draining owner commits
+    assert sc.fence_lost("w1", [moved]) == [moved]  # withheld target fenced
+    assert sc.assignments()["w0"]       # observability from the lease cache
+
+    # -- the successor: role_ttl of silence, then election + replay --
+    clock.advance(1.5)
+    assert sc.step("c1") is True
+    assert sc.term == 2 and sc.leader_id == "c1"
+    report = sc.succession_report()
+    assert set(report) == {"term", "leader", "candidates", "elections",
+                           "handoffs", "control"}
+    (handoff,) = report["handoffs"]
+    assert handoff["mode"] == "crash" and handoff["to"] == "c1"
+    assert handoff["failover_s"] >= 1.0     # paid the detection delay
+    assert report["candidates"] == {"c0": "dead", "c1": "leading"}
+    assert report["control"]["lost"] == 0
+
+    # -- the revoke barrier SURVIVED the failover --
+    l1b = sc.sync("w1")
+    assert moved not in {tuple(p) for p in l1b.partitions}
+    assert moved in {tuple(p) for p in l1b.pending}
+    sc.ack("w0")                        # old owner drains + acks
+    l1c = sc.sync("w1")
+    assert moved in {tuple(p) for p in l1c.partitions}
+
+
+def test_graceful_abdication_elects_immediately():
+    clock = _Clock()
+    kill = CoordinatorKillSpec(seed=1, kills=1, min_ticks=1, max_ticks=1,
+                               modes=("graceful",))
+    sc = SuccessionCoordinator(["in"], 2, candidates=2, role_ttl=5.0,
+                               kill=kill, clock=clock, wall=clock)
+    sc.join("w0")
+    clock.advance(0.05)
+    sc.tick()                           # dying breath: snapshot + abdicate
+    assert sc.coordinator is None
+    assert sc.step("c1") is True        # announced vacancy: no role_ttl wait
+    report = sc.succession_report()
+    assert report["term"] == 2
+    assert report["handoffs"][0]["mode"] == "graceful"
+    # the dying-breath snapshot carried full assignment state
+    assert sc.assignments() == {"w0": [("in", 0), ("in", 1)]}
+
+
+def test_consecutive_failovers_burn_through_candidates():
+    clock = _Clock()
+    kill = CoordinatorKillSpec(seed=2, kills=2, min_ticks=1, max_ticks=1,
+                               modes=("graceful",))
+    sc = SuccessionCoordinator(["in"], 2, candidates=3, role_ttl=5.0,
+                               kill=kill, clock=clock, wall=clock)
+    sc.join("w0")
+    clock.advance(0.05)
+    sc.tick()                           # kill 1: c0 dies
+    assert sc.step("c1") is True and sc.term == 2
+    clock.advance(0.05)
+    sc.tick()                           # kill 2: the successor dies too
+    assert sc.step("c1") is False       # the dead cannot contend
+    assert sc.step("c2") is True
+    report = sc.succession_report()
+    assert report["term"] == 3 and report["leader"] == "c2"
+    assert [h["to"] for h in report["handoffs"]] == ["c1", "c2"]
+    assert report["candidates"] == {"c0": "dead", "c1": "dead",
+                                    "c2": "leading"}
+    assert len(kill.report()["killed"]) == 2
+
+
+def test_zombie_incumbent_demotes_without_publishing():
+    clock = _Clock()
+    sc = SuccessionCoordinator(["in"], 2, candidates=2, role_ttl=1.0,
+                               clock=clock, wall=clock)
+    sc.join("w0")
+    clock.advance(0.05)
+    sc.tick()
+    # a rival's fence lands: some candidate won a newer term elsewhere
+    assert sc.gate.try_advance(sc.gate.current() + 1)
+    before = sc.control.stats()["published"]
+    sc.tick()                           # the stale incumbent notices...
+    assert sc.coordinator is None and sc.leader_id is None
+    # ...and publishes NOTHING at the fenced term (no stale beacon or
+    # snapshot may follow a newer fence)
+    assert sc.control.stats()["published"] == before
+    # the demoted candidate returns to standby and can re-contend
+    clock.advance(1.1)
+    assert sc.step("c0") is True
+    assert sc.term == 3 and sc.leader_id == "c0"
+
+
+def test_succession_validation():
+    with pytest.raises(ValueError, match="candidates"):
+        SuccessionCoordinator(["in"], 2, candidates=0)
+    with pytest.raises(ValueError, match="role_ttl"):
+        SuccessionCoordinator(["in"], 2, role_ttl=0.0)
+
+
+# ---------------------------------------------------------------------------
+# model-checked first (analysis/checker.py succession environment)
+# ---------------------------------------------------------------------------
+
+def test_succession_model_composes_worker_and_coordinator_chaos():
+    """One small exhaustive config fires EVERY spec action — worker
+    crash/lapse chaos composed with coordinator crash/lapse/election —
+    and the invariants hold across all interleavings. Together with
+    test_model_checker.py's default-config run this pins the coverage
+    union over ACTION_IMPLEMENTS."""
+    from fraud_detection_tpu.analysis.checker import (ACTION_IMPLEMENTS,
+                                                      SUCCESSION_ACTIONS,
+                                                      CheckConfig, check)
+
+    result = check(CheckConfig(workers=2, partitions=2,
+                               keys_per_partition=1, max_crashes=1,
+                               max_lapses=1, candidates=3,
+                               max_coord_crashes=1, max_coord_lapses=1))
+    assert result.ok, result.counterexample
+    assert result.states > 50_000
+    fired = {a for a, n in result.coverage.items() if n > 0}
+    assert fired == set(ACTION_IMPLEMENTS)
+    assert set(SUCCESSION_ACTIONS) <= fired
+
+
+def test_succession_config_requires_a_survivor():
+    from fraud_detection_tpu.analysis.checker import CheckConfig
+
+    with pytest.raises(ValueError, match="never-failing candidate"):
+        CheckConfig(candidates=2, max_coord_crashes=1,
+                    max_coord_lapses=1).validate()
+
+
+@pytest.mark.slow
+def test_succession_model_full_config_verifies():
+    from fraud_detection_tpu.analysis.checker import (SUCCESSION_CONFIG,
+                                                      CheckConfig, check)
+
+    result = check(CheckConfig(**SUCCESSION_CONFIG))
+    assert result.ok, result.counterexample
+    assert result.states > 100_000
+
+
+def test_model_cli_succession_mutant_dies(capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    rc = main(["model", "--mutate", "drop_coordinator_lease",
+               "--candidates", "2", "--coord-lapses", "1",
+               "--max-lapses", "0", "--keys", "2", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = json.loads(out)
+    assert doc["ok"] is False and doc["invariant_violated"] == "no_loss"
+
+
+def test_model_cli_succession_clean(capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    rc = main(["model", "--candidates", "3", "--coord-crashes", "1",
+               "--coord-lapses", "1", "--max-lapses", "0",
+               "--keys", "1", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["ok"] is True and doc["states"] > 1000
+
+
+# ---------------------------------------------------------------------------
+# the game day + scenario plumbing
+# ---------------------------------------------------------------------------
+
+def test_gameday_succession_validation():
+    from fraud_detection_tpu.scenarios.gameday import (CoordKillSpec,
+                                                       GameDay)
+    from fraud_detection_tpu.scenarios.slo import SloSpec
+    from fraud_detection_tpu.scenarios.traffic import SteadyLoad
+
+    kw = dict(name="x", description="d",
+              traffic=(SteadyLoad(name="s", rate=10.0, duration_s=1.0),),
+              slos=(SloSpec("no_errors", kind="no_errors"),))
+    with pytest.raises(ValueError, match="fleet runner"):
+        GameDay(workers=1, candidates=2, **kw)
+    with pytest.raises(ValueError, match="standby"):
+        GameDay(workers=2, candidates=1,
+                coordinator_kills=CoordKillSpec(), **kw)
+    with pytest.raises(ValueError, match="nobody"):
+        GameDay(workers=2, candidates=2,
+                coordinator_kills=CoordKillSpec(kills=2), **kw)
+    # the runtime spec the scenario compiles into validates its draws
+    with pytest.raises(ValueError, match="kills"):
+        CoordinatorKillSpec(kills=-1)
+    with pytest.raises(ValueError, match="min_ticks"):
+        CoordinatorKillSpec(min_ticks=5, max_ticks=3)
+    with pytest.raises(ValueError, match="modes"):
+        CoordinatorKillSpec(modes=())
+
+
+@pytest.mark.scenario
+def test_gameday_coordinator_kill_survives_brain_death(pipeline):
+    """The acceptance pin: a crash-mode coordinator kill mid-campaign —
+    while a crashed worker pins committed lag — and the fleet still
+    accounts for every row, elects a successor within the bound, loses
+    zero control records, and the watchdog catches the dead brain."""
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    gd = get_scenario("coordinator_kill", 0, scale=0.4)
+    result = run_gameday(gd, pipeline=pipeline)
+    assert result.ok, result.table()
+    by = {v.name: v for v in result.report.verdicts}
+    for name in ("exact_accounting", "worker_killed", "coordinator_killed",
+                 "election_won", "term_advanced", "failover_bounded_s",
+                 "control_zero_loss", "detects_coordinator_absence"):
+        assert by[name].ok, name
+    succ = result.evidence["succession"]
+    assert succ["kill_plan"]["killed"][0]["mode"] == "crash"
+    (handoff,) = succ["handoffs"]
+    assert handoff["from"] == succ["kill_plan"]["killed"][0]["coordinator"]
+    assert handoff["to"] == succ["leader"]
+    assert result.evidence["deaths"] == 1
+
+
+@pytest.mark.scenario
+def test_gameday_coordinator_kill_clean_arm_zero_incidents(pipeline):
+    """The false-positive gate: the SAME topology (3 candidates, leased
+    role, control lane) with nobody killed must hold a steady term,
+    elect no one, and end with zero incidents fired."""
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+    from fraud_detection_tpu.scenarios.gameday import SentinelSpec
+    from fraud_detection_tpu.scenarios.slo import SloSpec
+
+    gd = get_scenario("coordinator_kill", 0, scale=0.25)
+    clean = replace(
+        gd, name="coordinator_kill_clean", coordinator_kills=None,
+        kills=None, sentinel=SentinelSpec(zero_incidents=True),
+        slos=(SloSpec("exact_accounting", kind="exact_accounting"),
+              SloSpec("steady_term", path="succession.term", op="==",
+                      limit=1, scope="gameday"),
+              SloSpec("no_elections", path="succession.elections",
+                      op="==", limit=0, scope="gameday"),
+              SloSpec("control_zero_loss", path="succession.control.lost",
+                      op="==", limit=0, scope="gameday"),
+              SloSpec("no_errors", kind="no_errors")))
+    result = run_gameday(clean, pipeline=pipeline)
+    assert result.ok, result.table()
+    assert result.evidence["alerts"]["fired"] == 0
+
+
+def test_failover_lands_in_incident_flight_recorder(tmp_path, pipeline):
+    """A real fleet run: coordinator crash + worker crash, the sentinel's
+    coordinator_absence rule fires during the interregnum and the
+    incident flight recorder keeps the evidence — while the drain still
+    accounts for every key exactly once."""
+    from fraud_detection_tpu.obs.sentinel import (IncidentRecorder,
+                                                  fleet_rule_pack)
+
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, 400)
+    recorder = IncidentRecorder(str(tmp_path))
+    kill = CoordinatorKillSpec(seed=2, kills=1, min_ticks=2, max_ticks=4,
+                               modes=("crash",))
+    fleet = Fleet.in_process(
+        broker, pipeline, "in", "out", 2, batch_size=64,
+        lease_ttl=1.0, heartbeat_interval=0.02, tick_interval=0.02,
+        candidates=2, role_ttl=0.8, coordinator_kill=kill,
+        death_plan=WorkerDeathPlan(seed=4, kills=1, min_polls=2,
+                                   max_polls=4, modes=("crash",)),
+        sentinel_rules=fleet_rule_pack(backlog_limit=20000.0, fast_s=0.25,
+                                       slow_s=1.0, resolve_s=0.2),
+        sentinel_recorder=recorder)
+    out = fleet.run(idle_timeout=2.5, join_timeout=90.0)
+    assert sorted(m.key for m in broker.messages("out")) == \
+        sorted(str(i).encode() for i in range(400))
+    succ = out["succession"]
+    assert succ["elections"] >= 1 and succ["term"] >= 2
+    assert succ["control"]["lost"] == 0
+    assert recorder.recorded >= 1
+    text = (tmp_path / "incidents.jsonl").read_text()
+    assert "coordinator_absence" in text
+
+
+def test_serve_cli_fleet_candidates(capsys):
+    """serve --fleet N --fleet-candidates K: the demo drains under the
+    leased-role coordinator and the exit stats carry the succession
+    evidence block (steady term 1, no elections — the clean path)."""
+    from fraud_detection_tpu.app import serve
+
+    rc = serve.main(["--model", "synthetic", "--demo", "300",
+                     "--fleet", "2", "--partitions", "4",
+                     "--batch-size", "64", "--fleet-candidates", "2"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    out = json.loads(lines[-1])
+    assert out["processed"] == 300 and out["errors"] == []
+    succ = out["succession"]
+    assert succ["term"] == 1 and succ["leader"] == "c0"
+    assert succ["elections"] == 0 and succ["control"]["lost"] == 0
+    assert succ["candidates"] == {"c0": "leading", "c1": "standby"}
+
+
+def test_serve_cli_fleet_candidates_rejects_bad_combos():
+    from fraud_detection_tpu.app import serve
+
+    with pytest.raises(SystemExit):
+        serve.main(["--model", "synthetic", "--demo", "10",
+                    "--fleet-candidates", "2"])
+    with pytest.raises(SystemExit):
+        serve.main(["--model", "synthetic", "--demo", "10", "--fleet", "2",
+                    "--fleet-candidates", "0"])
+
+
+def test_bench_trend_carries_failover_fields(tmp_path):
+    """The bench trend record diffs failover latency + control-lane
+    losses round over round (bench.py fleet section, ISSUE 16)."""
+    import bench
+
+    line = {"metric": "m", "value": 1.0,
+            "fleet": {"workers": 2, "cores": 1,
+                      "single_worker_msgs_per_s": 10.0,
+                      "aggregate_msgs_per_s": 18.0, "scaling_x": 1.8,
+                      "global_shed": {"sheds": 0},
+                      "failover": {"candidates": 2, "role_ttl_s": 0.5,
+                                   "elections": 1, "term": 2,
+                                   "failover_s": 0.61, "control_lost": 0,
+                                   "lost_keys": 0, "duplicated_keys": 0}}}
+    rec = bench.append_bench_trend(line, str(tmp_path / "t.json"), now=1.0)
+    assert rec["fleet"]["failover_s"] == 0.61
+    assert rec["fleet"]["failover_control_lost"] == 0
+    assert rec["fleet"]["scaling_x"] == 1.8
+
+
+def test_fleet_rejects_unsurvivable_kill_budget(pipeline):
+    broker = InProcessBroker(num_partitions=2)
+    with pytest.raises(ValueError, match="survive"):
+        Fleet.in_process(broker, pipeline, "in", "out", 2, candidates=2,
+                         coordinator_kill=CoordinatorKillSpec(kills=2))
