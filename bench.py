@@ -210,6 +210,20 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
             "failover_control_lost": (fleet.get("failover")
                                       or {}).get("control_lost"),
         } if fleet and "workers" in fleet else None),
+        # Closed-loop autoscaling (ISSUE 18, docs/autoscaling.md):
+        # scale-out reaction latency in virtual seconds + the elastic
+        # arm's worker-seconds efficiency vs the static-max fleet, so a
+        # slow or wasteful sizing loop diffs in the trend file.
+        "autoscale": (lambda a: ({
+            "ok": a.get("ok"),
+            "reaction_virtual_s": a.get("reaction_virtual_s"),
+            "avg_desired_workers": a.get("avg_desired_workers"),
+            "elastic_rows_per_s_per_worker": (a.get("elastic")
+                                              or {}).get(
+                                                  "rows_per_s_per_worker"),
+            "efficiency_vs_static_max_x": a.get(
+                "efficiency_vs_static_max_x"),
+        } if a and "error" not in a else None))(line.get("autoscale") or {}),
     }
     trend = []
     try:
@@ -1183,6 +1197,93 @@ def scenario_bench(pipe) -> dict:
                          for v in result.report.verdicts},
         }
     out["pass"] = all(s["ok"] for s in out["scenarios"].values())
+    return out
+
+
+def autoscale_bench(pipe) -> dict:
+    """Closed-loop autoscaling evidence (docs/autoscaling.md): the paced
+    ``diurnal_tide_scale`` game day (elastic arm, judged by its SLO
+    gates) against two static fleets on the SAME seeded tide — pinned at
+    the policy's min and max. Committed: scale-out reaction latency in
+    VIRTUAL seconds, time-weighted mean desired capacity over the feed
+    window, and rows/s-per-worker for all three arms — so the trend file
+    shows what elasticity buys (near static-min's worker-seconds without
+    its crest backlog, near static-max's drain without paying for the
+    idle trough) and a slow or flapping loop diffs as a number instead
+    of failing a soak somewhere."""
+    import dataclasses
+
+    from fraud_detection_tpu.scenarios import get_scenario, run_gameday
+
+    seed = int(os.environ.get("BENCH_AUTOSCALE_SEED", "11"))
+    scale = float(os.environ.get("BENCH_AUTOSCALE_SCALE", "0.5"))
+    gd = get_scenario("diurnal_tide_scale", seed, scale=scale)
+    horizon = max(t.duration_s for t in gd.traffic)
+
+    def leg(day):
+        t0 = time.perf_counter()
+        result = run_gameday(day, pipeline=pipe)
+        ev = result.evidence
+        stats = ev.get("stats") or {}
+        return {
+            "ok": result.ok,
+            "rows": ev.get("planned"),
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "msgs_per_s": stats.get("msgs_per_sec"),
+            "p99_row_latency_ms": stats.get("p99_row_latency_ms"),
+        }, ev
+
+    elastic, ev = leg(gd)
+    asc = ev.get("autoscale") or {}
+    # Time-weighted mean desired capacity — the worker-seconds the
+    # elastic fleet actually paid for. The window covers the paced feed
+    # AND the decision tail (a scale-out that lands on the crest's edge
+    # still pays for its extra worker through the drain), all in virtual
+    # seconds on the same clock as the traffic curve.
+    decisions = asc.get("decisions") or []
+    end = max([horizon] + [float(d.get("at", 0.0)) for d in decisions])
+    desired, mark, area = gd.workers, 0.0, 0.0
+    for d in decisions:
+        at = min(float(d.get("at", 0.0)), end)
+        area += desired * max(0.0, at - mark)
+        mark, desired = at, d.get("desired_after", desired)
+    area += desired * max(0.0, end - mark)
+    avg_desired = area / end if end > 0 else float(gd.workers)
+
+    out = {
+        "seed": seed, "scale": scale,
+        "ok": elastic["ok"],
+        "reaction_virtual_s": ev.get("autoscale_reaction_s"),
+        "scale_outs": asc.get("scale_outs"),
+        "scale_ins": asc.get("scale_ins"),
+        "denied": asc.get("denied"),
+        "avg_desired_workers": round(avg_desired, 3),
+        "elastic": dict(elastic, rows_per_s_per_worker=round(
+            (elastic["msgs_per_s"] or 0.0) / max(avg_desired, 1e-9), 1)),
+        "static": {},
+    }
+    # The control arms: the same seeded tide on fixed fleets at the
+    # policy's min and max — no autoscaler, no detection gates (a static
+    # fleet has no scale decisions to judge), same rule pack running so
+    # the sentinel overhead matches.
+    for n in sorted({gd.autoscale.min_workers, gd.autoscale.max_workers}):
+        static = dataclasses.replace(
+            gd, name=f"{gd.name}_static{n}", workers=n, autoscale=None,
+            slos=(), sentinel=dataclasses.replace(gd.sentinel, expect=()))
+        arm, _ = leg(static)
+        out["static"][str(n)] = dict(arm, rows_per_s_per_worker=round(
+            (arm["msgs_per_s"] or 0.0) / n, 1))
+    s_max = out["static"][str(gd.autoscale.max_workers)]
+    if s_max["rows_per_s_per_worker"]:
+        out["efficiency_vs_static_max_x"] = round(
+            out["elastic"]["rows_per_s_per_worker"]
+            / s_max["rows_per_s_per_worker"], 3)
+    # In-leg gates (the CI bench-smoke re-asserts them from the
+    # artifact): the elastic arm must pass its game-day gates and must
+    # actually have scaled — an autoscale leg that "ran" with the fleet
+    # pinned flat is a regression, not a data point.
+    assert out["ok"], out
+    assert (out["scale_outs"] or 0) >= 1, out
     return out
 
 
@@ -2195,6 +2296,16 @@ def main() -> int:
         harness.section(
             "scenarios",
             lambda scratch: scenario_bench(pipe_or_raise()),
+            fraction=0.35)
+
+    if os.environ.get("BENCH_AUTOSCALE", "1") != "0":
+        # Closed-loop autoscaling evidence (docs/autoscaling.md): the
+        # paced elastic tide vs static min/max fleets on the same seeded
+        # curve — reaction latency in virtual seconds, time-weighted
+        # mean desired capacity, rows/s-per-worker per arm.
+        harness.section(
+            "autoscale",
+            lambda scratch: autoscale_bench(pipe_or_raise()),
             fraction=0.35)
 
     if os.environ.get("BENCH_LEARN", "1") != "0":

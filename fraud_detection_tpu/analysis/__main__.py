@@ -37,7 +37,8 @@ from fraud_detection_tpu.analysis.core import (RULES, resolve_roots,
 
 
 def model_main(argv=None) -> int:
-    from fraud_detection_tpu.analysis.checker import (MUTATIONS,
+    from fraud_detection_tpu.analysis.checker import (AUTOSCALE_CONFIG,
+                                                      MUTATIONS,
                                                       SUCCESSION_CONFIG,
                                                       CheckConfig, check)
     from fraud_detection_tpu.analysis import traces
@@ -64,11 +65,24 @@ def model_main(argv=None) -> int:
                         help="coordinator role-lease lapses (the zombie-"
                              "coordinator / delayed-decision adversary "
                              "budget)")
+    parser.add_argument("--spares", type=int, default=0,
+                        help="workers that start UNPROVISIONED until a "
+                             "scale_out launches them (the elasticity "
+                             "environment's capacity headroom)")
+    parser.add_argument("--max-scale-ins", type=int, default=0,
+                        help="coordinator-requested voluntary-leave "
+                             "budget (scale_in decisions)")
     parser.add_argument("--succession", action="store_true",
                         help="use the headline succession configuration "
                              "(W=3/P=3, one coordinator crash + one "
                              "coordinator lapse on a lossy control lane); "
                              "overrides the topology flags")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="use the headline elastic configuration "
+                             "(W=3 with one spare to launch and one "
+                             "voluntary leave, composed with one worker "
+                             "crash and one coordinator crash); overrides "
+                             "the topology flags")
     parser.add_argument("--mutate", default=None,
                         help="comma-separated protocol mutations to seed "
                              f"(known: {', '.join(MUTATIONS)})")
@@ -100,9 +114,16 @@ def model_main(argv=None) -> int:
             keys_per_partition=args.keys, max_crashes=args.max_crashes,
             max_lapses=args.max_lapses, candidates=args.candidates,
             max_coord_crashes=args.coord_crashes,
-            max_coord_lapses=args.coord_lapses)
+            max_coord_lapses=args.coord_lapses,
+            spares=args.spares, max_scale_ins=args.max_scale_ins)
+        if args.succession and args.autoscale:
+            raise ValueError(
+                "--succession and --autoscale are mutually exclusive "
+                "presets")
         if args.succession:
             topology = dict(SUCCESSION_CONFIG)
+        if args.autoscale:
+            topology = dict(AUTOSCALE_CONFIG)
         cfg = CheckConfig(
             mutations=mutations,
             max_states=args.max_states, max_seconds=args.max_seconds,

@@ -106,6 +106,33 @@ re-introduce the failover bugs the choreography prevents:
 * ``forget_holds_on_failover`` — the successor rebuilds assignment state
   from the target map alone, dropping in-flight revoke-barrier holds (the
   failover twin of ``forget_barrier_holds``).
+
+**Elasticity environment** (PR 18, docs/autoscaling.md). The autoscaler
+turns the sentinel signal plane into worker lifecycle decisions, and the
+model gains a capacity dimension so those decisions compose with every
+fault above. ``spares`` workers start UNPROVISIONED (not yet launched);
+``max_scale_ins`` budgets coordinator-requested voluntary leaves:
+
+* ``scale_out`` — the provisioner launches an unprovisioned spare, which
+  then joins through the ordinary join path (a replacement for a dead
+  worker is exactly this move scheduled after a crash);
+* ``scale_in`` — the coordinator marks a member RELEASED and re-deals its
+  partitions among the remaining active members — with the moved pairs
+  entering the EXISTING revoke barrier held by the released worker, so
+  scale-in is provably a voluntary leave through revoke -> drain ->
+  commit -> reassign (refused when it would empty the active set);
+* ``release`` — the released worker, drained and committed, acks the
+  barrier and leaves in one step (the ``FleetWorker`` released-lease
+  exit: ack + leave + retract fused, invisible to other roles between).
+
+The elasticity mutation re-introduces the bug the barrier routing
+prevents:
+
+* ``release_before_drain`` — the scale-in re-deal grants the released
+  worker's pairs to their new owners immediately (its barrier hold is
+  dropped), so a new owner polls while the live released owner still
+  holds uncommitted read-ahead — the scale-in twin of
+  ``skip_revoke_barrier``, and the counterexample CI pins.
 """
 
 from __future__ import annotations
@@ -119,7 +146,7 @@ MUTATIONS: Tuple[str, ...] = (
     "drop_fence", "skip_revoke_barrier", "ack_before_drain",
     "expire_before_renew", "forget_barrier_holds",
     "drop_coordinator_lease", "stale_term_fence_accepted",
-    "forget_holds_on_failover",
+    "forget_holds_on_failover", "release_before_drain",
 )
 
 INVARIANTS: Tuple[str, ...] = (
@@ -148,6 +175,10 @@ ACTION_IMPLEMENTS: Dict[str, Tuple[str, ...]] = {
     "coord_lapse": ("Candidate.lapse",),
     "elect": ("Candidate.elect", "Candidate.restore"),
     "stale_assign": ("Candidate.fence",),
+    "scale_out": ("Coordinator.scale_out", "Provisioner.launch"),
+    "scale_in": ("Coordinator.scale_in",),
+    "release": ("Worker.release", "Coordinator.leave",
+                "AssignedConsumer.close", "Bus.retract"),
 }
 
 #: The actions only a succession configuration (``candidates >= 2`` with a
@@ -155,6 +186,13 @@ ACTION_IMPLEMENTS: Dict[str, Tuple[str, ...]] = {
 #: default and succession runs (tests/test_model_checker.py).
 SUCCESSION_ACTIONS: Tuple[str, ...] = (
     "coord_crash", "coord_lapse", "elect", "stale_assign",
+)
+
+#: The actions only an elastic configuration (``spares > 0`` and/or
+#: ``max_scale_ins > 0``) can exercise; excluded from the default and
+#: succession coverage pins the same way SUCCESSION_ACTIONS is.
+AUTOSCALE_ACTIONS: Tuple[str, ...] = (
+    "scale_out", "scale_in", "release",
 )
 
 
@@ -173,6 +211,13 @@ class CheckConfig:
     candidates: int = 1
     max_coord_crashes: int = 0
     max_coord_lapses: int = 0
+    #: elasticity dimension: ``spares`` of the ``workers`` start
+    #: UNPROVISIONED (scale_out launches them); ``max_scale_ins`` budgets
+    #: coordinator-requested voluntary leaves. The defaults (no spares,
+    #: no scale-in budget) leave the capacity constant, so the explored
+    #: state space matches the pre-elasticity model.
+    spares: int = 0
+    max_scale_ins: int = 0
     mutations: FrozenSet[str] = frozenset()
     max_states: int = 400_000
     max_seconds: float = 120.0
@@ -206,6 +251,20 @@ class CheckConfig:
                 f"{self.max_coord_crashes}+{self.max_coord_lapses} with "
                 f"{self.candidates} candidates): liveness of the control "
                 "plane is conditioned on a survivor, like max_crashes")
+        if self.spares < 0 or self.spares >= self.workers:
+            raise ValueError(
+                f"spares must be 0..workers-1 (got {self.spares} with "
+                f"{self.workers} workers): at least one worker starts "
+                "provisioned")
+        if self.max_scale_ins < 0:
+            raise ValueError("max_scale_ins must be >= 0")
+        if self.max_crashes + self.max_scale_ins >= self.workers:
+            raise ValueError(
+                "max_crashes + max_scale_ins must leave at least one "
+                f"never-crashed, never-released worker (got "
+                f"{self.max_crashes}+{self.max_scale_ins} with "
+                f"{self.workers} workers): the zero-loss guarantee is "
+                "conditioned on a survivor that can still deliver")
         unknown = set(self.mutations) - set(MUTATIONS)
         if unknown:
             raise ValueError(f"unknown mutations {sorted(unknown)} "
@@ -223,6 +282,19 @@ class CheckConfig:
 SUCCESSION_CONFIG = dict(workers=3, partitions=3, keys_per_partition=1,
                          max_crashes=1, max_lapses=0, candidates=3,
                          max_coord_crashes=1, max_coord_lapses=1)
+
+#: The headline elastic configuration (CI's autoscale-smoke, the
+#: ``--autoscale`` CLI preset): one spare to launch (scale_out — which,
+#: scheduled after the crash, IS the replacement move), one voluntary
+#: leave to request (scale_in -> drain -> release), composed with one
+#: worker crash AND one coordinator crash so scale decisions interleave
+#: with worker death and failover. ``keys_per_partition=1`` and
+#: ``max_lapses=0`` keep the data plane minimal for the same reason as
+#: SUCCESSION_CONFIG: the scale interleavings are the point.
+AUTOSCALE_CONFIG = dict(workers=3, partitions=2, keys_per_partition=1,
+                        max_crashes=1, max_lapses=0, spares=1,
+                        max_scale_ins=1, candidates=2,
+                        max_coord_crashes=1, max_coord_lapses=0)
 
 
 @dataclass(frozen=True)
@@ -264,8 +336,10 @@ class CheckResult:
 #   target:   tuple[int]*P    authoritative owner per partition (-1 none)
 #   pending:  tuple[int]*P    live holder draining the pair (-1 none)
 #   committed:tuple[int]*P    group-durable committed offset
-#   workers:  tuple[W] of (wstate, lease, pos, base, zombie)
-#             wstate: i/r/d/c/l (init running draining crashed left)
+#   workers:  tuple[W] of (wstate, lease, pos, base, zombie, released)
+#             wstate: u/i/r/d/c/l (unprovisioned init running draining
+#                     crashed left) — "u" is a spare the provisioner has
+#                     not launched yet (scale_out flips it to "i")
 #             lease:  tuple[int] partitions of the CURRENT incarnation's
 #                     consumer (the worker's possibly-stale local view)
 #             pos/base: tuple[int]*P, -1 outside the lease; read-ahead on
@@ -275,8 +349,13 @@ class CheckResult:
 #                     barrier for an expired owner) and its re-deliveries
 #                     are the DOCUMENTED at-least-once duplicates, exempt
 #                     from the committed-coverage dup accounting
+#             released: True from the coordinator's scale_in request
+#                     until the voluntary leave completes — a released
+#                     member keeps its barrier holds (it must drain and
+#                     commit first) but is excluded from every re-deal
 #   crashes, lapses: environment budget spent
-#   coord:    (leading, standby, zombie, term, ccrashes, clapses)
+#   coord:    (leading, standby, zombie, term, ccrashes, clapses,
+#              scale_ins)
 #             leading: 1 while a live candidate holds the coordinator
 #                     role lease, 0 during an interregnum
 #             standby: count of standby candidates (candidates are
@@ -291,6 +370,9 @@ class CheckResult:
 #                     accepts; elect advances it (Kafka controller-epoch
 #                     style), so a zombie's zterm < term is rejectable
 #             ccrashes, clapses: coordinator fault budget spent
+#             scale_ins: elasticity budget spent (voluntary leaves
+#                     requested; scale_out needs no counter — each spare
+#                     can launch exactly once)
 #
 # Delivery accounting rides ``committed`` alone: a success commit covers
 # exactly the rows it newly advances past (each row exactly once, by
@@ -301,22 +383,24 @@ class CheckResult:
 # exempted explicitly instead of hidden.
 # ---------------------------------------------------------------------------
 
-_INIT, _RUN, _DRAIN, _CRASH, _LEFT = "i", "r", "d", "c", "l"
+_UNPROV, _INIT, _RUN, _DRAIN, _CRASH, _LEFT = "u", "i", "r", "d", "c", "l"
 
 
 def _initial_state(cfg: CheckConfig):
     P = cfg.partitions
-    worker = (_INIT, (), (-1,) * P, (-1,) * P, False)
+    live = (_INIT, (), (-1,) * P, (-1,) * P, False, False)
+    spare = (_UNPROV, (), (-1,) * P, (-1,) * P, False, False)
+    active = cfg.workers - cfg.spares
     # Candidate 0 holds the role lease from the start (the bootstrap
     # election is uncontended); the rest stand by.
-    coord = (1, cfg.candidates - 1, None, 0, 0, 0)
+    coord = (1, cfg.candidates - 1, None, 0, 0, 0, 0)
     return (
         (),                       # members
         (),                       # stale
         (-1,) * P,                # target
         (-1,) * P,                # pending
         (0,) * P,                 # committed
-        tuple(worker for _ in range(cfg.workers)),
+        tuple(live if i < active else spare for i in range(cfg.workers)),
         0, 0,
         coord,
     )
@@ -333,7 +417,7 @@ def _relabel(state, perm):
     inv = [0] * len(perm)
     for old, new in enumerate(perm):
         inv[new] = old
-    leading, standby, zombie, term, ccr, cla = coord
+    leading, standby, zombie, term, ccr, cla, sins = coord
     if zombie is not None and zombie[1] is not None:
         zterm, ztarget, zpending = zombie
         zombie = (zterm,
@@ -347,7 +431,7 @@ def _relabel(state, perm):
         committed,
         tuple(workers[inv[new]] for new in range(len(workers))),
         cr, la,
-        (leading, standby, zombie, term, ccr, cla),
+        (leading, standby, zombie, term, ccr, cla, sins),
     )
 
 
@@ -362,43 +446,51 @@ def _canonical(state, cfg: CheckConfig):
 # coordinator internals (pure functions over the state fields)
 # ---------------------------------------------------------------------------
 
-def _rebalance(members, old_target, old_pending, P, mutations):
+def _rebalance(members, old_target, old_pending, P, mutations,
+               released=frozenset()):
     """The balanced-sticky re-deal, mirroring
     ``FleetCoordinator._rebalance_locked`` (with the barrier-hold
     persistence fix; ``forget_barrier_holds`` restores the pre-fix shape,
-    ``skip_revoke_barrier`` drops the barrier entirely)."""
-    if not members:
-        return (-1,) * P, (-1,) * P
-    base_share, extra = divmod(P, len(members))
-    share = {w: base_share + (1 if i < extra else 0)
-             for i, w in enumerate(members)}
-    kept = {w: 0 for w in members}
+    ``skip_revoke_barrier`` drops the barrier entirely). ``released``
+    members — a coordinator-requested voluntary leave in flight — are
+    excluded from the DEAL but remain eligible barrier HOLDERS until they
+    drain and ack; ``release_before_drain`` drops exactly that hold (the
+    scale-in twin of ``skip_revoke_barrier``)."""
+    deal = tuple(m for m in members if m not in released)
+    holders = set(deal) if "release_before_drain" in mutations \
+        else set(members)
     target = [-1] * P
-    pool = []
-    for p in range(P):                    # partition order: deterministic
-        w = old_target[p]
-        if w in share and kept[w] < share[w]:
-            target[p] = w
-            kept[w] += 1
-        else:
-            pool.append(p)
-    for w in members:                     # join order: deterministic
-        take = share[w] - kept[w]
-        while take > 0 and pool:
-            target[pool.pop(0)] = w
-            take -= 1
+    if deal:
+        base_share, extra = divmod(P, len(deal))
+        share = {w: base_share + (1 if i < extra else 0)
+                 for i, w in enumerate(deal)}
+        kept = {w: 0 for w in deal}
+        pool = []
+        for p in range(P):                # partition order: deterministic
+            w = old_target[p]
+            if w in share and kept[w] < share[w]:
+                target[p] = w
+                kept[w] += 1
+            else:
+                pool.append(p)
+        for w in deal:                    # join order: deterministic
+            take = share[w] - kept[w]
+            while take > 0 and pool:
+                target[pool.pop(0)] = w
+                take -= 1
     pending = [-1] * P
     if "skip_revoke_barrier" not in mutations:
         for p in range(P):
             w = target[p]
-            if w < 0:
-                continue
             if "forget_barrier_holds" in mutations:
                 holder = old_target[p]
             else:
                 holder = old_pending[p] if old_pending[p] >= 0 \
                     else old_target[p]
-            if holder not in (-1, w) and holder in members:
+            # An UNOWNED pair (w == -1: the deal has nobody to give it
+            # to yet) still keeps its live holder's barrier hold — the
+            # hold protects the pair's NEXT owner, whoever that is.
+            if holder not in (-1, w) and holder in holders:
                 pending[p] = holder
     return tuple(target), tuple(pending)
 
@@ -420,7 +512,8 @@ def _granted(target, pending, wid) -> Tuple[Tuple[int, ...], bool]:
     return tuple(granted), withheld
 
 
-def _coord_sync(members, stale, target, pending, wid, mutations):
+def _coord_sync(members, stale, target, pending, wid, mutations,
+                released=frozenset()):
     """join/sync(wid): renew-then-scan (or the mutant's scan-then-renew),
     re-deal when membership changed. Returns the updated fields plus the
     id the scan expired-of-itself (the no_self_expiry witness) and the
@@ -457,7 +550,7 @@ def _coord_sync(members, stale, target, pending, wid, mutations):
 
     if changed:
         target, pending = _rebalance(tuple(members), target, pending,
-                                     len(target), mutations)
+                                     len(target), mutations, released)
     return (tuple(members), tuple(sorted(stale_set)), target, pending,
             expired, self_expired)
 
@@ -467,8 +560,8 @@ def _mark_zombies(workers, expired):
         return workers
     out = list(workers)
     for e in expired:
-        wstate, lease, pos, base, _ = out[e]
-        out[e] = (wstate, lease, pos, base, True)
+        wstate, lease, pos, base, _, rel = out[e]
+        out[e] = (wstate, lease, pos, base, True, rel)
     return tuple(out)
 
 
@@ -491,13 +584,13 @@ class FleetModel:
 
     def _read_ahead(self, worker) -> List[Tuple[int, int, int]]:
         """[(p, base, pos)] windows with uncommitted read-ahead."""
-        _, lease, pos, base, _ = worker
+        _, lease, pos, base, _, _ = worker
         return [(p, base[p], pos[p]) for p in lease if pos[p] > base[p]]
 
-    def _rebuild_worker(self, committed, granted):
+    def _rebuild_worker(self, committed, granted, released=False):
         P = self.cfg.partitions
         pos = tuple(committed[p] if p in granted else -1 for p in range(P))
-        return (_RUN, tuple(sorted(granted)), pos, pos, False)
+        return (_RUN, tuple(sorted(granted)), pos, pos, False, released)
 
     # -- successors --------------------------------------------------------
 
@@ -508,7 +601,8 @@ class FleetModel:
         (members, stale, target, pending, committed, workers,
          crashes, lapses, coord) = state
         cfg, P, K = self.cfg, self.cfg.partitions, self.cfg.keys_per_partition
-        leading, standby, czombie, term, ccrashes, clapses = coord
+        leading, standby, czombie, term, ccrashes, clapses, scale_ins = coord
+        released_set = frozenset(i for i, w in enumerate(workers) if w[5])
         # Control-plane RPCs (join/sync/ack/leave, the expiry scan) need a
         # live leader; the data plane (poll/commit on existing leases, the
         # materialized fence) rides out an interregnum. A lost or delayed
@@ -518,14 +612,19 @@ class FleetModel:
         have_leader = leading == 1
 
         for wid, worker in enumerate(workers):
-            wstate, lease, pos, base, zombie = worker
+            wstate, lease, pos, base, zombie, rel = worker
             actor = f"w{wid}"
+
+            # ---- unprovisioned spare: only scale_out (below) launches it
+            if wstate == _UNPROV:
+                continue
 
             # ---- join: init -> running (waits out an interregnum) ------
             if wstate == _INIT:
                 if have_leader:
                     m2, s2, t2, p2, expired, self_exp = _coord_sync(
-                        members, stale, target, pending, wid, self.mut)
+                        members, stale, target, pending, wid, self.mut,
+                        released_set)
                     w2 = _mark_zombies(workers, expired)
                     granted, _ = _granted(t2, p2, wid)
                     w2 = list(w2)
@@ -557,7 +656,8 @@ class FleetModel:
             # lease and the data plane carries on below. -------------------
             if wstate == _RUN and have_leader:
                 m2, s2, t2, p2, expired, self_exp = _coord_sync(
-                    members, stale, target, pending, wid, self.mut)
+                    members, stale, target, pending, wid, self.mut,
+                    released_set)
                 w2 = list(_mark_zombies(workers, expired))
                 granted, withheld = _granted(t2, p2, wid)
                 detail = f"heartbeat; lease {{{_pp(granted)}}}"
@@ -569,18 +669,23 @@ class FleetModel:
                         f"ran before the caller's renewal, so a live, "
                         f"syncing member lost its lease to itself",
                         ())
-                if set(granted) != set(lease) or withheld:
-                    # revoke detected: stop the engine, drain
+                if set(granted) != set(lease) or withheld or rel:
+                    # revoke detected (a released member's lease is
+                    # revoked WHOLESALE): stop the engine, drain
                     if "ack_before_drain" in self.mut:
                         p2 = _release_holds(p2, wid)
                         detail += ("; lease changed -> ACKS THE BARRIER "
                                    "EARLY, then drains")
+                    elif rel:
+                        detail += ("; lease RELEASED by the scale-in "
+                                   "request -> stops engine, drains "
+                                   "in-flight")
                     else:
                         detail += ("; lease changed -> stops engine, "
                                    "drains in-flight")
-                    w2[wid] = (_DRAIN, lease, pos, base, zombie)
+                    w2[wid] = (_DRAIN, lease, pos, base, zombie, rel)
                 else:
-                    w2[wid] = (_RUN, lease, pos, base, zombie)
+                    w2[wid] = (_RUN, lease, pos, base, zombie, rel)
                 nxt = (m2, s2, t2, p2, committed, tuple(w2),
                        crashes, lapses, coord)
                 yield Step(actor, "sync", detail), nxt, violation
@@ -598,7 +703,7 @@ class FleetModel:
                         for hid, other in enumerate(workers):
                             if hid == wid or hid not in members:
                                 continue
-                            ostate, olease, opos, obase, ozombie = other
+                            ostate, olease, opos, obase, ozombie, _ = other
                             if ozombie or p not in olease:
                                 continue
                             if opos[p] > obase[p]:
@@ -615,7 +720,7 @@ class FleetModel:
                     w2 = list(workers)
                     pos2 = list(pos)
                     pos2[p] += 1
-                    w2[wid] = (_RUN, lease, tuple(pos2), base, zombie)
+                    w2[wid] = (_RUN, lease, tuple(pos2), base, zombie, rel)
                     nxt = (members, stale, target, pending, committed,
                            tuple(w2), crashes, lapses, coord)
                     yield (Step(actor, "poll",
@@ -643,7 +748,8 @@ class FleetModel:
                     for p, b, q in windows:
                         base2[p] = q
                     w2 = list(workers)
-                    w2[wid] = (wstate, lease, pos, tuple(base2), zombie)
+                    w2[wid] = (wstate, lease, pos, tuple(base2), zombie,
+                               rel)
                     span = ", ".join(f"p{p}:[{b},{q})"
                                      for p, b, q in windows)
                     if fenced:
@@ -705,7 +811,7 @@ class FleetModel:
                                nxt, violation)
 
             # ---- ack: drain complete -> release barrier, rebuild -------
-            if wstate == _DRAIN and have_leader \
+            if wstate == _DRAIN and not rel and have_leader \
                     and not self._read_ahead(worker):
                 p2 = _release_holds(pending, wid)
                 s2 = tuple(x for x in stale if x != wid)   # ack renews
@@ -719,6 +825,27 @@ class FleetModel:
                             f"rebuilds on lease {{{_pp(granted)}}}"),
                        nxt, None)
 
+            # ---- release: a RELEASED member's drain completed -> it acks
+            # the barrier and leaves in one step (the FleetWorker
+            # released-lease exit: ack + leave + retract fused; no
+            # re-deal needed — a released member was already excluded
+            # from every deal, so its departure moves no pairs) ----------
+            if wstate == _DRAIN and rel and wid in members \
+                    and have_leader and not self._read_ahead(worker):
+                p2 = _release_holds(pending, wid)
+                m2 = tuple(m for m in members if m != wid)
+                s2 = tuple(x for x in stale if x != wid)
+                w2 = list(workers)
+                w2[wid] = (_LEFT, (), (-1,) * P, (-1,) * P, False, False)
+                nxt = (m2, s2, target, p2, committed, tuple(w2),
+                       crashes, lapses, coord)
+                yield (Step(actor, "release",
+                            "drained + committed under the scale-in "
+                            "request: acks the barrier and leaves "
+                            "voluntarily (its pairs' new owners may now "
+                            "poll)"),
+                       nxt, None)
+
             # ---- leave: drain-run idle exit ----------------------------
             if wstate == _RUN and have_leader \
                     and all(pos[p] >= K and base[p] == pos[p]
@@ -728,9 +855,10 @@ class FleetModel:
                 s2 = tuple(x for x in stale if x != wid)
                 t2, p2 = target, _release_holds(pending, wid)
                 if wid in members:
-                    t2, p2 = _rebalance(m2, t2, p2, P, self.mut)
+                    t2, p2 = _rebalance(m2, t2, p2, P, self.mut,
+                                        released_set)
                 w2 = list(workers)
-                w2[wid] = (_LEFT, (), (-1,) * P, (-1,) * P, False)
+                w2[wid] = (_LEFT, (), (-1,) * P, (-1,) * P, False, False)
                 nxt = (m2, s2, t2, p2, committed, tuple(w2),
                        crashes, lapses, coord)
                 yield (Step(actor, "leave",
@@ -754,7 +882,8 @@ class FleetModel:
                 if set(granted) != set(lease) \
                         or any(committed[p] < pos[p] for p in granted):
                     w2 = list(workers)
-                    w2[wid] = self._rebuild_worker(committed, granted)
+                    w2[wid] = self._rebuild_worker(committed, granted,
+                                                   released=rel)
                     nxt = (members, s2, target, p2, committed,
                            tuple(w2), crashes, lapses, coord)
                     yield (Step(actor, "ack",
@@ -767,7 +896,7 @@ class FleetModel:
             # ---- crash: the WorkerDeathPlan, on the poll path ----------
             if wstate in (_RUN, _DRAIN) and crashes < cfg.max_crashes:
                 w2 = list(workers)
-                w2[wid] = (_CRASH, lease, pos, base, zombie)
+                w2[wid] = (_CRASH, lease, pos, base, zombie, rel)
                 nxt = (members, stale, target, pending, committed,
                        tuple(w2), crashes + 1, lapses, coord)
                 yield (Step(actor, "crash",
@@ -782,9 +911,11 @@ class FleetModel:
                     s2 = tuple(x for x in stale if x != wid)
                     t2, p2 = target, _release_holds(pending, wid)
                     if wid in members:
-                        t2, p2 = _rebalance(m2, t2, p2, P, self.mut)
+                        t2, p2 = _rebalance(m2, t2, p2, P, self.mut,
+                                            released_set)
                     w2 = list(workers)
-                    w2[wid] = (_CRASH, (), (-1,) * P, (-1,) * P, False)
+                    w2[wid] = (_CRASH, (), (-1,) * P, (-1,) * P, False,
+                               False)
                     nxt = (m2, s2, t2, p2, committed, tuple(w2),
                            crashes + 1, lapses, coord)
                     yield (Step(actor, "crash",
@@ -811,7 +942,7 @@ class FleetModel:
             p2 = pending
             for e in expired:
                 p2 = _release_holds(p2, e)
-            t2, p2 = _rebalance(m2, target, p2, P, self.mut)
+            t2, p2 = _rebalance(m2, target, p2, P, self.mut, released_set)
             w2 = _mark_zombies(workers, expired)
             nxt = (m2, (), t2, p2, committed, w2, crashes, lapses, coord)
             yield (Step("coord", "tick",
@@ -821,10 +952,59 @@ class FleetModel:
                         f"dead owner's barrier)"),
                    nxt, None)
 
+        # ---- the elasticity environment ---------------------------------
+        # scale_out: the provisioner launches an unprovisioned spare; it
+        # then joins through the ordinary join path. Scheduled after a
+        # crash this IS the replacement move; nondeterministic scheduling
+        # explores every policy timing. Leader-fenced: scale decisions
+        # are coordinator control-plane moves.
+        if have_leader:
+            for wid, worker in enumerate(workers):
+                if worker[0] != _UNPROV:
+                    continue
+                w2 = list(workers)
+                w2[wid] = (_INIT, (), (-1,) * P, (-1,) * P, False, False)
+                nxt = (members, stale, target, pending, committed,
+                       tuple(w2), crashes, lapses, coord)
+                yield (Step("coord", "scale_out",
+                            f"policy scales OUT: the provisioner launches "
+                            f"spare w{wid}, which will join through the "
+                            f"ordinary join path"),
+                       nxt, None)
+
+        # scale_in: the coordinator marks a member RELEASED and re-deals
+        # its pairs among the remaining active members — moved pairs enter
+        # the EXISTING revoke barrier held by the released worker, so the
+        # voluntary leave drains + commits before its pairs' new owners
+        # may poll (release_before_drain drops that hold). Refused when it
+        # would leave fewer than one active member — the same refusal
+        # FleetCoordinator.request_release implements.
+        if have_leader and scale_ins < cfg.max_scale_ins:
+            active = [m for m in members if m not in released_set]
+            if len(active) >= 2:
+                for wid in active:
+                    rel2 = released_set | {wid}
+                    t2, p2 = _rebalance(members, target, pending, P,
+                                        self.mut, rel2)
+                    w2 = list(workers)
+                    ws, wl, wpos, wbase, wz, _ = workers[wid]
+                    w2[wid] = (ws, wl, wpos, wbase, wz, True)
+                    c2 = (leading, standby, czombie, term, ccrashes,
+                          clapses, scale_ins + 1)
+                    nxt = (members, stale, t2, p2, committed, tuple(w2),
+                           crashes, lapses, c2)
+                    yield (Step("coord", "scale_in",
+                                f"policy scales IN: w{wid} is RELEASED — "
+                                f"its pairs re-deal to the remaining "
+                                f"members behind the revoke barrier, and "
+                                f"it must drain + commit before leaving"),
+                           nxt, None)
+
         # ---- the succession environment ---------------------------------
         # coord_crash: the leading candidate dies mid-flight.
         if have_leader and ccrashes < cfg.max_coord_crashes:
-            c2 = (0, standby, czombie, term, ccrashes + 1, clapses)
+            c2 = (0, standby, czombie, term, ccrashes + 1, clapses,
+                  scale_ins)
             nxt = (members, stale, target, pending, committed, workers,
                    crashes, lapses, c2)
             yield (Step("coord", "coord_crash",
@@ -848,7 +1028,8 @@ class FleetModel:
                 snap = (term, target, pending)
             else:
                 snap = (term, None, None)
-            c2 = (0, standby, snap, term, ccrashes, clapses + 1)
+            c2 = (0, standby, snap, term, ccrashes, clapses + 1,
+                  scale_ins)
             nxt = (members, stale, target, pending, committed, workers,
                    crashes, lapses, c2)
             yield (Step("coord", "coord_lapse",
@@ -883,7 +1064,8 @@ class FleetModel:
                           f"the role lease: the term stays {term2}, so "
                           f"the fence cannot tell its decisions from the "
                           f"old leader's")
-            c2 = (1, standby - 1, czombie, term2, ccrashes, clapses)
+            c2 = (1, standby - 1, czombie, term2, ccrashes, clapses,
+                  scale_ins)
             nxt = (members, stale, target, p2, committed, workers,
                    crashes, lapses, c2)
             yield Step("coord", "elect", detail), nxt, None
@@ -896,7 +1078,8 @@ class FleetModel:
         # drop_coordinator_lease left the terms indistinguishable.
         if czombie is not None:
             zterm, ztarget, zpending = czombie
-            spent = (leading, standby, None, term, ccrashes, clapses)
+            spent = (leading, standby, None, term, ccrashes, clapses,
+                     scale_ins)
             if zterm >= term or "stale_term_fence_accepted" in self.mut:
                 # With no snapshot carried (clean model), the accepted
                 # record provably republishes the live assignment — apply

@@ -59,7 +59,10 @@ THREAD_SITES: FrozenSet[Tuple[str, str]] = frozenset({
     # delivers batch N (sched/batcher.py DispatchLane).
     ("sched/batcher.py", "self._run"),
     # Fleet serving lane (docs/fleet.md): one thread per partition-owning
-    # worker, plus the monitor thread ticking the lease coordinator.
+    # worker, plus the monitor thread ticking the lease coordinator. The
+    # autoscaler's scale-out path (Fleet._spawn_worker, fleet/autoscale/)
+    # constructs workers at a second site with the SAME (path, target)
+    # signature — one registry entry covers both.
     ("fleet/fleet.py", "self._worker_main"),
     ("fleet/fleet.py", "self._monitor_loop"),
     # Coordinator succession (fleet/control.py, docs/fleet.md "Coordinator
@@ -159,7 +162,9 @@ THREAD_ENTRY_POINTS: Tuple[EntryPoint, ...] = (
     EntryPoint("fleet-monitor", "fleet/fleet.py", "Fleet._monitor_loop", None,
                "coordinator state lives under FleetCoordinator._lock and "
                "the bus under FleetBus._lock; the tick never touches "
-               "engine/consumer state"),
+               "engine/consumer state; the autoscaler it steps keeps its "
+               "ledgers under Autoscaler._lock and spawns workers through "
+               "Fleet._spawn_worker under the fleet registry lock"),
     EntryPoint("fleet-candidate", "fleet/fleet.py", "Fleet._candidate_main",
                None,
                "succession state lives under SuccessionCoordinator._lock "
@@ -297,7 +302,8 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     # coordinator never calls out while holding it (acyclic lock graph).
     "fleet/coordinator.py::FleetCoordinator": _spec(
         any_thread=("join", "sync", "ack", "leave", "fence_lost",
-                    "assignments", "committed_lag", "last_view"),
+                    "assignments", "committed_lag", "last_view",
+                    "request_release"),
         fleet_monitor=("tick",)),
     # Fleet worker: run() (and the poll-path hooks the engine drives) is
     # the worker thread, guarded by the FleetWorker.run region;
@@ -310,7 +316,8 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     # reads of monitor-safe surfaces).
     "fleet/fleet.py::Fleet": _spec(
         any_thread=("stop", "fleet_health"),
-        fleet_monitor=("_monitor_loop", "_write_health_file"),
+        fleet_monitor=("_monitor_loop", "_write_health_file",
+                       "_spawn_worker"),
         fleet_worker=("_worker_main",),
         fleet_candidate=("_candidate_main",)),
     # Succession coordinator (fleet/control.py, docs/fleet.md "Coordinator
@@ -323,7 +330,7 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     "fleet/control.py::SuccessionCoordinator": _spec(
         any_thread=("join", "sync", "ack", "leave", "fence_lost",
                     "assignments", "committed_lag", "last_view",
-                    "succession_report"),
+                    "succession_report", "request_release"),
         fleet_monitor=("tick",),
         fleet_candidate=("step",)),
     # Control bus: a compacted-log blackboard like FleetBus — every surface
@@ -337,6 +344,21 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     # one lock, any thread.
     "fleet/control.py::TermGate": _spec(
         any_thread=("current", "try_advance", "accept")),
+    # Autoscaler (fleet/autoscale/, docs/autoscaling.md): step() runs on
+    # the fleet monitor tick (the single controller thread);
+    # stats()/report() are the cross-thread surface (the coordinator's
+    # view hook, health pollers, the post-run report). Desired capacity,
+    # the launch/release ledgers, and counters live under
+    # Autoscaler._lock; the policy object it drives is monitor-owned
+    # (its snapshot reads are the usual racy monotonic samples).
+    "fleet/autoscale/controller.py::Autoscaler": _spec(
+        any_thread=("stats", "report"),
+        fleet_monitor=("step",)),
+    # Thread provisioner: launch() rides the monitor thread today but the
+    # seam contract allows any caller; the idempotence ledger sits under
+    # its own lock and the spawn hook serializes on Fleet's registry.
+    "fleet/autoscale/provisioner.py::ThreadProvisioner": _spec(
+        any_thread=("launch", "launched")),
     # Scenario feeder (docs/scenarios.md): _run/_fire execute on the one
     # feeder thread; stats/fed/alive are the cross-thread surface
     # (counters under _lock; the error field is a write-once latch read
@@ -383,7 +405,8 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     # the scenario driver); snapshot/firing/healthz are the cross-thread
     # surface. Everything mutable sits under Sentinel._lock.
     "obs/sentinel/engine.py::Sentinel": _spec(
-        any_thread=("snapshot", "firing", "critical_firing", "healthz"),
+        any_thread=("snapshot", "firing", "critical_firing", "healthz",
+                    "last_eval_at"),
         sentinel=("evaluate", "prime")),
     # Chain-cumulative health source: attach() on the supervisor path,
     # __call__ on the sentinel driver; accumulator under its own lock,
@@ -394,7 +417,8 @@ CONCURRENT_CLASSES: Mapping[str, ClassSpec] = {
     # driving thread; the append log is serialized under _lock and
     # bundle publication rides the shared atomic writer.
     "obs/sentinel/bundle.py::IncidentRecorder": _spec(
-        any_thread=("record_fired", "record_resolved", "snapshot")),
+        any_thread=("record_fired", "record_resolved", "record_scale",
+                    "snapshot")),
 }
 
 
@@ -465,6 +489,19 @@ OBJECT_BINDINGS: Mapping[str, Tuple[str, ...]] = {
     "learn/loop.py::LearnLoop._consumer": ("Consumer",),
     "scenarios/labels.py::LabelFeeder._consumer": ("Consumer",),
     "scenarios/labels.py::LabelFeeder._producer": ("Producer",),
+    # Autoscale seams (fleet/autoscale/, docs/autoscaling.md): the
+    # controller reads the coordinator's view and actuates through the
+    # provisioner seam / the coordinator's release surface; decisions
+    # ride the control bus and the incident recorder.
+    "fleet/autoscale/controller.py::Autoscaler.coordinator":
+        ("FleetCoordinator", "SuccessionCoordinator"),
+    "fleet/autoscale/controller.py::Autoscaler.provisioner":
+        ("ThreadProvisioner",),
+    "fleet/autoscale/controller.py::Autoscaler.policy": ("ScalePolicy",),
+    "fleet/autoscale/controller.py::Autoscaler.control": ("ControlBus",),
+    "fleet/autoscale/controller.py::Autoscaler.recorder":
+        ("IncidentRecorder",),
+    "fleet/fleet.py::Fleet.autoscaler": ("Autoscaler",),
     # Sentinel seams (obs/sentinel/): the engine/fleet surfaces hold a
     # sentinel whose snapshot they read; the sentinel drives its recorder.
     "stream/engine.py::StreamingClassifier._sentinel": ("Sentinel",),
@@ -605,6 +642,28 @@ FLEET_PROTOCOLS: Tuple[RoleSpec, ...] = (
         _t("tick", "steady", "steady",
            ("fleet/fleet.py::Fleet._monitor_loop", "fleet/fleet.py::Fleet.run"),
            ("coordinator.tick",)),
+        # Elasticity (fleet/autoscale/, docs/autoscaling.md). scale_out:
+        # the controller's policy pass decides and actuates a grow through
+        # the provisioner seam — the coordinator's half is the eventual
+        # join, already modeled above.
+        _t("scale_out", "steady", "steady",
+           ("fleet/autoscale/controller.py::Autoscaler.step",),
+           ("policy.decide", "_actuate")),
+        # scale_in: a coordinator-requested VOLUNTARY LEAVE. The member is
+        # marked released and the re-deal moves its pairs behind the
+        # existing revoke barrier (`flightcheck model --autoscale`;
+        # mutation release_before_drain is the counterexample).
+        _t("scale_in", "steady", "steady",
+           ("fleet/coordinator.py::FleetCoordinator.request_release",),
+           ("_rebalance_locked",)),
+        # ...and the call sites that request it: the controller's victim
+        # walk, and the succession wrapper's leader-fenced relay (an
+        # interregnum refuses — granting from the lease cache could
+        # shrink a fleet the successor's replayed state still needs).
+        _t("scale_in", "steady", "steady",
+           ("fleet/autoscale/controller.py::Autoscaler._release_one",
+            "fleet/control.py::SuccessionCoordinator.request_release"),
+           ("coordinator.request_release",)),
     )),
     # The worker half of revoke->drain->commit->reassign: one engine
     # incarnation chain per lease, heartbeat-on-poll, crash transitions
@@ -643,6 +702,13 @@ FLEET_PROTOCOLS: Tuple[RoleSpec, ...] = (
         _t("crash", "draining", "crashed",
            ("fleet/worker.py::FleetWorker._on_poll",),
            ("death_plan.tick",)),
+        # Voluntary leave (scale-in): the ack that releases the revoke
+        # barrier returns a lease marked released; the worker has already
+        # drained + committed, so it exits through the graceful-leave
+        # path (docs/autoscaling.md "Drain before release").
+        _t("release", "draining", "left",
+           ("fleet/worker.py::FleetWorker._run",),
+           ("coordinator.ack", "coordinator.leave")),
     )),
     # The transport's manual-assignment consumer: committed-offset resume at
     # construction, fence consulted at commit time.
@@ -670,6 +736,26 @@ FLEET_PROTOCOLS: Tuple[RoleSpec, ...] = (
         _t("aggregate", "steady", "steady",
            ("fleet/coordinator.py::FleetCoordinator.tick",),
            ("bus.snapshots", "bus.publish_fleet")),
+    )),
+    # Worker provisioner (fleet/autoscale/provisioner.py,
+    # docs/autoscaling.md "Provisioner seam"): launch() ACCEPTS a bring-up
+    # (idempotent per id, refusable); the worker's existence is only ever
+    # observed through the coordinator's membership view. The checker's
+    # `scale_out` macro-step IS this machine: an unprovisioned spare flips
+    # to joinable and arrives through the ordinary join path.
+    RoleSpec("Provisioner", "fleet/autoscale/provisioner.py::ThreadProvisioner",
+             ("ready",), "ready", (
+        _t("launch", "ready", "ready",
+           ("fleet/autoscale/provisioner.py::ThreadProvisioner.launch",),
+           ("_spawn",)),
+        # ...the controller's actuation site and the in-process spawn
+        # hook that builds + starts the worker inside Fleet's registry.
+        _t("launch", "ready", "ready",
+           ("fleet/autoscale/controller.py::Autoscaler._actuate",),
+           ("provisioner.launch",)),
+        _t("launch", "ready", "ready",
+           ("fleet/fleet.py::Fleet._spawn_worker",),
+           ("thread.start",)),
     )),
     # Coordinator succession (fleet/control.py, docs/fleet.md "Coordinator
     # succession"): the coordinator ROLE as a leased machine. Candidates
@@ -812,6 +898,15 @@ FLEET_BARRIER_OBLIGATIONS: Tuple[BarrierObligation, ...] = (
             "(checker invariant revoke_barrier, mutation "
             "forget_holds_on_failover)"),
     BarrierObligation(
+        "release-rides-revoke-barrier",
+        "fleet/coordinator.py::FleetCoordinator.request_release",
+        first="call:_released.add", then="call:_rebalance_locked",
+        why="a scale-in victim must be MARKED released before the re-deal "
+            "runs — only then does the deal exclude it and move its pairs "
+            "behind the revoke barrier, so the new owners wait for its "
+            "drain + commit ack (checker invariant revoke_barrier, "
+            "mutation release_before_drain)"),
+    BarrierObligation(
         "term-fence-before-install",
         "fleet/control.py::SuccessionCoordinator._elect",
         first="call:gate.try_advance", then="call:_install",
@@ -838,7 +933,8 @@ FLEET_BARRIER_OBLIGATIONS: Tuple[BarrierObligation, ...] = (
 FLEET_PROTOCOL_VOCABULARY: Tuple[str, ...] = (
     "coordinator.join", "coordinator.sync", "coordinator.ack",
     "coordinator.leave", "coordinator.fence_lost", "coordinator.tick",
-    "coordinator.committed_lag",
+    "coordinator.committed_lag", "coordinator.request_release",
+    "provisioner.launch",
     "bus.publish", "bus.retract", "bus.publish_fleet", "bus.snapshots",
 )
 

@@ -89,6 +89,13 @@ CONTRACTS: Tuple[Contract, ...] = (
     # the control-lane delivery accounting.
     Contract("fleet/coordinator.py", "FleetCoordinator._coordinator_block",
              "test_succession.py", "COORDINATOR_BLOCK_SCHEMA"),
+    # Closed-loop autoscaling (docs/autoscaling.md): the fleet view's
+    # "autoscale" sub-object — desired/live capacity, decision counters,
+    # and the policy bounds/cooldown the ScalePolicy layer injects.
+    Contract("fleet/autoscale/controller.py", "Autoscaler.stats",
+             "test_autoscale.py", "AUTOSCALE_BLOCK_SCHEMA",
+             injected=frozenset({"min", "max", "denied",
+                                 "cooldown_remaining_s"})),
 )
 
 

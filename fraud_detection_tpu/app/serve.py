@@ -290,6 +290,27 @@ def main(argv=None) -> int:
                          "lane, so the fleet survives its own coordinator "
                          "dying (K >= 2 arms standby successors; 1 = the "
                          "classic single coordinator; --fleet)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="closed-loop autoscaling (docs/autoscaling.md): "
+                         "the fleet sizes itself from its own sentinel "
+                         "signals — scale-out on fleet_watermark_burn, "
+                         "voluntary-leave scale-in on sustained "
+                         "fleet_idle, dead capacity replaced — bounded by "
+                         "--min-workers/--max-workers. Needs --fleet N "
+                         "(the starting size); without --alerts there is "
+                         "no signal plane and the loop only replaces "
+                         "dead workers")
+    ap.add_argument("--min-workers", type=int, metavar="N", default=None,
+                    help="autoscale floor (default: 1; --autoscale)")
+    ap.add_argument("--max-workers", type=int, metavar="N", default=None,
+                    help="autoscale ceiling (default: the larger of "
+                         "--fleet and --partitions — a worker past the "
+                         "partition count would sit idle; --autoscale)")
+    ap.add_argument("--scale-cooldown", type=float, metavar="S",
+                    default=30.0,
+                    help="seconds between resizes — the anti-flap window; "
+                         "hysteresis credits a burn that started during "
+                         "it (--autoscale)")
     ap.add_argument("--mesh", action="store_true",
                     help="mesh data-parallel scoring (parallel/serving.py "
                          "MeshServingPipeline): shard every micro-batch "
@@ -559,6 +580,31 @@ def main(argv=None) -> int:
                          f"got {args.fleet_candidates}")
     if args.fleet_candidates > 1 and args.fleet == 0:
         raise SystemExit("--fleet-candidates needs --fleet N")
+    if (args.min_workers is not None or args.max_workers is not None) \
+            and not args.autoscale:
+        raise SystemExit("--min-workers/--max-workers need --autoscale")
+    autoscale_config = None
+    if args.autoscale:
+        # Closed-loop elasticity rides the in-process fleet lane only:
+        # the provisioner seam spawns THREADS against the demo broker
+        # (docs/autoscaling.md "Provisioners").
+        if args.fleet == 0:
+            raise SystemExit("--autoscale needs --fleet N (the elastic "
+                             "lane; docs/autoscaling.md)")
+        lo = args.min_workers if args.min_workers is not None else 1
+        hi = (args.max_workers if args.max_workers is not None
+              else max(args.fleet, args.partitions))
+        if lo < 1:
+            raise SystemExit(f"--min-workers must be >= 1, got {lo}")
+        if not lo <= args.fleet <= hi:
+            raise SystemExit(
+                f"--fleet {args.fleet} must sit within the autoscale "
+                f"bounds [{lo}, {hi}] (--min-workers/--max-workers)")
+        if args.scale_cooldown < 0:
+            raise SystemExit(f"--scale-cooldown must be >= 0, "
+                             f"got {args.scale_cooldown}")
+        autoscale_config = dict(min_workers=lo, max_workers=hi,
+                                cooldown_s=args.scale_cooldown)
     if args.workers > 1 and args.max_messages is not None:
         # Per-worker message caps can't split a global cap meaningfully —
         # refuse BEFORE the expensive pipeline build, like every other
@@ -1093,6 +1139,7 @@ def main(argv=None) -> int:
             sched_config=sched_config, dlq_topic=dlq_topic,
             health_file=args.fleet_health_file,
             candidates=args.fleet_candidates,
+            autoscale=autoscale_config,
             trace=args.trace, trace_sample=args.trace_sample,
             **fleet_sentinel_kw)
         if metrics_registry is not None:

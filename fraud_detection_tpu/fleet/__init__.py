@@ -9,9 +9,16 @@ the GLOBAL backlog watermark instead of per-worker guesses. The
 coordinator itself is a leased role (fleet/control.py): candidates
 contend on it over a faultable control bus and a successor inherits the
 assignment state — including in-flight revoke-barrier holds — so the
-fleet survives its own brain dying.
+fleet survives its own brain dying. On top of both, the fleet sizes
+ITSELF (fleet/autoscale/, docs/autoscaling.md): a scale policy turns the
+sentinel signal plane into grow/replace/shrink decisions, with scale-in
+as a coordinator-requested voluntary leave on the same revoke barrier.
 """
 
+from fraud_detection_tpu.fleet.autoscale import (Autoscaler, ScaleDecision,
+                                                 ScalePolicy,
+                                                 ThreadProvisioner,
+                                                 WorkerProvisioner)
 from fraud_detection_tpu.fleet.bus import FleetBus
 from fraud_detection_tpu.fleet.control import (ControlBus, ControlRecord,
                                                KafkaControlBus,
@@ -21,6 +28,7 @@ from fraud_detection_tpu.fleet.coordinator import FleetCoordinator, Lease
 from fraud_detection_tpu.fleet.fleet import Fleet
 from fraud_detection_tpu.fleet.worker import FleetWorker
 
-__all__ = ["ControlBus", "ControlRecord", "Fleet", "FleetBus",
+__all__ = ["Autoscaler", "ControlBus", "ControlRecord", "Fleet", "FleetBus",
            "FleetCoordinator", "FleetWorker", "KafkaControlBus", "Lease",
-           "SuccessionCoordinator", "TermGate"]
+           "ScaleDecision", "ScalePolicy", "SuccessionCoordinator",
+           "TermGate", "ThreadProvisioner", "WorkerProvisioner"]

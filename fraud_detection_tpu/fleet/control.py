@@ -406,6 +406,10 @@ class SuccessionCoordinator:
         self._abdicated = False
         self._leader_down_at: Optional[float] = None
         self._last_leader: Optional[str] = None
+        # Autoscale stats hook (fleet/autoscale/): propagated onto every
+        # installed incumbent so the view's ``autoscale`` block survives
+        # failover (the property setter below re-wires the live one).
+        self._autoscale_stats: Optional[Callable[[], dict]] = None
         # Bootstrap: the first candidate takes term 1 with a fresh
         # coordinator — no interregnum before the fleet's first tick.
         first = self.candidate_ids[0]
@@ -421,10 +425,24 @@ class SuccessionCoordinator:
         self._last_beacon = self._clock()
 
     def _new_coordinator(self) -> FleetCoordinator:
-        return FleetCoordinator(
+        coordinator = FleetCoordinator(
             self.topics, self.num_partitions, bus=self._fleet_bus,
             lease_ttl=self.lease_ttl, lag_fn=self._lag_fn,
             clock=self._clock, wall=self._wall)
+        coordinator.autoscale_stats = self._autoscale_stats
+        return coordinator
+
+    @property
+    def autoscale_stats(self) -> Optional[Callable[[], dict]]:
+        return self._autoscale_stats
+
+    @autoscale_stats.setter
+    def autoscale_stats(self, fn: Optional[Callable[[], dict]]) -> None:
+        with self._lock:
+            self._autoscale_stats = fn
+            coordinator = self.coordinator
+        if coordinator is not None:
+            coordinator.autoscale_stats = fn
 
     # ------------------------------------------------------------------
     # worker-facing surface (worker threads)
@@ -499,6 +517,19 @@ class SuccessionCoordinator:
                        | self._held.get(worker_id, set()))
                 return [p for p in pairs if tuple(p) not in own]
         return coordinator.fence_lost(worker_id, pairs)
+
+    def request_release(self, worker_id: str) -> bool:
+        """Coordinator-requested voluntary leave (fleet/autoscale/
+        scale-in). Leaderless, the request is REFUSED — the autoscaler
+        simply retries next tick; granting from the lease cache could
+        shrink a fleet whose successor's replayed state still needs the
+        member. A granted release lands in ``export_state`` and rides
+        the next snapshot, so an in-flight drain survives failover."""
+        with self._lock:
+            coordinator = self.coordinator
+        if coordinator is None:
+            return False
+        return coordinator.request_release(worker_id)
 
     # ------------------------------------------------------------------
     # lease cache + op outbox internals

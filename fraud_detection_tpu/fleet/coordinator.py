@@ -50,6 +50,9 @@ class Lease:
     partitions: Tuple[tuple, ...]    # granted pairs, sorted
     pending: Tuple[tuple, ...]       # target pairs withheld behind a live
                                      # previous owner's drain barrier
+    released: bool = False           # the coordinator requested this
+                                     # worker's voluntary leave (scale-in):
+                                     # drain + commit + ack, then exit
 
 
 class FleetCoordinator:
@@ -80,6 +83,10 @@ class FleetCoordinator:
         self._members: Dict[str, dict] = {}   # wid -> {renewed, joined}
         self._target: Dict[str, Set[tuple]] = {}
         self._pending: Dict[tuple, str] = {}  # pair -> live holder draining it
+        # Members the autoscaler asked to leave (scale-in): excluded from
+        # every re-deal but still live barrier HOLDERS until they drain,
+        # commit, and ack — release rides the EXISTING revoke barrier.
+        self._released: Set[str] = set()
         self._generation = 0
         self._join_seq = 0
         self._all_pairs = [(t, p) for t in self.topics
@@ -102,6 +109,11 @@ class FleetCoordinator:
         # Optional control-lane stats callable (ControlBus.stats) merged
         # into the view's coordinator block when succession is wired.
         self.control_stats: Optional[Callable[[], dict]] = None
+        # Optional autoscale stats callable (Autoscaler.stats) merged
+        # into the view as its ``autoscale`` block when elasticity is
+        # wired (fleet/autoscale/ — schema pinned by
+        # tests AUTOSCALE_BLOCK_SCHEMA, FC301).
+        self.autoscale_stats: Optional[Callable[[], dict]] = None
 
     # ------------------------------------------------------------------
     # membership (worker threads)
@@ -149,6 +161,7 @@ class FleetCoordinator:
         """Graceful departure (the worker already drained + committed):
         its partitions reassign immediately — no barrier, no ttl wait."""
         with self._lock:
+            self._released.discard(worker_id)
             if worker_id not in self._members:
                 return
             del self._members[worker_id]
@@ -156,6 +169,30 @@ class FleetCoordinator:
                          if h == worker_id]:
                 del self._pending[pair]
             self._rebalance_locked()
+
+    def request_release(self, worker_id: str) -> bool:
+        """Coordinator-requested VOLUNTARY LEAVE (the autoscaler's
+        scale-in actuator). The member is excluded from the re-deal NOW —
+        its pairs move to the surviving members *behind the existing
+        revoke barrier*, so the released worker drains and commits every
+        in-flight batch before the new owners may poll (`flightcheck
+        model` verifies this composition; mutation ``release_before_drain``
+        is the counterexample). The worker observes the released lease on
+        its next sync/ack and exits through the graceful-leave path.
+
+        Refused (returns False) for a non-member, a member already
+        released, or when granting it would leave the fleet without an
+        active (non-released) member."""
+        with self._lock:
+            if worker_id not in self._members \
+                    or worker_id in self._released:
+                return False
+            active = [w for w in self._members if w not in self._released]
+            if len(active) < 2:
+                return False
+            self._released.add(worker_id)
+            self._rebalance_locked()
+            return True
 
     def fence_lost(self, worker_id: str, pairs: Sequence[tuple]) -> List[tuple]:
         """Commit fence for the assigned consumer: which of ``pairs`` does
@@ -201,6 +238,10 @@ class FleetCoordinator:
                 "pending": sorted(
                     [[t, p], holder]
                     for (t, p), holder in self._pending.items()),
+                # In-flight scale-in drains: a successor must keep a
+                # released member OUT of its re-deals, or failover would
+                # silently cancel the voluntary leave mid-drain.
+                "released": sorted(self._released),
                 "rebalances": self.rebalances,
                 "expirations": self.expirations,
                 "ticks": self._ticks,
@@ -228,6 +269,8 @@ class FleetCoordinator:
                 (t, p): holder
                 for (t, p), holder in (state.get("pending") or [])
                 if holder in self._members}
+            self._released = {w for w in (state.get("released") or [])
+                              if w in self._members}
             self._generation = int(state.get("generation") or 0)
             self.rebalances = int(state.get("rebalances") or 0)
             self.expirations = int(state.get("expirations") or 0)
@@ -244,6 +287,7 @@ class FleetCoordinator:
                  if now - info["renewed"] > self.lease_ttl]
         for w in stale:
             del self._members[w]
+            self._released.discard(w)
             # Expiry IS the drain barrier for a dead worker: release its
             # holds — the committed offsets are the resume point.
             for pair in [p for p, h in self._pending.items() if h == w]:
@@ -260,29 +304,32 @@ class FleetCoordinator:
         old = {pair: w for w, pairs in self._target.items() for pair in pairs}
         members = sorted(self._members,
                          key=lambda w: self._members[w]["joined"])
+        # Released members (scale-in in flight) get NOTHING from the deal
+        # — their whole lease is revoked — but stay live barrier holders
+        # below until their drain acks.
+        deal = [w for w in members if w not in self._released]
         self._generation += 1
         self.rebalances += 1
         self._target = {w: set() for w in members}
-        if not members:
-            return
-        base, extra = divmod(len(self._all_pairs), len(members))
-        share = {w: base + (1 if i < extra else 0)
-                 for i, w in enumerate(members)}
-        kept: Dict[str, list] = {w: [] for w in members}
-        pool = []
-        for pair in self._all_pairs:          # partition order: deterministic
-            w = old.get(pair)
-            if w in share and len(kept[w]) < share[w]:
-                kept[w].append(pair)
-            else:
-                pool.append(pair)
-        for w in members:                     # join order: deterministic
-            take = share[w] - len(kept[w])
-            if take > 0:
-                kept[w].extend(pool[:take])
-                del pool[:take]
-        for w in members:
-            self._target[w].update(kept[w])
+        if deal:
+            base, extra = divmod(len(self._all_pairs), len(deal))
+            share = {w: base + (1 if i < extra else 0)
+                     for i, w in enumerate(deal)}
+            kept: Dict[str, list] = {w: [] for w in deal}
+            pool = []
+            for pair in self._all_pairs:      # partition order: deterministic
+                w = old.get(pair)
+                if w in share and len(kept[w]) < share[w]:
+                    kept[w].append(pair)
+                else:
+                    pool.append(pair)
+            for w in deal:                    # join order: deterministic
+                take = share[w] - len(kept[w])
+                if take > 0:
+                    kept[w].extend(pool[:take])
+                    del pool[:take]
+            for w in deal:
+                self._target[w].update(kept[w])
         # Barrier: pairs that moved away from a still-live previous owner
         # wait for its drain ack; everything else (dead/absent owner, or
         # still with its owner) clears immediately. An EXISTING hold outlives
@@ -293,11 +340,18 @@ class FleetCoordinator:
         # model-checker counterexample, mutation `forget_barrier_holds`;
         # regression: tests/test_fleet.py
         # test_coordinator_barrier_survives_consecutive_rebalances).
+        # Iterates ALL pairs, not just targeted ones: a pair the deal has
+        # nobody to give to yet (every dealable member released mid-scale-
+        # in) keeps its live holder's hold — the hold protects the pair's
+        # NEXT owner, whoever that turns out to be.
+        new_owner = {pair: w for w, pairs in self._target.items()
+                     for pair in pairs}
         self._pending = {
             pair: holder
-            for w in members for pair in self._target[w]
+            for pair in self._all_pairs
             for holder in (self._pending.get(pair, old.get(pair)),)
-            if holder not in (None, w) and holder in self._members}
+            if holder is not None and holder != new_owner.get(pair)
+            and holder in self._members}
 
     def _lease_locked(self, worker_id: str) -> Lease:
         target = self._target.get(worker_id, set())
@@ -305,7 +359,8 @@ class FleetCoordinator:
             p for p in target
             if self._pending.get(p) not in (None, worker_id)))
         granted = tuple(sorted(p for p in target if p not in withheld))
-        return Lease(worker_id, self._generation, granted, withheld)
+        return Lease(worker_id, self._generation, granted, withheld,
+                     released=worker_id in self._released)
 
     # ------------------------------------------------------------------
     # observability + aggregation (monitor thread)
@@ -413,6 +468,17 @@ class FleetCoordinator:
             # signal: an interregnum keeps republishing the stale view).
             "coordinator": self._coordinator_block(),
         }
+        # Elasticity block (fleet/autoscale/): desired-vs-live capacity,
+        # cumulative scale counters the sentinel's autoscale_flap rule
+        # windows over, and the last decision with its evidence. Absent
+        # (not null) when autoscaling isn't wired, so '+'-joined sentinel
+        # paths over the counters abstain instead of reading zeros.
+        scale_fn = self.autoscale_stats
+        if scale_fn is not None:
+            try:
+                view["autoscale"] = scale_fn()
+            except Exception:  # noqa: BLE001 — observability never kills
+                pass
         with self._lock:
             self._last_view = view
         if self.bus is not None:

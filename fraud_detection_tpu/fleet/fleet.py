@@ -55,6 +55,7 @@ class Fleet:
                  role_ttl: Optional[float] = None,
                  coordinator_kill=None,
                  control=None,
+                 autoscale=None,
                  worker_prefix: str = "w"):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
@@ -100,6 +101,7 @@ class Fleet:
         # time); None = process monotonic.
         self.sentinel = None
         self.worker_sentinels: dict = {}
+        self._spawn_worker_rules = None     # per-worker pack for scale-outs
         if sentinel_rules is not None:
             from fraud_detection_tpu.obs.sentinel import (Sentinel,
                                                           default_rule_pack)
@@ -115,6 +117,7 @@ class Fleet:
                                 fast_s=2.0, slow_s=8.0, resolve_s=1.0,
                                 p99_ms=60000.0, stall_s=30.0))
             if worker_rules:
+                self._spawn_worker_rules = worker_rules
                 holder = self.worker_sentinels
                 for i in range(n_workers):
                     wid = f"{worker_prefix}{i}"
@@ -128,6 +131,18 @@ class Fleet:
         self.death_plan = death_plan
         self.tick_interval = tick_interval
         self.health_file = health_file
+        # Saved factory wiring so the autoscaler's provisioner can build
+        # workers AFTER construction exactly the way __init__ does.
+        self._make_engine = make_engine
+        self._make_consumer = self._bind_consumer_factory(make_consumer)
+        self.heartbeat_interval = heartbeat_interval
+        self.worker_prefix = worker_prefix
+        self._trace = trace
+        self._trace_sample = trace_sample
+        self._trace_seed = trace_seed
+        self._sentinel_kw = ({} if sentinel_clock is None
+                             else {"clock": sentinel_clock})
+        self._idle_timeout: Optional[float] = None
         # Row tracing (docs/observability.md): one RowTracer per worker,
         # shared across that worker's engine incarnations — make_engine
         # factories look it up via ``tracers`` (Fleet.in_process wires it
@@ -140,7 +155,7 @@ class Fleet:
         self.workers: List[FleetWorker] = [
             FleetWorker(f"{worker_prefix}{i}", self.coordinator, self.bus,
                         make_engine,
-                        self._bind_consumer_factory(make_consumer),
+                        self._make_consumer,
                         death_plan=death_plan,
                         heartbeat_interval=heartbeat_interval,
                         rowtrace=self.tracers.get(f"{worker_prefix}{i}"),
@@ -150,10 +165,91 @@ class Fleet:
         self._worker_by_id = {w.worker_id: w for w in self.workers}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # Registry lock for DYNAMIC membership (fleet/autoscale/): the
+        # monitor thread appends scaled-out workers/threads while run()'s
+        # join loop and health snapshots iterate — every reader takes a
+        # snapshot under this lock, every writer appends under it.
+        self._registry = threading.Lock()
+        # Closed-loop elasticity (docs/autoscaling.md): a ScalePolicy (or
+        # its kwargs as a dict) arms the Autoscaler on the monitor tick —
+        # sentinel signals in, provisioner launches / voluntary-leave
+        # releases out, every decision term-stamped on the control lane.
+        self.autoscaler = None
+        if autoscale is not None:
+            from fraud_detection_tpu.fleet.autoscale import (
+                Autoscaler, ScalePolicy, ThreadProvisioner)
+
+            policy = (autoscale if isinstance(autoscale, ScalePolicy)
+                      else ScalePolicy(**dict(autoscale)))
+            sentinel = self.sentinel
+            if sentinel is not None:
+                # Share the fleet sentinel's clock domain (virtual
+                # seconds under the scenario harness) WITHOUT advancing
+                # it: decisions are stamped at the evaluation that
+                # produced their signals.
+                scale_clock = lambda: sentinel.last_eval_at() or 0.0  # noqa: E731
+                firing = sentinel.firing
+            else:
+                # Signal-less elasticity still replaces dead capacity.
+                scale_clock = time.monotonic
+                firing = None
+            self.autoscaler = Autoscaler(
+                policy, ThreadProvisioner(self._spawn_worker),
+                self.coordinator, initial_workers=n_workers,
+                firing=firing,
+                # Decisions ride the SAME control lane succession uses
+                # (the proxy owns one even when none was injected), so a
+                # successor inherits the sizing history.
+                control=(control if control is not None
+                         else getattr(self.coordinator, "control", None)),
+                recorder=sentinel_recorder,
+                clock=scale_clock, worker_prefix=worker_prefix)
+            self.coordinator.autoscale_stats = self.autoscaler.stats
 
     @staticmethod
     def _bind_consumer_factory(make_consumer):
         return make_consumer
+
+    def _spawn_worker(self, worker_id: str) -> bool:
+        """ThreadProvisioner's spawn hook (fleet/autoscale/): build one
+        more FleetWorker exactly the way __init__ does — same factories,
+        its own tracer and sentinel — register it, and start its thread.
+        Runs on the monitor thread; refuses once shutdown began (a
+        scale-out must never outlive ``stop()``)."""
+        if self._stop.is_set():
+            return False
+        with self._registry:
+            if worker_id in self._worker_by_id:
+                return True     # idempotent retry: already provisioned
+            if self._trace:
+                self.tracers[worker_id] = RowTracer(
+                    worker=worker_id, sample=self._trace_sample,
+                    seed=self._trace_seed)
+            if self._spawn_worker_rules:
+                from fraud_detection_tpu.obs.sentinel import Sentinel
+
+                def source(w=worker_id):
+                    worker = self._worker_by_id.get(w)
+                    return worker.health() if worker is not None else None
+
+                self.worker_sentinels[worker_id] = Sentinel(
+                    source, self._spawn_worker_rules, worker=worker_id,
+                    **self._sentinel_kw)
+            worker = FleetWorker(
+                worker_id, self.coordinator, self.bus, self._make_engine,
+                self._make_consumer, death_plan=self.death_plan,
+                heartbeat_interval=self.heartbeat_interval,
+                rowtrace=self.tracers.get(worker_id),
+                sentinel=self.worker_sentinels.get(worker_id))
+            self.workers.append(worker)
+            self._worker_by_id[worker_id] = worker
+            thread = threading.Thread(
+                target=self._worker_main, args=(worker, self._idle_timeout),
+                name=f"fleet-{worker_id}", daemon=True)
+            self._threads.append(thread)
+        thread.start()
+        log.info("fleet scaled out: %s provisioned", worker_id)
+        return True
 
     # ------------------------------------------------------------------
     # in-process wiring (tests / bench / demo CLI)
@@ -186,7 +282,8 @@ class Fleet:
                    candidates: int = 1,
                    role_ttl: Optional[float] = None,
                    coordinator_kill=None,
-                   control=None) -> "Fleet":
+                   control=None,
+                   autoscale=None) -> "Fleet":
         """A fleet over an InProcessBroker: assigned consumers with the
         coordinator's commit fence, group-lag drain signal, one shared
         scoring pipeline, and (with ``sched_config``) a per-worker adaptive
@@ -263,7 +360,8 @@ class Fleet:
             sentinel_clock=sentinel_clock,
             sentinel_recorder=sentinel_recorder,
             candidates=candidates, role_ttl=role_ttl,
-            coordinator_kill=coordinator_kill, control=control)
+            coordinator_kill=coordinator_kill, control=control,
+            autoscale=autoscale)
         fleet_holder["fleet"] = fleet
         return fleet
 
@@ -272,22 +370,28 @@ class Fleet:
     # ------------------------------------------------------------------
 
     def stop(self) -> None:
-        """Cooperative shutdown: every worker drains + commits and leaves."""
+        """Cooperative shutdown: every worker drains + commits and leaves.
+        The latch is set FIRST so a racing scale-out refuses instead of
+        launching a worker nobody will stop."""
         self._stop.set()
-        for w in self.workers:
+        with self._registry:
+            workers = list(self.workers)
+        for w in workers:
             w.stop()
 
     def fleet_health(self) -> dict:
         """Monitor-thread-safe aggregate: the coordinator's last view plus
         every live worker's engine health (the ``--fleet-health-file``
         payload and the serve CLI's exit report)."""
+        with self._registry:
+            workers = list(self.workers)
         return {
             "time": time.time(),
             "fleet": self.coordinator.last_view(),
             "alerts": (self.sentinel.snapshot()
                        if self.sentinel is not None else None),
             "workers": {w.worker_id: {**w.result(), "health": w.health()}
-                        for w in self.workers},
+                        for w in workers},
         }
 
     def _write_health_file(self) -> None:
@@ -308,6 +412,13 @@ class Fleet:
                 # Coordinator-level rules judged on the view the tick just
                 # aggregated (evaluate() guards its own failures).
                 self.sentinel.evaluate()
+            if self.autoscaler is not None:
+                # Elasticity judged AFTER the sentinel pass: the policy
+                # sees exactly the signal state this tick produced.
+                try:
+                    self.autoscaler.step()
+                except Exception:  # noqa: BLE001 — sizing must not kill
+                    log.exception("fleet autoscaler step failed")
             self._write_health_file()
 
     def _candidate_main(self, cid: str) -> None:
@@ -344,6 +455,10 @@ class Fleet:
             # so victims must not depend on thread start races.
             for w in self.workers:
                 self.death_plan.arm(w.worker_id)
+        # Scaled-out workers inherit this run's drain semantics (the
+        # provisioner spawns with the same idle_timeout).
+        with self._registry:
+            self._idle_timeout = idle_timeout
         t0 = time.perf_counter()
         monitor = threading.Thread(target=self._monitor_loop,
                                    name="fleet-monitor", daemon=True)
@@ -356,27 +471,50 @@ class Fleet:
                 for cid in self.coordinator.candidate_ids]
             for t in candidate_threads:
                 t.start()
-        self._threads = [
-            threading.Thread(target=self._worker_main,
-                             args=(w, idle_timeout),
-                             name=f"fleet-{w.worker_id}", daemon=True)
-            for w in self.workers]
-        for t in self._threads:
+        with self._registry:
+            self._threads = [
+                threading.Thread(target=self._worker_main,
+                                 args=(w, idle_timeout),
+                                 name=f"fleet-{w.worker_id}", daemon=True)
+                for w in self.workers]
+            threads = list(self._threads)
+        for t in threads:
             t.start()
         try:
-            for t in self._threads:
-                t.join(join_timeout)
+            # The join loop re-snapshots the registry each pass: the
+            # autoscaler grows ``_threads`` from the monitor thread, and
+            # a scaled-out worker is as load-bearing as a founding one.
+            deadline = (time.perf_counter() + join_timeout
+                        if join_timeout is not None else None)
+            while True:
+                with self._registry:
+                    threads = list(self._threads)
+                alive = [t for t in threads if t.is_alive()]
+                if not alive:
+                    break
+                if deadline is not None and time.perf_counter() >= deadline:
+                    break
+                alive[0].join(min(0.2, self.tick_interval * 4))
         except KeyboardInterrupt:
             # Operator shutdown: drain + leave gracefully (partitions
             # reassign immediately; nothing waits out a lease ttl).
             self.stop()
-            for t in self._threads:
+            with self._registry:
+                threads = list(self._threads)
+            for t in threads:
                 t.join(timeout=30.0)
         finally:
             self._stop.set()
             monitor.join(timeout=5.0)
             for t in candidate_threads:
                 t.join(timeout=5.0)
+            # A scale-out racing the loop's exit: the latch above stops
+            # further launches; whatever landed still gets drained.
+            with self._registry:
+                threads = list(self._threads)
+            for t in threads:
+                if t.is_alive():
+                    t.join(timeout=5.0)
         wall = time.perf_counter() - t0
         try:
             final_view = self.coordinator.tick()   # post-run aggregate
@@ -384,18 +522,20 @@ class Fleet:
             final_view = self.coordinator.last_view()
         self._write_health_file()
         total = StreamStats()
-        for w in self.workers:
+        with self._registry:
+            workers = list(self.workers)
+        for w in workers:
             _merge_stats(total, w.stats)
         total.elapsed = wall     # workers overlap: wall-clock, not the sum
-        deaths = [w.result() for w in self.workers if w.death is not None]
-        errors = [w.result() for w in self.workers if w.error is not None]
+        deaths = [w.result() for w in workers if w.death is not None]
+        errors = [w.result() for w in workers if w.error is not None]
         out = {
             **total.as_dict(),
-            "workers": len(self.workers),
-            "per_worker": [w.result() for w in self.workers],
+            "workers": len(workers),
+            "per_worker": [w.result() for w in workers],
             "per_worker_processed": [w.stats.processed
-                                     for w in self.workers],
-            "incarnations": sum(w.incarnations for w in self.workers),
+                                     for w in workers],
+            "incarnations": sum(w.incarnations for w in workers),
             "rebalances": self.coordinator.rebalances,
             "lease_expirations": self.coordinator.expirations,
             "deaths": deaths,
@@ -404,6 +544,8 @@ class Fleet:
         }
         if self.death_plan is not None:
             out["death_plan"] = self.death_plan.report()
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.report()
         if hasattr(self.coordinator, "succession_report"):
             succession = self.coordinator.succession_report()
             if self.coordinator_kill is not None:

@@ -161,7 +161,7 @@ class FleetWorker:
         cur = self._lease
         if cur is not None and lease.generation != cur.generation:
             if (set(lease.partitions) != set(cur.partitions)
-                    or lease.pending):
+                    or lease.pending or lease.released):
                 # Our ownership changed (or partitions are waiting on a
                 # peer's drain): end this incarnation. The engine's
                 # shutdown path drains + commits in-flight batches; the
@@ -251,6 +251,15 @@ class FleetWorker:
                 # Incarnation fully drained + committed: release anything
                 # the last rebalance revoked from us.
                 lease = self.coordinator.ack(self.worker_id)
+                if lease.released:
+                    # Coordinator-requested voluntary leave (scale-in,
+                    # fleet/autoscale/): the engine shutdown above drained
+                    # + committed everything, the ack dropped our barrier
+                    # holds — exit so the finally block leaves the fleet
+                    # and retracts our bus doc. Drain-before-release is
+                    # the checker's release_before_drain obligation.
+                    graceful_exit = True
+                    break
                 if self._stopped:
                     graceful_exit = True
                     break

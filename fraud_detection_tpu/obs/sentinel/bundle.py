@@ -189,6 +189,18 @@ class IncidentRecorder:
                 "health": recent[-1][1] if recent else None,
             })
 
+    def record_scale(self, decision: dict,
+                     evidence_window: Sequence[Tuple[float, object]] = ()
+                     ) -> bool:
+        """One autoscale decision (fleet/autoscale/) on the SAME
+        append-only timeline the alert transitions use, with the evidence
+        the policy judged — "why did the fleet grow at 3am" reads next to
+        the alert that caused it, in one ``incidents.jsonl``."""
+        return self._append({
+            "event": "scale", **decision,
+            "evidence_window": [{"t": round(t, 6), "value": v}
+                                for t, v in evidence_window]})
+
     def snapshot(self) -> dict:
         with self._lock:
             return {"dir": self.dir, "recorded": self.recorded,

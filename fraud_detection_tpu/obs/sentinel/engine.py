@@ -234,6 +234,14 @@ class Sentinel:
             return sorted(n for n, s in self._states.items()
                           if s.state == "firing")
 
+    def last_eval_at(self) -> Optional[float]:
+        """Stamp of the newest evaluation, in this sentinel's clock
+        domain (VIRTUAL seconds under the scenario harness) — the time
+        base the autoscaler shares so scale decisions are stamped in the
+        same domain as the signals that caused them (fleet/autoscale/)."""
+        with self._lock:
+            return self._last_eval_at
+
     def critical_firing(self) -> List[str]:
         """Firing rules whose severity is critical — the /healthz gate."""
         with self._lock:

@@ -19,12 +19,18 @@ vocabulary the codebase actually models:
   does: a negative delta reads as "restarted from zero".
 * ``ratio`` — instantaneous ratio of two cumulative counters (the
   explain-coverage gauge: explained-or-accounted over submitted).
-  ``num``/``den`` accept ``+``-joined path lists, summed.
+  ``num``/``den`` accept ``+``-joined path lists, summed; honors
+  ``while_path`` (fleet idleness only matters once traffic has flowed).
 * ``delta`` — the change of a counter over the fast window compared
   against ``limit`` (breaker opens, fence/zombie commit events, worker
   count drops — a NEGATIVE limit with ``op="<="`` alerts on decrease);
   honors ``while_path`` (a membership drop only alerts while committed
-  work remains).
+  work remains). A decrease-watching delta judges the drop from the
+  window's HIGH-WATER mark, not the far-edge sample: the window can
+  reach back to a sample taken before the watched gauge finished
+  forming (a sentinel primed mid-group-formation records membership 1),
+  and a far-edge comparison would read a later real 2 → 1 death as 0.
+  Growth inside the window must never mask a drop.
 * ``absence`` / ``stale`` — the path is missing/None (a subsystem stopped
   reporting), or a counter has not moved across the fast window while
   ``while_path`` is truthy (progress stalled while work remains).
@@ -166,6 +172,8 @@ class AlertRule:
                 return False, None
             return _OPS[self.op](v, self.limit), v
         if self.kind == "ratio":
+            if not self._while_ok(cur):
+                return False, None
             found_n, n = resolve_path(cur, self.num)
             found_d, d = resolve_path(cur, self.den)
             if not found_n or not found_d or not isinstance(n, (int, float)) \
@@ -180,7 +188,8 @@ class AlertRule:
             if not self._while_ok(cur):
                 return False, None
             d = self._window_delta(ring, now, self.path, self.fast_s,
-                                   reset_guard=self.op in (">", ">="))
+                                   reset_guard=self.op in (">", ">="),
+                                   from_peak=self.op in ("<", "<="))
             if d is None:
                 return False, None
             return _OPS[self.op](d, self.limit), d
@@ -233,7 +242,8 @@ class AlertRule:
 
     def _window_delta(self, ring, now: float, path: str,
                       window_s: float, *,
-                      reset_guard: bool = True) -> Optional[float]:
+                      reset_guard: bool = True,
+                      from_peak: bool = False) -> Optional[float]:
         old = self._at_or_before(ring, now - window_s)
         if old is None:
             return None
@@ -243,6 +253,20 @@ class AlertRule:
             return None
         if not found_old or not isinstance(v_old, (int, float)):
             v_old = 0.0         # the counter appeared mid-window
+        if from_peak:
+            # Decrease-watching gauge (module docstring): the drop is
+            # judged from the window's high-water mark, so a far edge
+            # that predates the gauge's formation (membership sampled
+            # mid-group-settlement) cannot mask a real drop. The current
+            # sample participates: if it IS the peak, the delta is 0.
+            peak = float(v_old)
+            for stamp, snap in ring:
+                if stamp < old[0]:
+                    continue
+                found, v = resolve_path(snap, path)
+                if found and isinstance(v, (int, float)) and float(v) > peak:
+                    peak = float(v)
+            v_old = peak
         d = float(v_cur) - float(v_old)
         # Counter reset (supervised restart): rate() semantics — the
         # counter restarted from zero, so the post-reset value IS the
@@ -402,7 +426,10 @@ def default_rule_pack(*, fast_s: float = 30.0, slow_s: float = 120.0,
 def fleet_rule_pack(*, backlog_limit: float = 5000.0,
                     for_s: float = 0.0, resolve_s: float = 10.0,
                     fast_s: float = 30.0, slow_s: float = 120.0,
-                    stale_s: Optional[float] = None
+                    stale_s: Optional[float] = None,
+                    idle_limit: float = 100.0,
+                    idle_for_s: Optional[float] = None,
+                    flap_limit: float = 3.0
                     ) -> Tuple[AlertRule, ...]:
     """Coordinator-level rules over the aggregated fleet view
     (``FleetCoordinator.tick``'s block under ``"fleet"``) plus the
@@ -415,9 +442,18 @@ def fleet_rule_pack(*, backlog_limit: float = 5000.0,
     sampling — but a STALE rule only fires once the counter sat frozen
     for the WHOLE window, so it must stay shorter than the outage it
     exists to catch (an interregnum lasts ~``role_ttl`` plus one
-    election; docs/fleet.md "Coordinator succession")."""
+    election; docs/fleet.md "Coordinator succession").
+
+    ``idle_limit``/``idle_for_s`` tune ``fleet_idle`` (the autoscaler's
+    scale-IN trigger, docs/autoscaling.md) and ``flap_limit`` tunes
+    ``autoscale_flap`` (the control-arm no-flap gate); ``idle_for_s``
+    defaults to ``fast_s`` — idleness is only an actionable signal once
+    it has been sustained, or every inter-burst lull would shrink the
+    fleet."""
     if stale_s is None:
         stale_s = fast_s
+    if idle_for_s is None:
+        idle_for_s = fast_s
     return (
         # The GLOBAL backlog watermark burning past the shed threshold's
         # neighborhood: the whole fleet is drowning, not one worker.
@@ -461,6 +497,37 @@ def fleet_rule_pack(*, backlog_limit: float = 5000.0,
                   description="coordinator ticks stalled while work "
                               "remained — coordinator death or control-"
                               "lane partition (docs/fleet.md)"),
+        # Sustained LOW backlog per live member: spare capacity the
+        # autoscaler can return (fleet/autoscale/ scale-in trigger).
+        # Double-guarded against the empty-topic trap: ``min_den=1``
+        # abstains when the view shows no members (an interregnum's 0/0
+        # must not read as idle), and ``while_path`` on the fleet's
+        # cumulative processed counter abstains until traffic has
+        # actually flowed — a fleet that never saw a row is WAITING,
+        # not idle, and must not shrink→flap on startup.
+        AlertRule("fleet_idle", "ratio", num="fleet.global_backlog",
+                  den="fleet.n_workers", op="<", limit=idle_limit,
+                  severity="warning", min_den=1,
+                  while_path="fleet.processed_total",
+                  for_s=idle_for_s, resolve_s=resolve_s,
+                  fast_s=fast_s, slow_s=slow_s,
+                  description="sustained low backlog per worker after "
+                              "traffic flowed — spare capacity "
+                              "(docs/autoscaling.md)"),
+        # The fleet resized ``flap_limit`` times inside the window: the
+        # policy is oscillating (hysteresis/cooldown mistuned), not
+        # tracking load. Sums the CUMULATIVE scale counters, so the rule
+        # abstains entirely while the autoscale block is absent (a
+        # static fleet can never flap).
+        AlertRule("autoscale_flap", "delta",
+                  path="fleet.autoscale.scale_outs"
+                       "+fleet.autoscale.scale_ins"
+                       "+fleet.autoscale.replacements",
+                  op=">=", limit=flap_limit, severity="warning",
+                  fast_s=slow_s, slow_s=slow_s, resolve_s=resolve_s,
+                  description="repeated scale events inside the window — "
+                              "autoscale oscillation "
+                              "(docs/autoscaling.md)"),
         # The role changed hands twice inside the window: an election
         # storm (flapping incumbents, a term war), not a one-off
         # failover — one clean succession must NOT fire this.

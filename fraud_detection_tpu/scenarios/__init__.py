@@ -22,7 +22,8 @@ and judges the system against declared SLOs:
 """
 
 from fraud_detection_tpu.scenarios.clock import ScenarioClock, derive_seed
-from fraud_detection_tpu.scenarios.gameday import (CATALOG, ChaosSpec,
+from fraud_detection_tpu.scenarios.gameday import (CATALOG, AutoscaleSpec,
+                                                   ChaosSpec,
                                                    ExpectedDetection,
                                                    GameDay, GameDayResult,
                                                    KillSpec, LearnSpec,
@@ -46,6 +47,7 @@ from fraud_detection_tpu.scenarios.traffic import (CampaignWave, DiurnalLoad,
                                                    compose, generate)
 
 __all__ = [
+    "AutoscaleSpec",
     "CATALOG", "CampaignWave", "ChaosSpec", "DiurnalLoad", "DriftCampaign",
     "ExpectedDetection", "FlashCrowd", "GameDay", "GameDayResult",
     "KillSpec", "LabelFeeder", "LearnSpec", "ScenarioClock", "SentinelSpec",

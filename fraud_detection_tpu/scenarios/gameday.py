@@ -119,6 +119,34 @@ class CoordKillSpec:
 
 
 @dataclass(frozen=True)
+class AutoscaleSpec:
+    """Closed-loop elasticity as scenario data (fleet/autoscale/,
+    docs/autoscaling.md): the ScalePolicy bounds/hysteresis the fleet
+    runner arms, plus the declared surge onset the reaction-latency
+    evidence measures from. The autoscaler reads the game day's OWN
+    sentinel (``fleet_watermark_burn`` out, ``fleet_idle`` in), so an
+    elastic scenario must declare a :class:`SentinelSpec` — the signals
+    it scales on are the ones the run's watchdog judges."""
+
+    min_workers: int = 1
+    max_workers: int = 4
+    cooldown_s: float = 1.0
+    out_for_s: float = 0.0
+    in_for_s: float = 0.0
+    step: int = 1
+    # Declared surge onset (virtual s): origin for the
+    # ``autoscale_reaction_s`` evidence (first scale_out.at - surge_at_s).
+    surge_at_s: float = 0.0
+
+    def policy_kwargs(self) -> dict:
+        return {"min_workers": self.min_workers,
+                "max_workers": self.max_workers,
+                "cooldown_s": self.cooldown_s,
+                "out_for_s": self.out_for_s, "in_for_s": self.in_for_s,
+                "step": self.step}
+
+
+@dataclass(frozen=True)
 class ExpectedDetection:
     """One seeded fault class and the alert that must catch it: the
     sentinel gate asserts rule ``rule`` FIRES within ``within_s``
@@ -267,6 +295,18 @@ class GameDay:
     candidates: int = 1
     role_ttl: Optional[float] = None
     coordinator_kills: Optional[CoordKillSpec] = None
+    # Closed-loop autoscaling (fleet/autoscale/, docs/autoscaling.md):
+    # the fleet sizes itself from the run's sentinel signals — scale-out
+    # on the burn, voluntary-leave scale-in on sustained idle, every
+    # decision term-stamped on the control lane and judged by the SLOs
+    # over the evidence's ``autoscale`` block.
+    autoscale: Optional[AutoscaleSpec] = None
+    # Declared pacing: elasticity is judged against the SLOPE of the
+    # load, so elastic scenarios pin time_scale (1.0 = real time) instead
+    # of inheriting the caller's warp default — a warp feed lands the
+    # whole tide in an instant and there is no curve left to track. An
+    # explicit nonzero --time-scale still wins.
+    time_scale: Optional[float] = None
     chaos: Optional[ChaosSpec] = None
     hot_swap_at: Optional[float] = None   # virtual seconds
     breaker_threshold: Optional[int] = None
@@ -360,6 +400,26 @@ class GameDay:
                 raise ValueError(
                     f"game day {self.name!r}: the learn loop warm-starts "
                     f"boosted trees; set model='xgb' (got {self.model!r})")
+        if self.autoscale is not None:
+            if not self.fleet_mode:
+                raise ValueError(
+                    f"game day {self.name!r}: autoscaling needs the fleet "
+                    "runner (workers >= 2)")
+            if self.sentinel is None:
+                raise ValueError(
+                    f"game day {self.name!r}: the autoscaler is signal-"
+                    "driven — declare a SentinelSpec (the fleet pack "
+                    "carries fleet_watermark_burn / fleet_idle)")
+            a = self.autoscale
+            if not (a.min_workers <= self.workers <= a.max_workers):
+                raise ValueError(
+                    f"game day {self.name!r}: workers ({self.workers}) "
+                    f"must sit inside the autoscale bounds "
+                    f"[{a.min_workers}, {a.max_workers}]")
+        if self.time_scale is not None and self.time_scale <= 0:
+            raise ValueError(
+                f"game day {self.name!r}: declared time_scale must be "
+                f"> 0 (got {self.time_scale}); leave it None for warp")
         if self.sentinel is not None and self.sentinel.expect:
             known = {r.name for r in
                      self.sentinel.resolve_rules(self.fleet_mode)}
@@ -489,6 +549,10 @@ def run_gameday(gd: GameDay, *, pipeline=None, time_scale: float = 0.0,
     """Execute a game day and judge its SLOs (see module docstring)."""
     from fraud_detection_tpu.stream import InProcessBroker
 
+    if time_scale == 0.0 and gd.time_scale is not None:
+        # The scenario declares its pacing (elastic tides are judged
+        # against the slope); an explicit nonzero --time-scale still wins.
+        time_scale = gd.time_scale
     clock = ScenarioClock(gd.seed, time_scale=time_scale)
     events = compose(gd.traffic, clock)
     if not events:
@@ -623,6 +687,8 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
         heartbeat_interval=0.02, tick_interval=0.02,
         candidates=gd.candidates, role_ttl=gd.role_ttl,
         coordinator_kill=coord_kill,
+        autoscale=(gd.autoscale.policy_kwargs()
+                   if gd.autoscale is not None else None),
         fault_plan=plan, trace=True, trace_sample=1.0, **sentinel_kw)
     feeder.start()
     _wait_for_feed(feeder, n=min(64, len(feeder.events)))
@@ -634,7 +700,20 @@ def _run_fleet(gd: GameDay, serving, broker, feeder: TrafficFeeder,
                2.0 * clock.time_scale * max(gaps, default=0.0))
     out = fleet.run(idle_timeout=idle, join_timeout=300.0)
     feeder.join(timeout=120.0)
+    # Scale-out reaction latency in VIRTUAL seconds: decision stamps ride
+    # the sentinel's clock (VirtualCadence above), so the first
+    # scale_out's ``at`` minus the DECLARED surge onset is comparable
+    # across pacings and hosts (docs/autoscaling.md, the bench's
+    # ``autoscale`` section trends it).
+    reaction = None
+    if gd.autoscale is not None:
+        outs = [d for d in (out.get("autoscale") or {}).get(
+                    "decisions") or [] if d.get("kind") == "scale_out"]
+        if outs:
+            reaction = round(outs[0]["at"] - gd.autoscale.surge_at_s, 3)
     return {
+        "autoscale": out.get("autoscale"),
+        "autoscale_reaction_s": reaction,
         "stats": {k: v for k, v in out.items()
                   if not isinstance(v, (dict, list))},
         "workers": out["workers"],
@@ -1346,6 +1425,156 @@ def _diurnal_hotkey(seed: int, scale: float) -> GameDay:
         ))
 
 
+def _autoscale_rules(*, backlog_limit: float, idle_limit: float,
+                     idle_for_s: float, fast_s: float = 1.0):
+    """The fleet pack tuned for elastic game days: tight burn/idle
+    windows (decisions are judged in seconds, not hours), the stale
+    window kept short of any interregnum, and the flap watchdog at its
+    default 3-events-per-window budget."""
+    from fraud_detection_tpu.obs.sentinel import fleet_rule_pack
+
+    return fleet_rule_pack(backlog_limit=backlog_limit, fast_s=fast_s,
+                           slow_s=4.0, resolve_s=0.5, stale_s=2.0,
+                           idle_limit=idle_limit, idle_for_s=idle_for_s)
+
+
+def _diurnal_tide_scale(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="diurnal_tide_scale",
+        description="The elastic tide: a paced diurnal curve whose crest "
+                    "outruns two workers — the autoscaler must grow the "
+                    "fleet on the watermark burn and hand the extra "
+                    "worker back on the trough through the voluntary-"
+                    "leave revoke barrier, with exact accounting, "
+                    "bounded churn, and bounded reaction latency in "
+                    "virtual seconds.",
+        seed=seed,
+        workers=2,
+        partitions=4,
+        batch_size=64,
+        time_scale=1.0,
+        idle_timeout=2.5,
+        # One full cosine period: trough -> crest (t = 4) -> trough. The
+        # crest rate is far past what two workers drain, the trough is
+        # near-idle; the surge onset for reaction latency is the upslope
+        # midpoint where the rate crosses the fleet's static capacity.
+        traffic=(DiurnalLoad(name="tide", duration_s=8.0,
+                             base_rate=30 * scale, peak_rate=2000 * scale,
+                             period_s=8.0, scam_fraction=0.15),),
+        autoscale=AutoscaleSpec(min_workers=2, max_workers=3,
+                                cooldown_s=1.5, out_for_s=0.2,
+                                in_for_s=0.3, surge_at_s=2.0),
+        sentinel=SentinelSpec(
+            rules=_autoscale_rules(backlog_limit=120.0, idle_limit=100.0,
+                                   idle_for_s=0.4),
+            expect=(ExpectedDetection("fleet_watermark_burn",
+                                      fault_at_s=2.0, within_s=20.0),)),
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            # THE gates this scenario exists for: the fleet breathed out
+            # on the crest and back in on the trough...
+            SloSpec("scaled_out", path="autoscale.scale_outs", op=">=",
+                    limit=1),
+            SloSpec("scaled_in", path="autoscale.scale_ins", op=">=",
+                    limit=1),
+            # ...without oscillating (the autoscale_flap budget is 3
+            # events per window; one tide cycle must stay well under it).
+            SloSpec("bounded_churn_out", path="autoscale.scale_outs",
+                    op="<=", limit=2),
+            SloSpec("bounded_churn_in", path="autoscale.scale_ins",
+                    op="<=", limit=2),
+            SloSpec("reaction_bounded_s", path="autoscale_reaction_s",
+                    op="<=", limit=15.0),
+            SloSpec("p99_batch_s", path="stats.p99_batch_latency_sec",
+                    op="<=", limit=30.0),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+def _flash_crowd_scale(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="flash_crowd_scale",
+        description="The elastic flash crowd: the 20x ramp lands on TWO "
+                    "workers behind the globally-coordinated adaptive "
+                    "shed — scale-out must outrun shed-budget erosion "
+                    "(the fleet grows toward max instead of shedding "
+                    "through the spike), every shed row still an "
+                    "accounted DLQ record.",
+        seed=seed,
+        workers=2,
+        partitions=4,
+        batch_size=64,
+        time_scale=1.0,
+        idle_timeout=2.5,
+        traffic=(FlashCrowd(name="crowd", duration_s=4.5,
+                            scam_fraction=0.2, base_rate=100 * scale,
+                            peak_rate=2400 * scale, ramp_at_s=0.8,
+                            ramp_s=0.5, hold_s=1.5, decay_s=0.5),),
+        sched=_sched_config(max_queue=800, shed_policy="adaptive",
+                            target_p99_ms=4000.0),
+        dlq=True,
+        autoscale=AutoscaleSpec(min_workers=2, max_workers=4,
+                                cooldown_s=0.5, out_for_s=0.1,
+                                in_for_s=2.0, surge_at_s=0.8),
+        sentinel=SentinelSpec(
+            rules=_autoscale_rules(backlog_limit=150.0, idle_limit=50.0,
+                                   idle_for_s=1.0),
+            expect=(ExpectedDetection("fleet_watermark_burn",
+                                      fault_at_s=0.8, within_s=15.0),)),
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            SloSpec("scaled_out", path="autoscale.scale_outs", op=">=",
+                    limit=1),
+            SloSpec("reaction_bounded_s", path="autoscale_reaction_s",
+                    op="<=", limit=10.0),
+            # The elastic shed budget: the single-engine flash_crowd
+            # tolerates 0.9 shed fraction; with capacity arriving
+            # mid-ramp the crowd must mostly be SERVED, not shed.
+            SloSpec("shed_budget", path="shed_fraction", op="<=",
+                    limit=0.5),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
+def _elastic_control(seed: int, scale: float) -> GameDay:
+    return GameDay(
+        name="elastic_control",
+        description="The elastic control arm: a clean steady load with "
+                    "the autoscaler ARMED but every signal quiet (the "
+                    "burn threshold unreachable, the idle rule gated "
+                    "off) — the fleet must not scale, not replace, not "
+                    "flap, and the full fleet pack must end with zero "
+                    "incidents.",
+        seed=seed,
+        workers=2,
+        partitions=4,
+        traffic=(SteadyLoad(name="steady", rate=150 * scale,
+                            duration_s=3.0, scam_fraction=0.1),),
+        autoscale=AutoscaleSpec(min_workers=2, max_workers=3,
+                                cooldown_s=0.5),
+        # idle_limit=0 gates fleet_idle structurally (backlog can never
+        # be < 0): the false-positive arm proves no-signal -> no-action,
+        # not that idleness is absent. The burn limit sits far above
+        # anything a warp-fed steady load enqueues.
+        sentinel=SentinelSpec(
+            rules=_autoscale_rules(backlog_limit=50000.0, idle_limit=0.0,
+                                   idle_for_s=1.0, fast_s=8.0),
+            zero_incidents=True),
+        slos=(
+            SloSpec("exact_accounting", kind="exact_accounting"),
+            SloSpec("no_scale_out", path="autoscale.scale_outs", op="==",
+                    limit=0),
+            SloSpec("no_scale_in", path="autoscale.scale_ins", op="==",
+                    limit=0),
+            SloSpec("no_replace", path="autoscale.replacements", op="==",
+                    limit=0),
+            SloSpec("spans_exact", kind="spans_exact"),
+            SloSpec("no_errors", kind="no_errors"),
+        ))
+
+
 CATALOG: dict = {
     "flash_crowd": _flash_crowd,
     "campaign_breaker": _campaign_breaker,
@@ -1354,7 +1583,10 @@ CATALOG: dict = {
     "chaos_storm": _chaos_storm,
     "coordinator_kill": _coordinator_kill,
     "diurnal_hotkey": _diurnal_hotkey,
+    "diurnal_tide_scale": _diurnal_tide_scale,
     "drift_shift": _drift_shift,
+    "elastic_control": _elastic_control,
+    "flash_crowd_scale": _flash_crowd_scale,
 }
 
 

@@ -1,0 +1,36 @@
+"""Protocol mutant: the scale-in re-deal runs before the release marker.
+
+The checker mutation ``release_before_drain`` gives this shape its
+dynamic counterexample (invariant ``revoke_barrier``, needs
+``--autoscale``); statically, FC503's ``release-rides-revoke-barrier``
+obligation must flag the re-deal preceding the released marker — the
+deal still counts the victim as a live owner, so its pairs are granted
+to new owners without entering the revoke barrier while the voluntary
+leaver still holds uncommitted read-ahead."""
+
+
+class MutantCoordinator:
+    def __init__(self):
+        self._lock = None
+        self._members = {}
+        self._released = set()
+
+    def request_release(self, worker_id):
+        with self._lock:
+            if worker_id not in self._members \
+                    or worker_id in self._released:
+                return False
+            active = [w for w in self._members
+                      if w not in self._released]
+            if len(active) < 2:
+                return False
+            # VIOLATION FC503 release-rides-revoke-barrier: the re-deal
+            # runs while the victim is still an ordinary member — its
+            # pairs move NOW, unbarriered; the marker lands too late.
+            self._rebalance_locked()
+            self._released.add(worker_id)
+            return True
+
+    def _rebalance_locked(self):
+        members = sorted(self._members)
+        self._target = {w: set() for w in members}

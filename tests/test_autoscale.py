@@ -1,0 +1,510 @@
+"""Closed-loop autoscaling (fraud_detection_tpu/fleet/autoscale/,
+docs/autoscaling.md).
+
+Pins the elasticity subsystem's defining invariants:
+
+* the ScalePolicy: hysteresis in BOTH directions, cooldown windows,
+  min/max clamps (one denial per cooldown window, not per evaluation),
+  replace-over-resize precedence, burn-beats-idle when both signals fire,
+  and the work-remaining gate that keeps drain exits from reading as
+  capacity deficits;
+* the Autoscaler: fresh worker ids (never reused), pending launches count
+  as live capacity (no replace double-provision during join latency),
+  launch-grace expiry, newest-first scale-in victims, refusals counted as
+  denied with a cooldown restart, decisions term-stamped on the control
+  bus and landed in the incident flight recorder with evidence;
+* the ``autoscale`` health block schema (AUTOSCALE_BLOCK_SCHEMA below is
+  FC301-checked against ``Autoscaler.stats`` — analysis/health.py);
+* end-to-end elasticity: a burn scales a real fleet OUT, idleness scales
+  it back IN through the coordinator's voluntary-leave release riding the
+  revoke->drain->commit->reassign barrier, with every input key
+  classified exactly once — including with a coordinator crash composed
+  in mid-scale (the successor inherits desired capacity and in-flight
+  releases through the control-bus snapshot).
+
+The model-checker side (scale actions composed with crashes + failover,
+the ``release_before_drain`` mutation's counterexample) is pinned in
+tests/test_model_checker.py.
+"""
+
+import json
+
+import pytest
+
+from fraud_detection_tpu.fleet import Fleet
+from fraud_detection_tpu.fleet.autoscale import (Autoscaler, ScalePolicy,
+                                                 ThreadProvisioner,
+                                                 WorkerProvisioner)
+from fraud_detection_tpu.stream import InProcessBroker
+from fraud_detection_tpu.stream.faults import CoordinatorKillSpec
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    from fraud_detection_tpu.models.pipeline import synthetic_demo_pipeline
+
+    return synthetic_demo_pipeline(batch_size=64, n=300, seed=3,
+                                   num_features=1024,
+                                   corpus_kwargs=dict(hard_fraction=0.0,
+                                                      label_noise=0.0))
+
+
+def feed(broker, n, topic="in"):
+    producer = broker.producer()
+    for i in range(n):
+        producer.produce(topic,
+                         json.dumps({"text": f"hello dialogue {i}",
+                                     "id": i}).encode(),
+                         key=str(i).encode())
+
+
+# ---------------------------------------------------------------------------
+# the FC301 contract: the fleet view's "autoscale" block
+# (analysis/health.py cross-checks Autoscaler.stats against this dict
+# literal — keep them in lockstep)
+# ---------------------------------------------------------------------------
+
+AUTOSCALE_BLOCK_SCHEMA = {
+    "desired": (int,),
+    "live": (int,),
+    "min": (int,),
+    "max": (int,),
+    "scale_outs": (int,),
+    "scale_ins": (int,),
+    "replacements": (int,),
+    "denied": (int,),
+    "cooldown_remaining_s": (int, float),
+    "last_decision": (dict, type(None)),
+}
+
+
+def assert_autoscale_block(block):
+    assert set(block) == set(AUTOSCALE_BLOCK_SCHEMA), (
+        f"autoscale block keys changed — update AUTOSCALE_BLOCK_SCHEMA "
+        f"AND the docs/pollers "
+        f"(extra: {set(block) - set(AUTOSCALE_BLOCK_SCHEMA)}, "
+        f"missing: {set(AUTOSCALE_BLOCK_SCHEMA) - set(block)})")
+    for key, types in AUTOSCALE_BLOCK_SCHEMA.items():
+        assert isinstance(block[key], types), (key, block[key])
+
+
+# ---------------------------------------------------------------------------
+# ScalePolicy: hysteresis, cooldown, clamps, precedence
+# ---------------------------------------------------------------------------
+
+BURN = ["fleet_watermark_burn"]
+IDLE = ["fleet_idle"]
+
+
+def test_policy_validates_configuration():
+    with pytest.raises(ValueError, match="min_workers"):
+        ScalePolicy(min_workers=0, max_workers=2)
+    with pytest.raises(ValueError, match="max_workers"):
+        ScalePolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError, match="cooldown_s"):
+        ScalePolicy(min_workers=1, max_workers=2, cooldown_s=-1)
+    with pytest.raises(ValueError, match="out_for_s"):
+        ScalePolicy(min_workers=1, max_workers=2, out_for_s=-1)
+    with pytest.raises(ValueError, match="step"):
+        ScalePolicy(min_workers=1, max_workers=2, step=0)
+
+
+def test_policy_scale_out_hysteresis():
+    """A burn must hold continuously for out_for_s before the fleet
+    grows; a gap in the signal resets the clock."""
+    p = ScalePolicy(min_workers=1, max_workers=4, out_for_s=5.0,
+                    cooldown_s=0.0)
+    assert p.decide(0.0, firing=BURN, live=2, desired=2) is None
+    assert p.decide(4.9, firing=BURN, live=2, desired=2) is None
+    # signal drops: the hysteresis clock resets
+    assert p.decide(5.0, firing=[], live=2, desired=2) is None
+    assert p.decide(6.0, firing=BURN, live=2, desired=2) is None
+    d = p.decide(11.0, firing=BURN, live=2, desired=2)
+    assert d is not None and d.kind == "scale_out"
+    assert d.reason == "fleet_watermark_burn"
+    assert (d.desired_before, d.desired_after) == (2, 3)
+
+
+def test_policy_scale_in_hysteresis_and_burn_wins():
+    p = ScalePolicy(min_workers=1, max_workers=4, in_for_s=5.0,
+                    cooldown_s=0.0)
+    assert p.decide(0.0, firing=IDLE, live=3, desired=3) is None
+    # burn and idle together resolve to the burn side: no shrink, and the
+    # idle hysteresis clock resets (capacity errs toward availability)
+    d = p.decide(3.0, firing=BURN + IDLE, live=3, desired=3)
+    assert d is not None and d.kind == "scale_out"
+    p2 = ScalePolicy(min_workers=1, max_workers=4, in_for_s=5.0,
+                     cooldown_s=0.0)
+    p2.decide(0.0, firing=IDLE, live=3, desired=3)
+    d = p2.decide(5.0, firing=IDLE, live=3, desired=3)
+    assert d is not None and d.kind == "scale_in"
+    assert d.reason == "fleet_idle"
+    assert (d.desired_before, d.desired_after) == (3, 2)
+
+
+def test_policy_cooldown_suppresses_and_credits_hysteresis():
+    """No resize inside the cooldown window — but a burn that started
+    DURING cooldown has served its out_for_s when the window opens."""
+    p = ScalePolicy(min_workers=1, max_workers=4, cooldown_s=30.0,
+                    out_for_s=5.0)
+    p.decide(0.0, firing=BURN, live=2, desired=2)
+    d = p.decide(5.0, firing=BURN, live=2, desired=2)
+    assert d is not None and d.kind == "scale_out"
+    # burn re-arises at t=10 (inside cooldown): suppressed...
+    assert p.decide(10.0, firing=BURN, live=3, desired=3) is None
+    assert p.decide(34.9, firing=BURN, live=3, desired=3) is None
+    # ...but at cooldown end the 5s hysteresis is already served
+    d = p.decide(35.1, firing=BURN, live=3, desired=3)
+    assert d is not None and d.kind == "scale_out"
+
+
+def test_policy_clamps_deny_once_per_cooldown_window():
+    p = ScalePolicy(min_workers=2, max_workers=3, cooldown_s=10.0)
+    # max clamp: the burn keeps firing at the bound — ONE denial per
+    # cooldown window, not one per evaluation
+    assert p.decide(0.0, firing=BURN, live=3, desired=3) is None
+    assert p.denied == 1
+    assert p.decide(1.0, firing=BURN, live=3, desired=3) is None
+    assert p.decide(9.0, firing=BURN, live=3, desired=3) is None
+    assert p.denied == 1
+    assert p.decide(10.5, firing=BURN, live=3, desired=3) is None
+    assert p.denied == 2
+    # min clamp symmetric
+    assert p.decide(21.0, firing=IDLE, live=2, desired=2) is None
+    assert p.denied == 3
+
+
+def test_policy_replace_precedence_and_work_gate():
+    """A capacity deficit replaces — bypassing cooldown AND hysteresis,
+    winning over a simultaneous burn — but ONLY while work remains:
+    drain-mode exits must not respawn the fleet forever."""
+    p = ScalePolicy(min_workers=1, max_workers=4, cooldown_s=30.0,
+                    out_for_s=5.0)
+    p.decide(0.0, firing=BURN, live=3, desired=3)
+    d = p.decide(5.0, firing=BURN, live=3, desired=3)
+    assert d is not None                     # resize at t=5: cooldown starts
+    d = p.decide(6.0, firing=BURN, live=3, desired=4)
+    assert d is not None and d.kind == "replace"
+    assert d.reason == "capacity_deficit"
+    assert (d.desired_before, d.desired_after) == (4, 4)
+    assert p.decide(7.0, firing=[], live=3, desired=4,
+                    work_remaining=False) is None
+
+
+def test_policy_snapshot_shape():
+    p = ScalePolicy(min_workers=1, max_workers=4, cooldown_s=10.0)
+    p.decide(0.0, firing=BURN, live=2, desired=2)
+    snap = p.snapshot(4.0)
+    assert snap == {"min": 1, "max": 4, "denied": 0,
+                    "cooldown_remaining_s": 6.0}
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: ledgers, actuation, publication
+# ---------------------------------------------------------------------------
+
+class FakeCoordinator:
+    def __init__(self, members=("w0", "w1"), lag=10):
+        self.members = list(members)
+        self.lag = lag
+        self.term = 3
+        self.released = []
+        self.refuse_release = False
+
+    def last_view(self):
+        return {"workers": list(self.members),
+                "n_workers": len(self.members),
+                "global_backlog": 0, "backlog_per_worker": 0.0,
+                "committed_lag": self.lag}
+
+    def request_release(self, worker_id):
+        if self.refuse_release or worker_id not in self.members:
+            return False
+        self.released.append(worker_id)
+        return True
+
+
+class FakeProvisioner(WorkerProvisioner):
+    kind = "fake"
+
+    def __init__(self, accept=True):
+        self.accept = accept
+        self.launched = []
+
+    def launch(self, worker_id):
+        if not self.accept:
+            return False
+        self.launched.append(worker_id)
+        return True
+
+
+class FakeControl:
+    def __init__(self):
+        self.published = []
+
+    def publish(self, kind, sender, payload, *, term=0):
+        self.published.append((kind, sender, payload, term))
+
+
+class FakeRecorder:
+    def __init__(self):
+        self.scales = []
+
+    def record_scale(self, decision, evidence_window=()):
+        self.scales.append((decision, list(evidence_window)))
+        return True
+
+
+def _autoscaler(coord, prov, *, firing, control=None, recorder=None, **pol):
+    policy = ScalePolicy(**{"min_workers": 1, "max_workers": 4,
+                            "cooldown_s": 0.0, **pol})
+    return Autoscaler(policy, prov, coord, initial_workers=2,
+                      firing=firing, control=control, recorder=recorder,
+                      launch_grace_s=5.0)
+
+
+def test_autoscaler_validates_initial_workers():
+    with pytest.raises(ValueError, match="bounds"):
+        Autoscaler(ScalePolicy(min_workers=3, max_workers=4),
+                   FakeProvisioner(), FakeCoordinator(), initial_workers=2)
+
+
+def test_autoscaler_scale_out_fresh_ids_and_pending_counts_as_live():
+    coord = FakeCoordinator()
+    prov = FakeProvisioner()
+    signals = {"firing": BURN}
+    a = _autoscaler(coord, prov, firing=lambda: signals["firing"])
+    d = a.step(now=1.0)
+    assert d is not None and d.kind == "scale_out"
+    assert prov.launched == ["w2"]           # w0/w1 exist: numbering continues
+    # the launch hasn't joined yet — pending counts as live, so the next
+    # step must NOT read the join latency as a deficit and re-provision
+    signals["firing"] = []
+    assert a.step(now=1.1) is None
+    assert prov.launched == ["w2"]
+    st = a.stats()
+    assert st["desired"] == 3 and st["live"] == 3 and st["scale_outs"] == 1
+    # the member joins: pending prunes, live stays 3
+    coord.members.append("w2")
+    a.step(now=1.2)
+    assert a.stats()["live"] == 3
+
+
+def test_autoscaler_replaces_after_launch_grace_with_fresh_id():
+    coord = FakeCoordinator()
+    prov = FakeProvisioner()
+    a = _autoscaler(coord, prov, firing=lambda: BURN)
+    a.step(now=1.0)
+    assert prov.launched == ["w2"]
+    # the launch never joins; past the grace window the deficit is real
+    # and the replacement uses a FRESH id (w2's lease/stats stay its own)
+    d = a.step(now=7.0)
+    assert d is not None and d.kind == "replace"
+    assert prov.launched == ["w2", "w3"]
+    assert a.stats()["replacements"] == 1
+    assert a.stats()["desired"] == 3         # replace restores, never resizes
+
+
+def test_autoscaler_no_replace_when_work_done():
+    """Drain-mode exits shrink membership with zero lag — the controller
+    must NOT respawn the leavers."""
+    coord = FakeCoordinator(members=("w0",), lag=0)
+    prov = FakeProvisioner()
+    a = _autoscaler(coord, prov, firing=lambda: [])
+    assert a.step(now=1.0) is None
+    assert prov.launched == []
+
+
+def test_autoscaler_scale_in_newest_first_and_refusal_denies():
+    coord = FakeCoordinator(members=("w0", "w1", "w2"))
+    prov = FakeProvisioner()
+    t = [1.0]
+    a = Autoscaler(ScalePolicy(min_workers=1, max_workers=4,
+                               cooldown_s=10.0),
+                   prov, coord, initial_workers=3, firing=lambda: IDLE,
+                   clock=lambda: t[0])
+    d = a.step()
+    assert d is not None and d.kind == "scale_in"
+    assert coord.released == ["w2"]          # newest member returns first
+    assert a.stats()["scale_ins"] == 1
+    # a refused release counts as denied and restarts the cooldown so the
+    # controller doesn't hammer the refusal every tick
+    coord.refuse_release = True
+    t[0] = 12.0                              # past the first cooldown
+    assert a.step() is None
+    assert a.policy.denied == 1
+    assert a.stats()["cooldown_remaining_s"] > 0
+
+
+def test_autoscaler_publishes_term_stamped_and_records_evidence():
+    coord = FakeCoordinator()
+    control = FakeControl()
+    recorder = FakeRecorder()
+    a = _autoscaler(coord, FakeProvisioner(), firing=lambda: BURN,
+                    control=control, recorder=recorder)
+    a.step(now=1.0)
+    (kind, sender, payload, term), = control.published
+    assert (kind, sender) == ("scale", "autoscaler")
+    assert term == 3 and payload["term"] == 3        # coordinator's term
+    assert payload["kind"] == "scale_out"
+    assert payload["evidence"] == ["fleet_watermark_burn"]
+    (decision, window), = recorder.scales
+    assert decision["kind"] == "scale_out"
+    (at, sample), = window
+    assert at == 1.0 and "backlog_per_worker" in sample
+    assert sample["firing"] == ["fleet_watermark_burn"]
+
+
+def test_autoscaler_stats_block_schema_and_report():
+    a = _autoscaler(FakeCoordinator(), FakeProvisioner(),
+                    firing=lambda: BURN)
+    assert_autoscale_block(a.stats())
+    a.step(now=1.0)
+    block = a.stats()
+    assert_autoscale_block(block)
+    assert block["last_decision"]["kind"] == "scale_out"
+    rep = a.report()
+    assert rep["provisioner"] == "fake"
+    assert [d["kind"] for d in rep["decisions"]] == ["scale_out"]
+
+
+def test_thread_provisioner_idempotent_ledger():
+    calls = []
+
+    def spawn(wid):
+        calls.append(wid)
+        return wid != "nope"
+
+    p = ThreadProvisioner(spawn)
+    assert p.kind == "thread"
+    assert p.launch("w2") and p.launch("w2")         # retry: one spawn
+    assert calls == ["w2"]
+    assert not p.launch("nope")                      # veto propagates
+    assert p.launched() == ["w2"]
+
+
+# ---------------------------------------------------------------------------
+# end to end: a real fleet breathes out and back in, exactly once
+# ---------------------------------------------------------------------------
+
+def _scaled_fleet(broker, pipeline, tmp_path=None, **kw):
+    from fraud_detection_tpu.obs.sentinel import fleet_rule_pack
+
+    return Fleet.in_process(
+        broker, pipeline, "in", "out", 2, batch_size=64,
+        lease_ttl=1.0, heartbeat_interval=0.02, tick_interval=0.02,
+        sentinel_rules=fleet_rule_pack(
+            backlog_limit=200.0, fast_s=0.25, slow_s=1.0, resolve_s=0.2,
+            idle_limit=50.0, idle_for_s=0.1),
+        autoscale=dict(min_workers=2, max_workers=3, cooldown_s=0.3,
+                       in_for_s=0.1),
+        **kw)
+
+
+def test_fleet_scales_out_on_burn_and_back_in_exactly_once(pipeline,
+                                                           tmp_path):
+    """The headline loop: a backlog burn grows the fleet 2 -> 3 (fresh
+    worker w2 joins through the ordinary path), the post-drain idle
+    shrinks it 3 -> 2 through a voluntary-leave release riding the revoke
+    barrier — and every one of the 1200 input keys is classified exactly
+    once. Decisions land in the incident flight recorder with evidence."""
+    from fraud_detection_tpu.obs.sentinel import IncidentRecorder
+
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, 1200)
+    recorder = IncidentRecorder(str(tmp_path))
+    fleet = _scaled_fleet(broker, pipeline, sentinel_recorder=recorder)
+    out = fleet.run(idle_timeout=2.5, join_timeout=120.0)
+    assert sorted(m.key for m in broker.messages("out")) == \
+        sorted(str(i).encode() for i in range(1200))
+    scale = out["autoscale"]
+    assert_autoscale_block({k: v for k, v in scale.items()
+                            if k not in ("provisioner", "decisions")})
+    assert scale["scale_outs"] >= 1 and scale["scale_ins"] >= 1
+    kinds = [d["kind"] for d in scale["decisions"]]
+    assert kinds.index("scale_out") < kinds.index("scale_in")
+    assert all(d["term"] >= 1 for d in scale["decisions"])
+    # the view block rode the coordinator tick (health file / pollers)
+    view = fleet.coordinator.last_view()
+    assert_autoscale_block(view["autoscale"])
+    # ...and the same block serves fleet_health()
+    assert_autoscale_block(fleet.fleet_health()["fleet"]["autoscale"])
+    # decisions landed in the flight recorder with their evidence window
+    events = [json.loads(l) for l in
+              (tmp_path / "incidents.jsonl").read_text().splitlines()]
+    scales = [e for e in events if e["event"] == "scale"]
+    assert len(scales) >= 2
+    assert all(e["evidence_window"] for e in scales)
+    assert scales[0]["kind"] == "scale_out"
+    assert scales[0]["evidence_window"][0]["value"]["firing"]
+
+
+def test_fleet_scales_under_coordinator_failover_exactly_once(pipeline):
+    """Elasticity composed with succession: the leader dies mid-run, a
+    successor reconstructs from the control bus — and the scale decisions
+    plus the drain still account for every key exactly once (the runtime
+    twin of the checker's AUTOSCALE_CONFIG composition pin)."""
+    broker = InProcessBroker(num_partitions=4)
+    feed(broker, 1200)
+    kill = CoordinatorKillSpec(seed=2, kills=1, min_ticks=3, max_ticks=6,
+                               modes=("crash",))
+    fleet = _scaled_fleet(broker, pipeline, candidates=2, role_ttl=0.8,
+                          coordinator_kill=kill)
+    out = fleet.run(idle_timeout=2.5, join_timeout=120.0)
+    assert sorted(m.key for m in broker.messages("out")) == \
+        sorted(str(i).encode() for i in range(1200))
+    assert out["succession"]["elections"] >= 1
+    assert out["succession"]["term"] >= 2
+    scale = out["autoscale"]
+    assert scale["scale_outs"] >= 1
+    # desired capacity survived the failover: the successor's view serves
+    # the same autoscale block the dead leader's did
+    assert_autoscale_block(fleet.coordinator.last_view()["autoscale"])
+
+
+# ---------------------------------------------------------------------------
+# serve CLI (app/serve.py --autoscale)
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_autoscale(capsys):
+    """serve --fleet N --autoscale: the demo drains with the sizing loop
+    armed and the exit stats carry the autoscale evidence block — steady
+    capacity on a clean drain (no signal plane without --alerts, so the
+    loop can only replace, and nothing dies)."""
+    from fraud_detection_tpu.app import serve
+
+    rc = serve.main(["--model", "synthetic", "--demo", "300",
+                     "--fleet", "2", "--partitions", "4",
+                     "--batch-size", "64", "--autoscale",
+                     "--min-workers", "2", "--max-workers", "3",
+                     "--scale-cooldown", "5"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    out = json.loads(lines[-1])
+    assert out["processed"] == 300 and out["errors"] == []
+    scale = out["autoscale"]
+    assert scale["provisioner"] == "thread"
+    assert (scale["min"], scale["max"]) == (2, 3)
+    assert scale["desired"] == 2 and scale["decisions"] == []
+    assert scale["scale_outs"] == 0 and scale["scale_ins"] == 0
+    assert scale["replacements"] == 0
+
+
+def test_serve_cli_autoscale_rejects_bad_combos():
+    from fraud_detection_tpu.app import serve
+
+    base = ["--model", "synthetic", "--demo", "10", "--partitions", "4",
+            "--batch-size", "64"]
+    with pytest.raises(SystemExit):          # needs --fleet
+        serve.main(base + ["--autoscale"])
+    with pytest.raises(SystemExit):          # bounds need --autoscale
+        serve.main(base + ["--fleet", "2", "--min-workers", "2"])
+    with pytest.raises(SystemExit):          # fleet below the floor
+        serve.main(base + ["--fleet", "2", "--autoscale",
+                           "--min-workers", "3"])
+    with pytest.raises(SystemExit):          # fleet above the ceiling
+        serve.main(base + ["--fleet", "2", "--autoscale",
+                           "--max-workers", "1"])

@@ -30,6 +30,8 @@ import pytest
 
 from fraud_detection_tpu.analysis import model, sarif
 from fraud_detection_tpu.analysis.checker import (ACTION_IMPLEMENTS,
+                                                  AUTOSCALE_ACTIONS,
+                                                  AUTOSCALE_CONFIG,
                                                   INVARIANTS, MUTATIONS,
                                                   SUCCESSION_ACTIONS,
                                                   CheckConfig, check,
@@ -68,11 +70,32 @@ def test_clean_spec_verifies_within_budget():
     assert result.elapsed < 60.0
     # every protocol action was exercised (no vacuous verification) — the
     # succession actions need candidates >= 2 with a coordinator fault
-    # budget, so they are covered by the SUCCESSION_CONFIG run instead
-    # (tests/test_succession.py unions the two coverages).
+    # budget and the autoscale actions need spares/max_scale_ins, so
+    # those are covered by the SUCCESSION_CONFIG / AUTOSCALE_CONFIG runs
+    # instead (tests/test_succession.py, test_autoscale_checker below).
     assert set(result.coverage) == (set(ACTION_IMPLEMENTS)
-                                    - set(SUCCESSION_ACTIONS))
+                                    - set(SUCCESSION_ACTIONS)
+                                    - set(AUTOSCALE_ACTIONS))
     assert all(n > 0 for n in result.coverage.values())
+
+
+def test_autoscale_spec_verifies_and_composes_with_crashes():
+    """The elastic configuration VERIFIES: scale-out launches, scale-in
+    voluntary leaves (drain -> commit -> ack -> leave through the revoke
+    barrier), COMPOSED with one worker crash and one coordinator crash —
+    the pin that elasticity decisions survive worker death and failover
+    interleavings without breaking zero-loss/zero-dup."""
+    result = check(CheckConfig(**AUTOSCALE_CONFIG))
+    assert result.ok, (result.budget_reason if result.budget_exhausted
+                       else traces.render_trace(result.violation))
+    assert not result.budget_exhausted
+    assert result.states > 10_000
+    # scale decisions actually fired, interleaved with the fault actions
+    for action in AUTOSCALE_ACTIONS:
+        assert result.coverage.get(action, 0) > 0, action
+    assert result.coverage.get("crash", 0) > 0
+    assert result.coverage.get("coord_crash", 0) > 0
+    assert result.coverage.get("elect", 0) > 0
 
 
 _EXPECTED = {
@@ -84,6 +107,7 @@ _EXPECTED = {
     "forget_holds_on_failover": "revoke_barrier",
     "drop_coordinator_lease": "no_loss",
     "stale_term_fence_accepted": "no_loss",
+    "release_before_drain": "revoke_barrier",
 }
 
 #: per-mutation configuration overrides: the succession mutations need a
@@ -102,6 +126,9 @@ _MUTATION_KW = {
     "stale_term_fence_accepted": dict(workers=2, partitions=2,
                                       keys_per_partition=2, max_lapses=0,
                                       candidates=2, max_coord_lapses=1),
+    "release_before_drain": dict(workers=2, partitions=2,
+                                 keys_per_partition=1, max_crashes=0,
+                                 max_lapses=0, max_scale_ins=1),
 }
 
 
@@ -135,6 +162,10 @@ def test_config_validation():
         CheckConfig(mutations=frozenset({"nope"})).validate()
     with pytest.raises(ValueError, match="workers"):
         CheckConfig(workers=9).validate()
+    with pytest.raises(ValueError, match="spares"):
+        CheckConfig(workers=2, spares=2).validate()
+    with pytest.raises(ValueError, match="never-released"):
+        CheckConfig(workers=2, max_crashes=1, max_scale_ins=1).validate()
 
 
 def test_budget_exhaustion_is_honest():
@@ -242,6 +273,10 @@ _MUTANT_OBLIGATIONS = {
         "restore-inherits-holds",
         "fx_succession.py::MutantCoordinator.restore_state",
         first="store:_pending", why="w"),
+    "fx_autoscale.py": BarrierObligation(
+        "release-rides-revoke-barrier",
+        "fx_autoscale.py::MutantCoordinator.request_release",
+        first="call:_released.add", then="call:_rebalance_locked", why="w"),
 }
 
 

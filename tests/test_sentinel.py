@@ -236,6 +236,36 @@ def test_delta_decrease_watches_gauges():
     assert s2.evaluate(now=1.0) == []
 
 
+def test_delta_decrease_judges_from_window_high_water():
+    """The pre-settlement blind spot (reproduced under CPU starvation):
+    the sentinel's FIRST sample can land before the group finishes
+    forming — membership 1 — and the delta window reaches back to it, so
+    a far-edge comparison reads a later real 2 -> 1 death as 1 - 1 = 0
+    and the kill-swap game day's detects_worker_absence gate never
+    fires. A decrease-watching delta judges from the window's
+    high-water mark instead: growth inside the window can never mask a
+    drop."""
+    src = ScriptedSource()
+    src.state = {"fleet": {"n_workers": 1, "committed_lag": 50}}
+    rule = [r for r in fleet_rule_pack(fast_s=8.0, slow_s=16.0)
+            if r.name == "worker_absence"]
+    s = Sentinel(src, rule)
+    s.evaluate(now=0.0)                       # pre-settlement baseline
+    src.state["fleet"]["n_workers"] = 2       # group settles
+    s.evaluate(now=0.5)
+    src.state["fleet"]["n_workers"] = 1       # real death, work remains
+    out = s.evaluate(now=1.0)
+    assert [o["event"] for o in out] == ["fired"]
+    # ...but startup growth ALONE never reads as a drop: current == peak.
+    src2 = ScriptedSource()
+    src2.state = {"fleet": {"n_workers": 1, "committed_lag": 50}}
+    s2 = Sentinel(src2, rule)
+    s2.evaluate(now=0.0)
+    src2.state["fleet"]["n_workers"] = 2
+    assert s2.evaluate(now=0.5) == []
+    assert s2.evaluate(now=1.0) == []
+
+
 def test_absence_and_stale_rules():
     src = ScriptedSource(progress=0, busy=True)
     absent = AlertRule("gone", "absence", path="missing_block",
