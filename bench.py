@@ -162,6 +162,21 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
             "slot_expl_per_s": slotserve.get("slot_expl_per_s"),
             "fixed_expl_per_s": slotserve.get("fixed_expl_per_s"),
             "occupancy": slotserve.get("occupancy"),
+            # Paged KV pool (PR 19): the paged-vs-contiguous expl/s ratio,
+            # the HBM reduction at equal slots, and the prefix-prefill
+            # token savings — the three paging headlines, trended.
+            "paged": ({
+                "ratio": (slotserve.get("paged") or {}).get("ratio"),
+                "kv_bytes_saved_vs_contiguous": (slotserve.get("paged")
+                    or {}).get("kv_bytes_saved_vs_contiguous"),
+                "max_slots_at_equal_hbm": (slotserve.get("paged")
+                    or {}).get("max_slots_at_equal_hbm"),
+                "prefix_tokens_saved": (slotserve.get("paged")
+                    or {}).get("prefix_tokens_saved"),
+                "prefix_hits": (slotserve.get("paged")
+                    or {}).get("prefix_hits"),
+            } if (slotserve.get("paged") or {}).get("ratio") is not None
+                else None),
         } if slotserve.get("ratio") is not None else None),
         # Game-day verdicts (ISSUE 12, docs/scenarios.md): one ok bit per
         # named scenario so an SLO regression diffs in the trend file.
@@ -1993,7 +2008,7 @@ def _slotserve_bench(lm) -> dict:
     # The honest-accounting invariant, asserted in the artifact's face
     # (counters include the warm rows; the invariant covers them too).
     assert snap["admitted"] == snap["completed"] + snap["dropped"], snap
-    return {
+    out = {
         "slots": slots, "rows": total, "max_tokens": max_tokens,
         "decode_window": window, "arrival_batches": sizes,
         "fixed_expl_per_s": round(total / fixed_dt, 2),
@@ -2006,6 +2021,136 @@ def _slotserve_bench(lm) -> dict:
         "completed": snap["completed"],
         "dropped": snap["dropped"],
         "kv_bytes": snap["kv_bytes"],
+    }
+    # Paged-vs-contiguous arms (PR 19, docs/explain_serving.md "Paged KV
+    # and prefix sharing"). BENCH_SLOT_PAGED=0 skips.
+    if os.environ.get("BENCH_SLOT_PAGED", "1") != "0":
+        try:
+            out["paged"] = _paged_slotserve_bench(lm, max_tokens, window)
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            out["paged"] = {"error": repr(e)[:300]}
+    return out
+
+
+def _paged_slotserve_bench(lm, max_tokens: int, window: int) -> dict:
+    """Paged KV pool vs contiguous slot pool on a long-transcript +
+    shared-preamble workload (ISSUE 19 acceptance evidence).
+
+    Every prompt is a full framed analysis prompt — they all open with the
+    explain template's preamble, so every paged admit hits the prefix
+    cache (one COW of the partial page, suffix-only prefill). The paged
+    pool is sized to the workload's TRUE worst case — prefix pages plus
+    the fresh pages one slot can reference — instead of the contiguous
+    worst-case reservation, which is where the kv_bytes reduction at
+    EQUAL slot count comes from; ``max_slots_at_equal_hbm`` inverts the
+    same arithmetic. Exact page accounting (allocator identity, zero
+    leaks at close) is asserted here AND in CI's bench smoke."""
+    from fraud_detection_tpu.explain.backends import frame_prompt
+    from fraud_detection_tpu.explain.onpod import flatten_chat
+    from fraud_detection_tpu.explain.prompts import analysis_prompt
+    from fraud_detection_tpu.explain.slotserve import SlotServeService
+    from fraud_detection_tpu.explain.slotserve.service import \
+        shared_explain_prefix
+
+    slots = int(os.environ.get("BENCH_SLOT_PAGED_SLOTS", "8"))
+    rows = int(os.environ.get("BENCH_SLOT_PAGED_ROWS", str(3 * slots)))
+    page_size, prompt_width = 64, 448
+    rng = np.random.default_rng(19)
+    prompts = []
+    for i in range(rows):
+        # Long transcripts: the dialogue alone overflows the slot width,
+        # so every row decodes at the worst-case prompt length.
+        d = (f"Caller {i}: this is the bank fraud department, your card "
+             "is compromised, read me the one-time code now. "
+             + "Customer: are you really the bank? Caller: yes, hurry. "
+             * int(rng.integers(6, 12)))
+        prompts.append(flatten_chat(frame_prompt(
+            analysis_prompt(d, int(rng.integers(0, 2)), 0.97))))
+
+    # Pool arithmetic for the paged arm: full prefix pages are shared
+    # (free-list-neutral to retain), so a slot's worst case draws only
+    # the COW page + suffix/growth pages from the pool.
+    lp = len(lm.tokenizer.encode(shared_explain_prefix()))
+    max_len = prompt_width + max_tokens
+    n_view = -(-max_len // page_size)
+    n_prefix, n_full = -(-lp // page_size), lp // page_size
+    fresh_per_slot = n_view - n_full
+    kv_pages = n_prefix + fresh_per_slot * slots
+
+    def run(paged):
+        svc = SlotServeService(
+            lm, slots=slots, max_new_tokens=max_tokens,
+            prompt_width=prompt_width, decode_window=window,
+            prefill_per_iter=4, max_queue=4096, wait_timeout=1200.0,
+            paged=paged,
+            **({"page_size": page_size, "kv_pages": kv_pages}
+               if paged else {}))
+        ok = False
+        try:
+            # Warm with the SAME framed prompts the timed region submits:
+            # a re-framed warm would miss the prefix cache and leave the
+            # suffix-bucket prefill program compiling inside the timing.
+            warm = [svc.submit(p, max_tokens=max_tokens, temperature=0.0)
+                    for p in prompts[:2]]
+            for r in warm:
+                r.wait(1200.0)
+            t0 = time.perf_counter()
+            reqs = [svc.submit(p, max_tokens=max_tokens, temperature=0.0)
+                    for p in prompts]
+            texts = [r.wait(1200.0) for r in reqs]
+            dt = time.perf_counter() - t0
+            snap = svc.snapshot()
+            dec = svc._decoder
+            acct = (dec.allocator_snapshot() if paged
+                    else {"total": 0, "free": 0, "in_use": 0, "refs": 0,
+                          "pages_in_tables": 0, "prefix_base_refs": 0})
+            saved = dec.prefix_tokens_saved if paged else 0
+            ok = True
+        finally:
+            # On the interrupt path (SIGTERM mid-leg) bound the close drain
+            # so the bench process still exits inside the runner's grace
+            # window; the normal path keeps the full drain for accounting.
+            svc.close(timeout=30.0 if ok else 5.0)
+        assert snap["admitted"] == snap["completed"] + snap["dropped"], snap
+        leaked = dec.leaked_pages if paged else 0
+        assert leaked == 0, f"paged pool leaked {leaked} pages"
+        return texts, dt, snap, acct, saved
+
+    contig_texts, contig_dt, contig_snap, _, _ = run(False)
+    paged_texts, paged_dt, paged_snap, acct, tokens_saved = run(True)
+    # The parity discipline, asserted in the artifact's face: the paged
+    # arm must emit the contiguous arm's exact greedy texts.
+    assert paged_texts == contig_texts, "paged/contiguous outputs diverged"
+    contig_kv, paged_kv = contig_snap["kv_bytes"], paged_snap["kv_bytes"]
+    page_bytes = paged_snap["page_bytes"]
+    return {
+        "slots": slots, "rows": rows, "max_tokens": max_tokens,
+        "page_size": page_size, "kv_pages": kv_pages,
+        "contig_expl_per_s": round(rows / contig_dt, 2),
+        "paged_expl_per_s": round(rows / paged_dt, 2),
+        "ratio": round(contig_dt / paged_dt, 2),
+        "outputs_bit_equal": True,
+        # HBM at EQUAL slot count, and slots at EQUAL HBM (the two ways
+        # to spend the paging win).
+        "contig_kv_bytes": contig_kv,
+        "kv_bytes": paged_kv,
+        "kv_bytes_saved_vs_contiguous":
+            paged_snap["kv_bytes_saved_vs_contiguous"],
+        "max_slots_at_equal_hbm": int(
+            (contig_kv - n_prefix * page_bytes)
+            // (fresh_per_slot * page_bytes)),
+        # Prefix sharing evidence.
+        "prefix_hits": paged_snap["prefix_hits"],
+        "prefix_pages": paged_snap["prefix_pages"],
+        "cow_copies": paged_snap["cow_copies"],
+        "prefix_tokens_saved": tokens_saved,
+        # Exact accounting at quiescence-1 (before close released the
+        # prefix base refs) + the honest counters.
+        "accounting": acct,
+        "leaked_pages": 0,
+        "admitted": paged_snap["admitted"],
+        "completed": paged_snap["completed"],
+        "dropped": paged_snap["dropped"],
     }
 
 

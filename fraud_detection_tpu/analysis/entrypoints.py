@@ -951,6 +951,13 @@ FLEET_PROTOCOL_SCOPE: Tuple[str, ...] = ("fleet/",)
 # slots never idle through a window while requests queue), and (b) a
 # finished row is fully resolved (_complete) BEFORE its slot returns to the
 # free pool (_release) — slot reuse can never leak an unresolved row.
+#
+# PR 19 adds the PAGE lifecycle under the same machinery: a paged slot's
+# KV pages are mapped (retain shared prefix / COW the partial page / alloc
+# suffix) BEFORE its prefill runs, grown at the host side of each iteration
+# boundary, and released BEFORE the slot id re-enters the free pool; shared
+# prefix pages are never written in place — an admit that would append into
+# one copies it first (the "Pages" role + the page obligations below).
 # ---------------------------------------------------------------------------
 
 SLOT_PROTOCOLS: Tuple[RoleSpec, ...] = (
@@ -958,13 +965,22 @@ SLOT_PROTOCOLS: Tuple[RoleSpec, ...] = (
              ("free", "prefill", "decode", "drain"), "free", (
         # Iteration boundary: queued requests admit into free slots and
         # prefill (the decoder writes the prompt's k/v into the slot).
+        # Paged pools gate the claim on the allocator's free count first
+        # (pages_needed) so admission never over-commits the pool.
         _t("admit", "free", "prefill",
            ("explain/slotserve/service.py::SlotServeService._admit_pending",),
-           ("_decoder.prefill",)),
+           ("_decoder.prefill", "_decoder.pages_needed")),
         # The admitted row joins the decode set (first token emitted).
         _t("first_token", "prefill", "decode",
            ("explain/slotserve/service.py::SlotServeService._admit_pending",),
            ("_emit",)),
+        # Host side of the iteration boundary: every busy slot's page
+        # table is extended to cover the coming window (no-op contiguous);
+        # exhaustion preempts the newest admit as an accounted drop.
+        _t("grow", "decode", "decode",
+           ("explain/slotserve/service.py::"
+            "SlotServeService._ensure_window_pages",),
+           ("_decoder.grow_for_window",)),
         # One fused decode window advances every busy slot.
         _t("step", "decode", "decode",
            ("explain/slotserve/service.py::SlotServeService._decode_step",),
@@ -977,6 +993,57 @@ SLOT_PROTOCOLS: Tuple[RoleSpec, ...] = (
         _t("free", "drain", "free",
            ("explain/slotserve/service.py::SlotServeService._retire_done",),
            ("_release",)),
+        # Release drops the slot's page references BEFORE the slot id
+        # re-enters the free pool (the page-lifecycle obligation below).
+        _t("pages_free", "drain", "free",
+           ("explain/slotserve/service.py::SlotServeService._release",),
+           ("_decoder.release_slot",)),
+        # Decoder death: every slot's pages return to the allocator as
+        # part of failing the in-flight rows (no leak across the outage).
+        _t("death_reset", "decode", "free",
+           ("explain/slotserve/service.py::SlotServeService._fail_all",),
+           ("_decoder.reset_slots",)),
+        # Shutdown: the pool itself quiesces (prefix base refs released,
+        # the leak counter recorded — zero at quiescence).
+        _t("shutdown", "free", "free",
+           ("explain/slotserve/service.py::SlotServeService.close",),
+           ("_decoder.close",)),
+    )),
+    # The page-pool side of the same choreography (PR 19): what each
+    # decoder-level transition does to the refcounted allocator.
+    RoleSpec("Pages", "explain/slotserve/decode.py::PagedSlotDecoder",
+             ("free", "mapped"), "free", (
+        # Admission maps the slot's table: retain shared prefix pages,
+        # COW the partial one, alloc fresh suffix pages — all-or-nothing
+        # (the except arm releases every reference taken so far).
+        _t("map", "free", "mapped",
+           ("explain/slotserve/decode.py::"
+            "PagedSlotDecoder._table_for_admit",),
+           ("allocator.retain", "allocator.alloc", "_cow_prefix_page",
+            "allocator.release")),
+        # COW: a private copy of the partial shared page — shared pages
+        # are never written in place.
+        _t("cow", "free", "mapped",
+           ("explain/slotserve/decode.py::"
+            "PagedSlotDecoder._cow_prefix_page",),
+           ("allocator.alloc", "llm.copy_kv_page")),
+        # The shared preamble prefills once into base-referenced pages.
+        _t("prefix_seed", "free", "mapped",
+           ("explain/slotserve/decode.py::PagedSlotDecoder.set_prefix",),
+           ("allocator.alloc",)),
+        # Window growth allocates cover for lens + steps.
+        _t("grow", "mapped", "mapped",
+           ("explain/slotserve/decode.py::"
+            "PagedSlotDecoder.grow_for_window",),
+           ("allocator.alloc",)),
+        # Slot release returns every reference the slot holds.
+        _t("unmap", "mapped", "free",
+           ("explain/slotserve/decode.py::PagedSlotDecoder.release_slot",),
+           ("allocator.release",)),
+        # Close drops the prefix base refs — quiescence means all free.
+        _t("quiesce", "mapped", "free",
+           ("explain/slotserve/decode.py::PagedSlotDecoder.close",),
+           ("allocator.release",)),
     )),
 )
 
@@ -996,13 +1063,43 @@ SLOT_BARRIER_OBLIGATIONS: Tuple[BarrierObligation, ...] = (
         why="a finished row must be fully resolved (text decoded, waiter "
             "released, trace recorded) BEFORE its slot re-enters the free "
             "pool — slot reuse must never leak an unresolved row's state"),
+    # -- page lifecycle (PR 19) ------------------------------------------
+    BarrierObligation(
+        "pages-mapped-before-prefill",
+        "explain/slotserve/decode.py::PagedSlotDecoder.prefill",
+        first="call:_table_for_admit", then="call:llm.paged_slot_prefill",
+        why="the slot's page table must be fully built (retain/COW/alloc) "
+            "BEFORE the prefill program runs — the compiled program "
+            "scatters by table entry and must never see an uncovered "
+            "write position"),
+    BarrierObligation(
+        "pages-freed-on-slot-release",
+        "explain/slotserve/service.py::SlotServeService._release",
+        first="call:_decoder.release_slot", then="call:_free.append",
+        why="a slot's page references must return to the allocator BEFORE "
+            "the slot id re-enters the free pool — a re-admitted slot "
+            "would otherwise double-map pages the old row still holds, "
+            "leaking them (the accounting identity breaks)"),
+    BarrierObligation(
+        "cow-before-suffix-alloc",
+        "explain/slotserve/decode.py::PagedSlotDecoder._table_for_admit",
+        first="call:_cow_prefix_page", then="call:allocator.alloc",
+        why="shared prefix pages are never written in place: the partial "
+            "preamble page must be copied-on-write BEFORE fresh suffix "
+            "pages are appended, or the admit's suffix k/v would land in "
+            "a page every other slot's table reads"),
 )
 
 #: Call patterns that ARE the slot protocol (FC501 scope below): any call
 #: site in slotserve code matching one must be claimed by a SLOT_PROTOCOLS
-#: transition — new decoder traffic cannot land unmodeled.
+#: transition — new decoder traffic cannot land unmodeled. PR 19 adds the
+#: page-lifecycle traffic: the service-side pool calls and the decoder's
+#: allocator calls.
 SLOT_PROTOCOL_VOCABULARY: Tuple[str, ...] = (
     "_decoder.prefill", "_decoder.step",
+    "_decoder.pages_needed", "_decoder.grow_for_window",
+    "_decoder.release_slot", "_decoder.reset_slots", "_decoder.close",
+    "allocator.alloc", "allocator.retain", "allocator.release",
 )
 
 SLOT_PROTOCOL_SCOPE: Tuple[str, ...] = ("explain/slotserve/",)

@@ -338,6 +338,19 @@ def main(argv=None) -> int:
     ap.add_argument("--explain-queue", type=int, default=1024,
                     help="slotserve admission-queue bound (--explain-slots; "
                          "overflow drops OLDEST with honest accounting)")
+    ap.add_argument("--explain-paged", action="store_true",
+                    help="page the slot lane's KV cache: fixed-size KV "
+                         "pages behind a refcounted allocator, with the "
+                         "shared explain preamble prefilled ONCE and "
+                         "copy-on-write per admit (--explain-slots; "
+                         "greedy outputs stay bit-equal to contiguous — "
+                         "docs/explain_serving.md \"Paged KV and prefix "
+                         "sharing\")")
+    ap.add_argument("--explain-kv-pages", type=int, metavar="N", default=0,
+                    help="cap the paged pool at N pages (--explain-paged; "
+                         "0 = slots * pages-per-slot, the zero-preemption "
+                         "default; smaller pools preempt the NEWEST admit "
+                         "with a kv_pages_exhausted drop record)")
     ap.add_argument("--explain-async", action="store_true",
                     help="annotate flagged rows in the background onto "
                          "--annotations-topic instead of inline: "
@@ -541,6 +554,16 @@ def main(argv=None) -> int:
         # The slot lane IS the async configuration: classification never
         # waits for decode, annotations ride the side topic.
         args.explain_async = True
+    if args.explain_paged and args.explain_slots < 1:
+        raise SystemExit(
+            "--explain-paged pages the slotserve lane's KV cache — it "
+            "needs --explain-slots")
+    if args.explain_kv_pages < 0:
+        raise SystemExit(
+            f"--explain-kv-pages must be >= 0, got {args.explain_kv_pages}")
+    if args.explain_kv_pages > 0 and not args.explain_paged:
+        raise SystemExit(
+            "--explain-kv-pages caps the paged pool; set --explain-paged")
     if args.explain_async and args.explain == "off":
         raise SystemExit("--explain-async needs an --explain backend")
     if args.annotations_topic is not None and not args.explain_async:
@@ -800,7 +823,10 @@ def main(argv=None) -> int:
                 backend = explain_service = SlotServeService(
                     slot_lm, slots=args.explain_slots,
                     max_queue=args.explain_queue,
-                    max_new_tokens=args.explain_tokens)
+                    max_new_tokens=args.explain_tokens,
+                    paged=args.explain_paged,
+                    **({"kv_pages": args.explain_kv_pages}
+                       if args.explain_kv_pages > 0 else {}))
             except ValueError as e:
                 raise SystemExit(f"--explain-slots: {e}")
         if args.breaker > 0:

@@ -15,6 +15,12 @@ from typing import Sequence, Tuple
 
 LABEL_NAMES = {0: "Normal Conversation", 1: "Potential Scam"}
 
+# The static first line every analysis prompt opens with. Named so the
+# slotserve shared-prefix cache (explain/slotserve/) can split prompts at
+# the exact template/payload boundary without duplicating the string.
+ANALYSIS_PREAMBLE = (
+    "A phone-call transcript was classified by a fraud-detection model.\n")
+
 
 def label_name(prediction: int) -> str:
     return LABEL_NAMES.get(int(prediction), str(prediction))
@@ -23,7 +29,7 @@ def label_name(prediction: int) -> str:
 def analysis_prompt(dialogue: str, prediction: int, confidence: float) -> str:
     """Structured explanation request for one classified dialogue."""
     return (
-        "A phone-call transcript was classified by a fraud-detection model.\n"
+        ANALYSIS_PREAMBLE +
         f"Predicted class: {label_name(prediction)} "
         f"(confidence {confidence:.1%}).\n\n"
         "Transcript:\n"

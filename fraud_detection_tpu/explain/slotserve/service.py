@@ -9,11 +9,16 @@ At ~18.6 expl/s measured against a classifier doing ~100k rows/s, that
 barrier is why explanations were sampled, not guaranteed.
 
 This module is the iteration-level alternative (Orca, OSDI '22; slot/KV
-management in the spirit of vLLM, SOSP '23, minus paging — one fixed region
-per slot):
+management in the spirit of vLLM, SOSP '23):
 
 * a fixed pool of **decode slots** over ONE persistent KV cache
-  (``SlotDecoder``, models/llm.py ``slot_prefill``/``slot_decode_step``);
+  (``SlotDecoder``, models/llm.py ``slot_prefill``/``slot_decode_step``) —
+  or, with ``paged=True``, over a flat pool of fixed-size KV pages and
+  per-slot page tables (``PagedSlotDecoder``): block-granular allocation
+  kills the worst-case per-slot reservation, the shared explain preamble
+  is prefilled ONCE into refcounted read-only pages (copy-on-write on the
+  partial page), and pool exhaustion preempts the newest admit as an
+  accounted ``kv_pages_exhausted`` drop;
 * a bounded **admission queue**: newly flagged rows admit into free slots
   at iteration boundaries — prefill interleaves with decode, no fixed-batch
   barrier, and overload drops the OLDEST queued request with honest
@@ -52,7 +57,8 @@ from typing import Callable, List, Optional, Sequence
 from fraud_detection_tpu.explain.backends import (BackendError, ChatMessage,
                                                   frame_prompt)
 from fraud_detection_tpu.explain.onpod import flatten_chat
-from fraud_detection_tpu.explain.slotserve.decode import SlotDecoder
+from fraud_detection_tpu.explain.slotserve.decode import (PagedSlotDecoder,
+                                                          SlotDecoder)
 from fraud_detection_tpu.sched.sketch import LatencySketch
 from fraud_detection_tpu.utils import get_logger
 
@@ -60,6 +66,18 @@ log = get_logger("explain.slotserve")
 
 DROPPED_MARKER = "[explanation dropped: {reason}]"
 UNAVAILABLE_MARKER = "[explanation unavailable: {reason}]"
+
+
+def shared_explain_prefix() -> str:
+    """The template preamble every slotserve analysis prompt opens with:
+    chat framing + system prompt + the analysis template's static first
+    line. Derived through the SAME ``flatten_chat``/``frame_prompt``/
+    ``ANALYSIS_PREAMBLE`` pieces the serving paths use, so it can never
+    drift from what ``explain_rows`` actually renders."""
+    from fraud_detection_tpu.explain.prompts import ANALYSIS_PREAMBLE
+
+    framed = flatten_chat(frame_prompt(ANALYSIS_PREAMBLE))
+    return framed[: framed.index(ANALYSIS_PREAMBLE) + len(ANALYSIS_PREAMBLE)]
 
 
 class _SlotRequest:
@@ -118,7 +136,9 @@ class SlotServeService:
                  decode_window: int = 16,
                  temperature: float = 0.0, seed: int = 0,
                  rowtrace=None, wait_timeout: float = 600.0,
-                 warm: bool = True,
+                 warm: bool = True, paged: bool = False,
+                 page_size: int = 64, kv_pages: Optional[int] = None,
+                 shared_prefix: bool = True,
                  clock: Callable[[], float] = time.perf_counter):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -128,10 +148,37 @@ class SlotServeService:
         if decode_window < 1:
             raise ValueError(
                 f"decode_window must be >= 1, got {decode_window}")
-        self._decoder = SlotDecoder(lm, slots,
-                                    prompt_width=prompt_width,
-                                    max_new_tokens=max_new_tokens,
-                                    prompt_bucket=prompt_bucket)
+        if not paged and kv_pages is not None:
+            raise ValueError("kv_pages is a paged-pool budget; pass "
+                             "paged=True to use it")
+        if paged:
+            self._decoder = PagedSlotDecoder(lm, slots,
+                                             prompt_width=prompt_width,
+                                             max_new_tokens=max_new_tokens,
+                                             prompt_bucket=prompt_bucket,
+                                             page_size=page_size,
+                                             total_pages=kv_pages)
+            if shared_prefix:
+                prefix = shared_explain_prefix()
+                lp = len(lm.tokenizer.encode(prefix))
+                n_prefix = -(-lp // self._decoder.page_size)
+                fits = (lp < self._decoder.prompt_width
+                        and self._decoder.total_pages
+                        >= self._decoder.n_view + n_prefix)
+                if fits:
+                    self._decoder.set_prefix(prefix)
+                else:
+                    log.warning(
+                        "shared explain prefix (%d tokens, %d pages) does "
+                        "not fit prompt_width %d / pool %d; serving paged "
+                        "WITHOUT prefix sharing", lp, n_prefix,
+                        self._decoder.prompt_width,
+                        self._decoder.total_pages)
+        else:
+            self._decoder = SlotDecoder(lm, slots,
+                                        prompt_width=prompt_width,
+                                        max_new_tokens=max_new_tokens,
+                                        prompt_bucket=prompt_bucket)
         import numpy as np
 
         self.slots = slots
@@ -154,8 +201,10 @@ class SlotServeService:
         self._last_tok = np.full(slots, lm.cfg.EOS, np.int32)
         self._active_arr = np.zeros(slots, bool)
         self._temps = np.zeros(slots, np.float32)
+        self._admit_seq = np.zeros(slots, np.int64)  # preemption order key
         self._retired: List[int] = []       # slots finished this iteration
         self._seq = 0                       # device-call counter (seeds)
+        self._admits = 0                    # monotone admission counter
         # --- shared state (everything below lives under _cv) ---
         self._cv = threading.Condition()
         self._q: List[_SlotRequest] = []
@@ -306,9 +355,18 @@ class SlotServeService:
         per iteration so admission never starves decode), prefill each
         prompt into its slot and emit the first sampled token."""
         grabbed: List[tuple] = []
+        pages_planned = 0
         with self._cv:
             while (self._free and self._q
                    and len(grabbed) < self.prefill_per_iter):
+                # Page-pool gate (paged decoder; contiguous needs 0 of 0):
+                # stop admitting this boundary once the free pages can't
+                # cover every grabbed prompt's table — decode retirements
+                # free pages for the next boundary, so nothing deadlocks.
+                need = self._decoder.pages_needed(self._q[0].tokens)
+                if self._decoder.pages_free < pages_planned + need:
+                    break
+                pages_planned += need
                 req = self._q.pop(0)
                 slot = self._free.pop()
                 self._busy += 1
@@ -318,6 +376,8 @@ class SlotServeService:
                 # waiter would hang to timeout.
                 self._slot_req[slot] = req
                 req.slot = slot
+                self._admits += 1
+                self._admit_seq[slot] = self._admits
                 grabbed.append((slot, req))
         for slot, req in grabbed:
             self._seq += 1
@@ -340,6 +400,13 @@ class SlotServeService:
         so each row's emission stream is exactly the single-step one."""
         import numpy as np
 
+        busy_rows = np.flatnonzero(self._active_arr).tolist()
+        if not busy_rows:
+            return
+        # Host side of the iteration boundary: every busy row's page table
+        # must cover this window's writes BEFORE the compiled program runs
+        # (paged decoder; the contiguous one grows trivially).
+        self._ensure_window_pages(busy_rows)
         busy_rows = np.flatnonzero(self._active_arr).tolist()
         if not busy_rows:
             return
@@ -366,6 +433,39 @@ class SlotServeService:
                     self._active_arr[slot] = False
                     self._retired.append(slot)
                     break
+
+    def _ensure_window_pages(self, busy_rows: List[int]) -> None:
+        """Grow each busy slot's page table to cover ``lens +
+        decode_window``. On pool exhaustion, preempt the NEWEST-admitted
+        active slot (its waiter resolves to an accounted
+        ``kv_pages_exhausted`` drop — oldest work survives, matching the
+        queue's drop-OLDEST-first... inverse: admitted rows beat queued
+        ones, and among admitted the most recent yields) and retry; a
+        preempted row's pages free immediately, so the pass terminates
+        (the pool is validated to hold at least one worst-case row)."""
+        for slot in busy_rows:
+            while self._active_arr[slot] and not self._decoder.grow_for_window(
+                    slot, int(self._lens[slot]), self.decode_window):
+                victims = [s for s in busy_rows if self._active_arr[s]]
+                victim = max(victims, key=lambda s: self._admit_seq[s])
+                self._preempt(victim)
+
+    def _preempt(self, slot: int) -> None:
+        """Evict one in-flight row to reclaim its pages: accounted drop
+        (``admitted == completed + dropped`` holds), waiter resolved with
+        the drop marker, slot + pages released."""
+        req = self._slot_req[slot]
+        req.dropped = "kv_pages_exhausted"
+        with self._cv:
+            self._dropped += 1
+        if self._rowtrace is not None and req.cid is not None:
+            self._rowtrace.record_event(req.cid, "explain", ok=False,
+                                        detail="dropped:kv_pages_exhausted")
+        log.warning("page pool exhausted: preempting slot %d "
+                    "(%d tokens emitted) to free its pages",
+                    slot, len(req.out))
+        self._release(slot)
+        req.done.set()
 
     def _emit(self, slot: int, tok: int) -> None:
         """Record one prefill-emitted token; a row whose FIRST token is
@@ -405,6 +505,9 @@ class SlotServeService:
         req.done.set()
 
     def _release(self, slot: int) -> None:
+        # Pages first, slot second: a slot on the free list ALWAYS has an
+        # empty page table (the page-lifecycle obligation FC503 checks).
+        self._decoder.release_slot(slot)
         self._slot_req[slot] = None
         self._lens[slot] = 0
         self._last_tok[slot] = self._decoder.cfg.EOS
@@ -428,6 +531,9 @@ class SlotServeService:
             self._last_tok[slot] = self._decoder.cfg.EOS
             self._active_arr[slot] = False
         self._retired = []
+        # The failed rows' page tables go with them — the allocator
+        # identity must hold across the reset, not leak into the retry.
+        self._decoder.reset_slots()
         with self._cv:
             drained, self._q = self._q, []
             for req in drained:
@@ -478,6 +584,11 @@ class SlotServeService:
         for req in residual:
             req.done.set()
         self._thread.join(timeout=min(10.0, max(0.2, timeout)))
+        if not self._thread.is_alive():
+            # Quiescence: the lane is down, every slot released — return
+            # every page (prefix base refs included). Leaks are recorded
+            # by the decoder, not raised here.
+            self._decoder.close()
         return drained and not residual and not self._thread.is_alive()
 
     def snapshot(self) -> dict:
@@ -523,6 +634,16 @@ class SlotServeService:
             "decode_steps": decode_steps,
             "tokens_out": tokens_out,
             "kv_bytes": self._decoder.kv_bytes,
+            # Paged-pool block (all-zero when the contiguous decoder runs
+            # — the schema is mode-independent so pollers never branch).
+            "kv_pages": self._decoder.kv_pages,
+            "page_bytes": self._decoder.page_bytes,
+            "pages_free": self._decoder.pages_free,
+            "prefix_pages": self._decoder.prefix_pages,
+            "prefix_hits": self._decoder.prefix_hits,
+            "cow_copies": self._decoder.cow_copies,
+            "kv_bytes_saved_vs_contiguous":
+                self._decoder.kv_bytes_saved_vs_contiguous,
         }
 
 

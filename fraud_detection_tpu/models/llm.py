@@ -910,32 +910,17 @@ def _slot_step_math(params: Params, cfg: TransformerConfig,
     return tok, new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps"))
-def slot_decode_window(params: Params, tokens: jax.Array, lens: jax.Array,
-                       active: jax.Array, remaining: jax.Array,
-                       cfg: TransformerConfig,
-                       kv_cache: Dict[str, jax.Array],
-                       temperature: jax.Array, rng: jax.Array,
-                       steps: int):
-    """Up to ``steps`` fused decode iterations for the WHOLE slot pool —
-    iteration-level scheduling with the per-token dispatch amortized
-    (multi-step scheduling: admissions land at window boundaries, which
-    is the continuous-batching granularity knob).
-
-    ``tokens``: (B,) last sampled token per slot (written this window);
-    ``lens``: (B,) valid cache length per slot; ``active``: (B,) bool —
-    inactive slots compute garbage into index ``lens[b]`` (free slots
-    keep lens 0) which the next prefill overwrites, and always emit EOS;
-    ``remaining``: (B,) per-slot token budget left. A row that samples
-    EOS or exhausts its budget FREEZES for the rest of the window (emits
-    EOS, writes nothing further) — exactly the `_generate_batch_jit`
-    freeze rule — and the loop exits early once every row froze.
-
-    Returns ``(out (B, steps) EOS-padded, new_lens, steps_run,
-    active_row_steps, new_cache)``; the host appends each row's tokens
-    column-by-column under the same freeze rule, so host and device agree
-    bit-for-bit, and steps_run/active_row_steps feed the occupancy
-    accounting."""
+def _slot_window_loop(params: Params, tokens: jax.Array, lens: jax.Array,
+                      active: jax.Array, remaining: jax.Array,
+                      cfg: TransformerConfig,
+                      kv_cache: Dict[str, jax.Array],
+                      temperature: jax.Array, rng: jax.Array,
+                      steps: int):
+    """The fused multi-step decode loop over a (B, S, Hkv, d) cache layout —
+    shared VERBATIM by the contiguous pool (`slot_decode_window`) and the
+    paged pool (`paged_decode_window`, which gathers its pages into exactly
+    this layout first). One body means the two paths are bit-equal by
+    construction, not by test luck."""
     B = tokens.shape[0]
     out0 = jnp.full((B, steps), cfg.EOS, jnp.int32)
 
@@ -962,6 +947,219 @@ def slot_decode_window(params: Params, tokens: jax.Array, lens: jax.Array,
     i, _, new_lens, _, _, new_cache, out, n_act = jax.lax.while_loop(
         cond, body, carry)
     return out, new_lens, i, n_act, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"))
+def slot_decode_window(params: Params, tokens: jax.Array, lens: jax.Array,
+                       active: jax.Array, remaining: jax.Array,
+                       cfg: TransformerConfig,
+                       kv_cache: Dict[str, jax.Array],
+                       temperature: jax.Array, rng: jax.Array,
+                       steps: int):
+    """Up to ``steps`` fused decode iterations for the WHOLE slot pool —
+    iteration-level scheduling with the per-token dispatch amortized
+    (multi-step scheduling: admissions land at window boundaries, which
+    is the continuous-batching granularity knob).
+
+    ``tokens``: (B,) last sampled token per slot (written this window);
+    ``lens``: (B,) valid cache length per slot; ``active``: (B,) bool —
+    inactive slots compute garbage into index ``lens[b]`` (free slots
+    keep lens 0) which the next prefill overwrites, and always emit EOS;
+    ``remaining``: (B,) per-slot token budget left. A row that samples
+    EOS or exhausts its budget FREEZES for the rest of the window (emits
+    EOS, writes nothing further) — exactly the `_generate_batch_jit`
+    freeze rule — and the loop exits early once every row froze.
+
+    Returns ``(out (B, steps) EOS-padded, new_lens, steps_run,
+    active_row_steps, new_cache)``; the host appends each row's tokens
+    column-by-column under the same freeze rule, so host and device agree
+    bit-for-bit, and steps_run/active_row_steps feed the occupancy
+    accounting."""
+    return _slot_window_loop(params, tokens, lens, active, remaining, cfg,
+                             kv_cache, temperature, rng, steps)
+
+
+# ---------------------------------------------------------------------------
+# paged slot decode (PagedAttention-style KV pool: explain/slotserve/)
+#
+# The pooled cache above still reserves a worst-case (slots, S, Hkv, d)
+# region per slot. The paged layout below replaces it with a flat pool of
+# fixed-size KV blocks — per layer/tensor (num_pages, page, Hkv, d) — plus a
+# per-slot PAGE TABLE of page ids. Device programs see only gathers and
+# scatters by page id (no data-dependent shapes; table shapes are static),
+# and the page tables themselves mutate on the HOST side of the iteration
+# boundary, so the compiled programs stay shape-stable across any
+# allocation pattern. Shared-prefix caching falls out of the indirection:
+# several tables may point at the same refcounted read-only pages holding
+# the explain template's preamble k/v, prefilled once (PagedAttention /
+# RadixAttention, applied to the slot pool). Allocation policy — refcounts,
+# copy-on-write, exhaustion preemption — lives with the host-side allocator
+# in explain/slotserve/decode.py; nothing here allocates.
+# ---------------------------------------------------------------------------
+
+
+def init_kv_pages(cfg: TransformerConfig, num_pages: int,
+                  page_size: int) -> Dict[str, jax.Array]:
+    """The paged twin of ``init_cache``: a flat block pool per layer/tensor.
+    Page ids index the leading axis; a slot's logical position p lives at
+    ``(table[p // page_size], p % page_size)``."""
+    return {f"l{l}.{t}": jnp.zeros(
+                (num_pages, page_size, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+            for l in range(cfg.n_layers) for t in ("k", "v")}
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def copy_kv_page(kv_pages: Dict[str, jax.Array], src: jax.Array,
+                 dst: jax.Array) -> Dict[str, jax.Array]:
+    """Copy-on-write device copy: page ``src`` -> page ``dst`` across every
+    layer/tensor. Traced page ids — one compile covers every COW."""
+    return {name: arr.at[dst].set(arr[src]) for name, arr in kv_pages.items()}
+
+
+def _gather_view(kv_pages: Dict[str, jax.Array],
+                 tables: jax.Array) -> Dict[str, jax.Array]:
+    """Materialize the contiguous-layout view of ``tables`` (B, n_view):
+    (B, n_view*page, Hkv, d) per layer/tensor. Unallocated table slots hold
+    filler id 0 — their gathered content is stale pool data, which the
+    decode/prefill masks (never attended) and the scatter-back never
+    targets (write positions are always table-covered by the allocator)."""
+    out = {}
+    for name, arr in kv_pages.items():
+        num_pages, page, hkv, d = arr.shape
+        g = arr[tables]                                  # (B, n_view, P, ...)
+        out[name] = g.reshape(tables.shape[0], tables.shape[1] * page, hkv, d)
+    return out
+
+
+@partial(jax.jit, static_argnames=("cfg", "prefix_len"))
+def paged_slot_prefill(params: Params, tokens: jax.Array, length: jax.Array,
+                       cfg: TransformerConfig,
+                       kv_pages: Dict[str, jax.Array], table_row: jax.Array,
+                       temperature: jax.Array, rng: jax.Array,
+                       prefix_len: int):
+    """Prefill ONE prompt suffix into the pages of ``table_row``.
+
+    ``tokens``: (1, Ts) RIGHT-padded suffix — with shared-prefix caching the
+    first ``prefix_len`` positions of the row are already resident (read-only
+    preamble pages every table points at), so only the transcript suffix is
+    computed; ``prefix_len == 0`` is the plain no-sharing path. ``length`` is
+    the FULL prompt length (prefix + real suffix), matching the contiguous
+    ``slot_prefill`` convention so the sampled-token position is identical.
+
+    ``table_row``: (n_view,) page ids covering at least
+    ``prefix_len + Ts`` positions. Suffix k/v scatter into the row's own
+    pages; the prefix region is only gathered (COW in the allocator
+    guarantees a table never points a WRITE position at a shared page).
+    ``prefix_len`` is static: one shared preamble per service -> one
+    compile per suffix bucket, same bound as the contiguous ladder.
+
+    Bit-equality with ``slot_prefill``: suffix activations are position-
+    wise identical; attention reads [cached prefix k/v ; this suffix's
+    k/v] under the same causal mask (row j attends positions <=
+    prefix_len + j), and the masked tail pads with exact zeros — the
+    zero-pad width invariance the slot tests pin."""
+    B, Ts = tokens.shape
+    page = next(iter(kv_pages.values())).shape[1]
+    positions = jnp.broadcast_to(prefix_len + jnp.arange(Ts), (B, Ts))
+    x = _embed_rows(params["embed"], tokens, cfg.dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    act = jax.nn.silu if cfg.activation == "silu" else partial(
+        jax.nn.gelu, approximate=True)
+    rep = cfg.n_heads // cfg.kv_heads
+    # Static per-suffix-position page/offset mapping: position prefix_len+j
+    # lives at (table_row[(prefix_len+j)//page], (prefix_len+j)%page).
+    pos = prefix_len + jnp.arange(Ts)
+    pids = table_row[pos // page]                        # (Ts,) traced ids
+    offs = pos % page
+    # Row j attends every resident position at or below its own.
+    kv_mask = (jnp.arange(table_row.shape[0] * page)[None, :]
+               <= pos[:, None])                          # (Ts, Tkv)
+    new_pages: Dict[str, jax.Array] = dict(kv_pages)
+    for l in range(cfg.n_layers):
+        h = rms_norm(x, params[f"l{l}.ln1"], cfg.rms_eps)
+        q = _mm("btD,Dhd->bthd", h, params[f"l{l}.wq"], cfg.dtype)
+        k = _mm("btD,Dhd->bthd", h, params[f"l{l}.wk"], cfg.dtype)
+        v = _mm("btD,Dhd->bthd", h, params[f"l{l}.wv"], cfg.dtype)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        # Scatter the suffix k/v into the row's own pages (pad-region
+        # overhang included — garbage-but-private, masked downstream and
+        # overwritten in order by decode, same as the contiguous path).
+        pk = new_pages[f"l{l}.k"].at[pids, offs].set(k[0])
+        pv = new_pages[f"l{l}.v"].at[pids, offs].set(v[0])
+        new_pages[f"l{l}.k"], new_pages[f"l{l}.v"] = pk, pv
+        # Gather the row's resident view: prefix pages + the suffix just
+        # written. (B=1: table_row[None] is the one-row table.)
+        view = _gather_view({"k": pk, "v": pv}, table_row[None])
+        attn = _attend(q, _expand_kv_heads(view["k"], rep),
+                       _expand_kv_heads(view["v"], rep), kv_mask)
+        x = x + _mm("bthd,hdD->btD", attn, params[f"l{l}.wo"], cfg.dtype)
+        h2 = rms_norm(x, params[f"l{l}.ln2"], cfg.rms_eps)
+        gate = act(_mm("btD,DF->btF", h2, params[f"l{l}.w_gate"], cfg.dtype))
+        up = _mm("btD,DF->btF", h2, params[f"l{l}.w_up"], cfg.dtype)
+        x = x + _mm("btF,FD->btD", gate * up, params[f"l{l}.w_down"], cfg.dtype)
+    x = rms_norm(x, params["ln_f"], cfg.rms_eps)
+    # Logits at the last REAL position, suffix-local index length-1-prefix.
+    x_last = jax.lax.dynamic_slice_in_dim(
+        x[0], length - 1 - prefix_len, 1, 0)                       # (1, D)
+    logits = _logits_head(x_last, params, cfg)                     # (1, V)
+    tok = _sample_token(temperature, logits, rng)
+    return tok[0], new_pages
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "view_len"))
+def paged_decode_window(params: Params, tokens: jax.Array, lens: jax.Array,
+                        active: jax.Array, remaining: jax.Array,
+                        cfg: TransformerConfig,
+                        kv_pages: Dict[str, jax.Array], tables: jax.Array,
+                        temperature: jax.Array, rng: jax.Array,
+                        steps: int, view_len: int):
+    """`slot_decode_window` over the paged pool: gather every slot's pages
+    into the contiguous (B, view_len, Hkv, d) layout, run the IDENTICAL
+    fused window loop (``_slot_window_loop``), then scatter each row's
+    newly written positions [lens, new_lens) back to its pages.
+
+    ``view_len`` is the contiguous pool's max_len: the gathered view is
+    SLICED to it (the last page may overhang when max_len is not
+    page-aligned), so the window loop runs at exactly the contiguous
+    attention width — bit-equal by construction, not by reduction-order
+    luck.
+
+    ``tables``: (B, n_view) page ids; the allocator guarantees every active
+    row's table covers [0, lens + steps) before the call, so scatter-back
+    positions are always table-resident. Frozen/inactive rows write
+    in-window garbage at their frozen ``lens`` exactly like the contiguous
+    path — it is NOT scattered back (the next admit/step overwrites it
+    before any attend, so dropping it preserves bit-equality)."""
+    B = tokens.shape[0]
+    page = next(iter(kv_pages.values())).shape[1]
+    n_view = tables.shape[1]
+    num_pages = next(iter(kv_pages.values())).shape[0]
+    if not 0 < view_len <= n_view * page:
+        raise ValueError(f"view_len {view_len} outside (0, "
+                         f"{n_view * page}]")
+    view = {name: arr[:, :view_len]
+            for name, arr in _gather_view(kv_pages, tables).items()}
+    out, new_lens, i, n_act, new_view = _slot_window_loop(
+        params, tokens, lens, active, remaining, cfg, view, temperature,
+        rng, steps)
+    # Scatter-back: row b wrote view positions [lens[b], new_lens[b]).
+    rows = jnp.arange(B)
+    pos = lens[:, None] + jnp.arange(steps)[None, :]               # (B, W)
+    valid = pos < new_lens[:, None]
+    pidx = jnp.minimum(pos // page, n_view - 1)
+    pids = jnp.take_along_axis(tables, pidx, axis=1)
+    # Invalid entries get an out-of-range page id: JAX scatter DROPS
+    # out-of-bounds writes, so masked positions never touch the pool.
+    pids = jnp.where(valid, pids, num_pages)
+    offs = pos % page
+    pos_c = jnp.minimum(pos, view_len - 1)
+    new_pages: Dict[str, jax.Array] = {}
+    for name, arr in kv_pages.items():
+        vals = new_view[name][rows[:, None], pos_c]        # (B, W, Hkv, d)
+        new_pages[name] = arr.at[pids, offs].set(vals)
+    return out, new_lens, i, n_act, new_pages
 
 
 # ---------------------------------------------------------------------------

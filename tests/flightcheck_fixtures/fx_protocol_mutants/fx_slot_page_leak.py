@@ -1,0 +1,26 @@
+"""Protocol mutant: a slot freed without releasing its KV pages.
+
+The refactor-shaped bug the page lifecycle exists to prevent: ``_release``
+returns the slot id to the free pool but never hands the slot's page
+references back to the allocator. The next admit maps fresh pages for the
+same slot while the old row's pages stay referenced forever — the
+allocator accounting identity (``free + pages_with_refs == total``) drifts
+one admit at a time until the pool is exhausted by ghosts. Statically,
+FC503's ``pages-freed-on-slot-release`` obligation must flag that
+``_release`` re-pools the slot without a ``_decoder.release_slot`` call."""
+
+
+class MutantSlotServeService:
+    def __init__(self, decoder, slots):
+        self._decoder = decoder
+        self._free = list(range(slots))
+        self._reqs = [None] * slots
+        self._lens = [0] * slots
+
+    def _release(self, slot):
+        # VIOLATION FC503 pages-freed-on-slot-release: the slot id goes
+        # back to the free pool with its page references still held —
+        # every reuse leaks the prior row's pages.
+        self._reqs[slot] = None
+        self._lens[slot] = 0
+        self._free.append(slot)

@@ -277,6 +277,11 @@ _MUTANT_OBLIGATIONS = {
         "release-rides-revoke-barrier",
         "fx_autoscale.py::MutantCoordinator.request_release",
         first="call:_released.add", then="call:_rebalance_locked", why="w"),
+    "fx_slot_page_leak.py": BarrierObligation(
+        "pages-freed-on-slot-release",
+        "fx_slot_page_leak.py::MutantSlotServeService._release",
+        first="call:_decoder.release_slot", then="call:_free.append",
+        why="w"),
 }
 
 

@@ -364,6 +364,15 @@ SLOTSERVE_BLOCK_SCHEMA = {
     "decode_steps": (int,),
     "tokens_out": (int,),
     "kv_bytes": (int,),
+    # Paged-pool block (PR 19): zeros in contiguous mode so the schema is
+    # mode-independent — FC301 pins these against snapshot()'s literal.
+    "kv_pages": (int,),
+    "page_bytes": (int,),
+    "pages_free": (int,),
+    "prefix_pages": (int,),
+    "prefix_hits": (int,),
+    "cow_copies": (int,),
+    "kv_bytes_saved_vs_contiguous": (int,),
 }
 
 
