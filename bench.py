@@ -239,6 +239,20 @@ def append_bench_trend(line: dict, path=None, *, keep: int = 500,
             "efficiency_vs_static_max_x": a.get(
                 "efficiency_vs_static_max_x"),
         } if a and "error" not in a else None))(line.get("autoscale") or {}),
+        # Flightcheck v4 (ISSUE 20, docs/static_analysis.md): liveness
+        # checker wall/states (lasso detection under weak fairness over
+        # the default bounded topology) + the trace-conformance replay
+        # wall, so a state-space blowup or a slow conformance scan diffs
+        # in the trend file.
+        "flightcheck": (lambda fc: ({
+            "liveness_ok": fc.get("liveness_ok"),
+            "liveness_wall_s": fc.get("liveness_wall_s"),
+            "liveness_states": fc.get("liveness_states"),
+            "liveness_sccs": fc.get("liveness_sccs"),
+            "conform_wall_s": fc.get("conform_wall_s"),
+            "conform_records": fc.get("conform_records"),
+        } if fc and "error" not in fc
+            else None))(line.get("flightcheck") or {}),
     }
     trend = []
     try:
@@ -1345,6 +1359,76 @@ def learn_bench() -> dict:
     # regression, not a data point.
     assert out["promoted"], out
     assert out["accounting_exact"] is True, out
+    return out
+
+
+def flightcheck_bench() -> dict:
+    """Flightcheck v4 evidence (ISSUE 20, docs/static_analysis.md): the
+    liveness model checker's wall/states over the default bounded topology
+    (all four eventually-invariants must VERIFY — a livelock here is a
+    protocol regression, not a data point) + the trace-conformance replay
+    wall over a real succession journal (zero violations under the bus's
+    own transport budgets) — so a state-space blowup or a slow conformance
+    scan diffs in the artifact and the trend file."""
+    from fraud_detection_tpu.analysis import checker, conformance
+    from fraud_detection_tpu.fleet.control import SuccessionCoordinator
+    from fraud_detection_tpu.stream.faults import CoordinatorKillSpec
+
+    out: dict = {}
+    # Liveness leg: the default CheckConfig is the same topology CI's
+    # liveness-smoke verifies; wall + states + SCC count are the trended
+    # costs (docs/static_analysis.md budget table).
+    res = checker.check_liveness(checker.CheckConfig())
+    assert res.ok and not res.budget_exhausted, res
+    out["liveness_ok"] = res.ok
+    out["liveness_wall_s"] = round(res.elapsed, 3)
+    out["liveness_states"] = res.states
+    out["liveness_transitions"] = res.transitions
+    out["liveness_sccs"] = res.sccs
+    out["liveness_checked"] = len(res.checked)
+
+    # Conformance leg: drive an actual SuccessionCoordinator (graceful
+    # leader handoff + sustained worker traffic) and replay the journal
+    # its succession_report() exports — the same seam `flightcheck
+    # conform` consumes. The replay must be clean; the trended number is
+    # the scan wall over the record count.
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = _Clock()
+    kill = CoordinatorKillSpec(seed=1, kills=1, min_ticks=2, max_ticks=2,
+                               modes=("graceful",))
+    sc = SuccessionCoordinator(["in"], 2, candidates=2, role_ttl=5.0,
+                               kill=kill, clock=clock, wall=clock)
+    sc.join("w0")
+    sc.join("w1")
+    rounds = int(os.environ.get("BENCH_FLIGHTCHECK_ROUNDS", "400"))
+    for i in range(rounds):
+        clock.t += 0.05
+        sc.tick()
+        if i == 3:
+            sc.step("c1")        # successor claims the graceful vacancy
+        sc.sync("w0")
+        sc.ack("w0")
+        sc.sync("w1")
+        sc.ack("w1")
+    sc.leave("w1")
+    report = sc.succession_report()
+    records, ctx = conformance.extract_trace(report)
+    t0 = time.perf_counter()
+    violations = conformance.check_records(
+        records, handoffs=ctx.get("handoffs"),
+        lost=ctx["lost"], reordered=ctx["reordered"])
+    conform_wall = time.perf_counter() - t0
+    assert violations == [], "\n".join(v.render() for v in violations)
+    out["conform_records"] = len(records)
+    out["conform_wall_s"] = round(conform_wall, 4)
+    out["conform_records_per_s"] = (round(len(records) / conform_wall)
+                                    if conform_wall > 0 else None)
+    out["conform_violations"] = 0
     return out
 
 
@@ -2459,6 +2543,14 @@ def main() -> int:
         # latency, join-hit ratio, exact accounting (asserted in-leg).
         harness.section("learn", lambda scratch: learn_bench(),
                         fraction=0.35)
+
+    if os.environ.get("BENCH_FLIGHTCHECK", "1") != "0":
+        # Flightcheck v4 evidence (ISSUE 20, docs/static_analysis.md):
+        # liveness wall/states over the default bounded topology (all four
+        # eventually-invariants VERIFY) + the conformance replay wall over
+        # a real succession journal.
+        harness.section("flightcheck", lambda scratch: flightcheck_bench(),
+                        fraction=0.25)
 
     if os.environ.get("BENCH_ALERTS", "1") != "0":
         # Sentinel evidence (ISSUE 14, docs/observability.md): detection

@@ -22,7 +22,17 @@ every invariant holds over all bounded interleavings, 1 with a
 counterexample trace (also written to ``--trace-file``, and to ``--sarif``
 as an FC504 result), 2 when the state/wall budget was exhausted before
 the frontier emptied. ``--mutate`` seeds a protocol mutation that MUST
-produce a counterexample — the checker checking itself.
+produce a counterexample — the checker checking itself. ``--liveness``
+switches to the eventually-invariants: lasso detection under weak
+fairness over the same bounded space, exit 1 rendering the stem+cycle
+counterexample (the three livelock mutations each MUST die this way).
+
+``flightcheck conform`` replays a recorded control-lane run (``--input``:
+a game-day ``--record`` file, a ``succession_report()`` dict, or a raw
+record list) against the declared role state machines, tolerating exactly
+the transport casualties the bus accounted: exit 0 conformant, 1 with
+each violation citing the offending record (FC505 via ``--sarif``), 2 on
+unreadable input.
 """
 
 from __future__ import annotations
@@ -40,7 +50,8 @@ def model_main(argv=None) -> int:
     from fraud_detection_tpu.analysis.checker import (AUTOSCALE_CONFIG,
                                                       MUTATIONS,
                                                       SUCCESSION_CONFIG,
-                                                      CheckConfig, check)
+                                                      CheckConfig, check,
+                                                      check_liveness)
     from fraud_detection_tpu.analysis import traces
 
     parser = argparse.ArgumentParser(
@@ -86,6 +97,11 @@ def model_main(argv=None) -> int:
     parser.add_argument("--mutate", default=None,
                         help="comma-separated protocol mutations to seed "
                              f"(known: {', '.join(MUTATIONS)})")
+    parser.add_argument("--liveness", action="store_true",
+                        help="check the eventually-invariants by lasso "
+                             "detection under weak fairness instead of "
+                             "the safety invariants; a violation renders "
+                             "as stem + repeating cycle")
     parser.add_argument("--max-states", type=int, default=400_000)
     parser.add_argument("--max-seconds", type=float, default=120.0)
     parser.add_argument("--no-symmetry", action="store_true",
@@ -132,6 +148,62 @@ def model_main(argv=None) -> int:
     except ValueError as e:
         print(f"flightcheck model: {e}", file=sys.stderr)
         return 2
+
+    if args.liveness:
+        # Liveness explores in canonical (symmetry-reduced) space and
+        # lasso steps are regenerated inside it, so the rendered worker
+        # labels are canonical ids — there is no plain re-search here
+        # (a lasso found in the quotient graph need not exist verbatim
+        # in the concrete graph; the canonical replay is the witness).
+        lresult = check_liveness(cfg)
+        report = traces.render_liveness(lresult, cfg)
+        if args.json:
+            payload = {
+                "ok": lresult.ok,
+                "liveness": True,
+                "states": lresult.states,
+                "transitions": lresult.transitions,
+                "sccs": lresult.sccs,
+                "elapsed_s": round(lresult.elapsed, 3),
+                "budget_exhausted": lresult.budget_exhausted,
+                "budget_reason": lresult.budget_reason,
+                "checked": list(lresult.checked),
+                "mutations": sorted(cfg.mutations),
+                "invariant_violated": (lresult.lasso.invariant
+                                       if lresult.lasso else None),
+                "stem": ([{"actor": s.actor, "action": s.action,
+                           "detail": s.detail}
+                          for s in lresult.lasso.stem]
+                         if lresult.lasso else []),
+                "cycle": ([{"actor": s.actor, "action": s.action,
+                            "detail": s.detail}
+                           for s in lresult.lasso.cycle]
+                          if lresult.lasso else []),
+            }
+            print(json.dumps(payload, indent=2))
+        else:
+            print(report)
+        if args.trace_file:
+            with open(args.trace_file, "w", encoding="utf-8") as f:
+                f.write(report + "\n")
+        if args.sarif:
+            from fraud_detection_tpu.analysis import sarif
+
+            findings = ([traces.lasso_to_finding(lresult.lasso)]
+                        if lresult.lasso else [])
+            doc = sarif.build(findings, suppressed=0, n_files=0)
+            problems = sarif.validate(doc)
+            if problems:  # pragma: no cover - emitter/validator drift
+                print("SARIF self-validation failed:\n  "
+                      + "\n  ".join(problems), file=sys.stderr)
+                return 2
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=2)
+        if lresult.lasso is not None:
+            return 1
+        if lresult.budget_exhausted:
+            return 2
+        return 0
 
     result = check(cfg)
     if result.violation is not None and cfg.symmetry:
@@ -191,11 +263,71 @@ def model_main(argv=None) -> int:
     return 0
 
 
+def conform_main(argv=None) -> int:
+    from fraud_detection_tpu.analysis import conformance
+
+    parser = argparse.ArgumentParser(
+        prog="flightcheck conform",
+        description="replay a recorded control-lane run against the "
+                    "declared role state machines (FLEET_PROTOCOLS); "
+                    "exit 1 on any non-conforming record "
+                    "(docs/static_analysis.md)")
+    parser.add_argument("--input", required=True, metavar="PATH",
+                        help="JSON file: a record list, {'records': "
+                             "[...]}, a succession_report() dict, or a "
+                             "game-day result with evidence."
+                             "succession.trace")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable result on stdout")
+    parser.add_argument("--sarif", default=None, metavar="PATH",
+                        help="write violations as SARIF 2.1.0 FC505 "
+                             "results")
+    args = parser.parse_args(argv)
+
+    try:
+        with open(args.input, "r", encoding="utf-8") as f:
+            obj = json.load(f)
+        records, ctx = conformance.extract_trace(obj)
+    except (OSError, ValueError) as e:
+        print(f"flightcheck conform: {e}", file=sys.stderr)
+        return 2
+
+    violations = conformance.check_records(
+        records, handoffs=ctx.get("handoffs"),
+        lost=ctx.get("lost", 0), reordered=ctx.get("reordered", 0))
+    if args.json:
+        print(json.dumps({
+            "ok": not violations,
+            "summary": conformance.summarize(violations, len(records)),
+            "violations": [{"index": v.index, "rule": v.rule,
+                            "detail": v.detail, "record": v.record}
+                           for v in violations],
+        }, indent=2))
+    else:
+        print(conformance.render_report(violations, len(records),
+                                        args.input))
+    if args.sarif:
+        from fraud_detection_tpu.analysis import sarif
+
+        doc = sarif.build(conformance.to_findings(violations),
+                          suppressed=0, n_files=0)
+        problems = sarif.validate(doc)
+        if problems:  # pragma: no cover - emitter/validator drift guard
+            print("SARIF self-validation failed:\n  "
+                  + "\n  ".join(problems), file=sys.stderr)
+            return 2
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+    return 1 if violations else 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "model":
         return model_main(argv[1:])
+    if argv and argv[0] == "conform":
+        return conform_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="flightcheck",
         description="flightcheck: first-party static analysis "

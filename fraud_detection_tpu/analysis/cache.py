@@ -38,15 +38,24 @@ CACHE_FORMAT = 1
 
 
 def _registry_salt() -> str:
-    """Hash of everything file-local findings depend on besides the file."""
+    """Hash of everything file-local findings depend on besides the file.
+
+    The checker/spec sources (checker.py, conformance.py, entrypoints.py)
+    are folded in too: their registries (FLEET_PROTOCOLS, the mutation
+    and invariant catalogs) feed pragma justification and FC5xx context
+    that file-local passes cite, so editing a spec must never serve a
+    stale lint verdict (tests/test_flightcheck.py pins the
+    invalidation)."""
+    import fraud_detection_tpu.analysis.checker as _k
     import fraud_detection_tpu.analysis.concurrency as _c
+    import fraud_detection_tpu.analysis.conformance as _f
     import fraud_detection_tpu.analysis.jaxlint as _j
     import fraud_detection_tpu.analysis.protocol as _p
     from fraud_detection_tpu.analysis import entrypoints
 
     h = hashlib.sha256()
     h.update(str(CACHE_FORMAT).encode())
-    for mod in (_c, _j, _p):
+    for mod in (_c, _j, _p, _k, _f, entrypoints):
         try:
             with open(mod.__file__, "rb") as f:
                 h.update(f.read())
@@ -55,6 +64,7 @@ def _registry_salt() -> str:
     h.update(_stable(dict(entrypoints.CONCURRENT_CLASSES)).encode())
     h.update(_stable(entrypoints.COMMIT_PROTOCOLS).encode())
     h.update(_stable(entrypoints.HOT_PATHS).encode())
+    h.update(_stable(entrypoints.FLEET_PROTOCOLS).encode())
     return h.hexdigest()[:16]
 
 

@@ -142,16 +142,53 @@ from dataclasses import dataclass, field
 from itertools import permutations
 from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
 
-MUTATIONS: Tuple[str, ...] = (
+#: Mutations that break a bad-state predicate: the safety search
+#: (``check``) must kill each with a shortest counterexample trace.
+SAFETY_MUTATIONS: Tuple[str, ...] = (
     "drop_fence", "skip_revoke_barrier", "ack_before_drain",
     "expire_before_renew", "forget_barrier_holds",
     "drop_coordinator_lease", "stale_term_fence_accepted",
     "forget_holds_on_failover", "release_before_drain",
 )
 
+#: Mutations that break a PROGRESS property instead: no reachable state is
+#: ever bad, but an obligation can now evade discharge forever on a fair
+#: cycle. Only the liveness search (``check_liveness``) kills these, each
+#: with a stem+cycle lasso — the liveness machinery's own regression
+#: guard, mirroring what SAFETY_MUTATIONS is to ``check``:
+#:
+#: * ``election_ping_pong`` — TOTAL beacon loss eats every claim: a
+#:   standby's election never lands, leadership ping-pongs back to vacant,
+#:   and ``election_eventually_converges`` dies on the elect cycle;
+#: * ``zero_cooldown_flap`` — scale-in decisions cost no budget and the
+#:   policy relaunches the workers it just released: scale_in -> drain ->
+#:   release -> scale_out -> join repeats forever
+#:   (``autoscale_eventually_stabilizes``);
+#: * ``drain_requeues_revoke`` — the drain-complete ack re-queues its own
+#:   revoke instead of releasing the barrier: the worker re-enters
+#:   draining and ``every_drain_eventually_acked`` never discharges.
+LIVELOCK_MUTATIONS: Tuple[str, ...] = (
+    "election_ping_pong", "zero_cooldown_flap", "drain_requeues_revoke",
+)
+
+MUTATIONS: Tuple[str, ...] = SAFETY_MUTATIONS + LIVELOCK_MUTATIONS
+
 INVARIANTS: Tuple[str, ...] = (
     "no_duplicate", "no_loss", "no_zombie_commit", "revoke_barrier",
     "no_self_expiry",
+)
+
+#: The "eventually" invariant class (``check_liveness``): progress
+#: obligations that a safety search cannot state, checked by LASSO
+#: detection — a reachable cycle, fair under the declared weak-fairness
+#: constraints, on which the obligation never discharges. Listed in CHECK
+#: order, most specific obligation first, so a livelock mutant
+#: deterministically names the invariant it was built to break.
+EVENTUALLY_INVARIANTS: Tuple[str, ...] = (
+    "election_eventually_converges",
+    "autoscale_eventually_stabilizes",
+    "every_drain_eventually_acked",
+    "every_row_eventually_committed",
 )
 
 #: checker action -> the FLEET_PROTOCOLS transitions (``Role.name``) each
@@ -447,7 +484,7 @@ def _canonical(state, cfg: CheckConfig):
 # ---------------------------------------------------------------------------
 
 def _rebalance(members, old_target, old_pending, P, mutations,
-               released=frozenset()):
+               released=frozenset(), leases=None):
     """The balanced-sticky re-deal, mirroring
     ``FleetCoordinator._rebalance_locked`` (with the barrier-hold
     persistence fix; ``forget_barrier_holds`` restores the pre-fix shape,
@@ -455,7 +492,18 @@ def _rebalance(members, old_target, old_pending, P, mutations,
     members — a coordinator-requested voluntary leave in flight — are
     excluded from the DEAL but remain eligible barrier HOLDERS until they
     drain and ack; ``release_before_drain`` drops exactly that hold (the
-    scale-in twin of ``skip_revoke_barrier``)."""
+    scale-in twin of ``skip_revoke_barrier``).
+
+    ``leases`` (per-worker issued-lease tuples) gates NEW holds: a hold
+    protects uncommitted read-ahead, which only an owner whose issued
+    lease actually covered the pair can have. A pair that merely
+    TRANSITED a member's target between two of its syncs (an expired
+    peer's pair parked on it, then re-dealt away before it ever synced)
+    leaves nothing to drain — and a phantom hold for it is never acked,
+    withholding the pair from its new owner forever. Found by
+    ``check_liveness`` as an ``every_row_eventually_committed`` lasso;
+    fixed in ``FleetCoordinator._rebalance_locked`` (the ``_issued``
+    map), kept here as the model's faithful mirror."""
     deal = tuple(m for m in members if m not in released)
     holders = set(deal) if "release_before_drain" in mutations \
         else set(members)
@@ -484,9 +532,14 @@ def _rebalance(members, old_target, old_pending, P, mutations,
             w = target[p]
             if "forget_barrier_holds" in mutations:
                 holder = old_target[p]
+            elif old_pending[p] >= 0:
+                holder = old_pending[p]       # existing holds outlive deals
             else:
-                holder = old_pending[p] if old_pending[p] >= 0 \
-                    else old_target[p]
+                holder = old_target[p]
+                if holder >= 0 and leases is not None \
+                        and p not in leases[holder]:
+                    holder = -1               # never issued: no read-ahead
+                                              # to protect, no phantom hold
             # An UNOWNED pair (w == -1: the deal has nobody to give it
             # to yet) still keeps its live holder's barrier hold — the
             # hold protects the pair's NEXT owner, whoever that is.
@@ -513,7 +566,7 @@ def _granted(target, pending, wid) -> Tuple[Tuple[int, ...], bool]:
 
 
 def _coord_sync(members, stale, target, pending, wid, mutations,
-                released=frozenset()):
+                released=frozenset(), leases=None):
     """join/sync(wid): renew-then-scan (or the mutant's scan-then-renew),
     re-deal when membership changed. Returns the updated fields plus the
     id the scan expired-of-itself (the no_self_expiry witness) and the
@@ -550,7 +603,8 @@ def _coord_sync(members, stale, target, pending, wid, mutations,
 
     if changed:
         target, pending = _rebalance(tuple(members), target, pending,
-                                     len(target), mutations, released)
+                                     len(target), mutations, released,
+                                     leases)
     return (tuple(members), tuple(sorted(stale_set)), target, pending,
             expired, self_expired)
 
@@ -603,6 +657,9 @@ class FleetModel:
         cfg, P, K = self.cfg, self.cfg.partitions, self.cfg.keys_per_partition
         leading, standby, czombie, term, ccrashes, clapses, scale_ins = coord
         released_set = frozenset(i for i, w in enumerate(workers) if w[5])
+        # Issued leases per worker (the coordinator's ``_issued`` map):
+        # gates NEW barrier holds in every re-deal below.
+        leases = tuple(w[1] for w in workers)
         # Control-plane RPCs (join/sync/ack/leave, the expiry scan) need a
         # live leader; the data plane (poll/commit on existing leases, the
         # materialized fence) rides out an interregnum. A lost or delayed
@@ -624,7 +681,7 @@ class FleetModel:
                 if have_leader:
                     m2, s2, t2, p2, expired, self_exp = _coord_sync(
                         members, stale, target, pending, wid, self.mut,
-                        released_set)
+                        released_set, leases)
                     w2 = _mark_zombies(workers, expired)
                     granted, _ = _granted(t2, p2, wid)
                     w2 = list(w2)
@@ -657,7 +714,7 @@ class FleetModel:
             if wstate == _RUN and have_leader:
                 m2, s2, t2, p2, expired, self_exp = _coord_sync(
                     members, stale, target, pending, wid, self.mut,
-                    released_set)
+                    released_set, leases)
                 w2 = list(_mark_zombies(workers, expired))
                 granted, withheld = _granted(t2, p2, wid)
                 detail = f"heartbeat; lease {{{_pp(granted)}}}"
@@ -812,6 +869,19 @@ class FleetModel:
 
             # ---- ack: drain complete -> release barrier, rebuild -------
             if wstate == _DRAIN and not rel and have_leader \
+                    and not self._read_ahead(worker) \
+                    and "drain_requeues_revoke" in self.mut:
+                # Livelock mutant: the drain-complete ack RE-QUEUES its
+                # own revoke instead of releasing the barrier — the hold
+                # is restored verbatim and the worker re-enters draining,
+                # so the drain obligation never discharges.
+                yield (Step(actor, "ack",
+                            "drained + committed: acks the barrier, but "
+                            "the BROKEN ack path re-queues its own revoke "
+                            "— the hold is restored and the worker is "
+                            "back in draining"),
+                       state, None)
+            elif wstate == _DRAIN and not rel and have_leader \
                     and not self._read_ahead(worker):
                 p2 = _release_holds(pending, wid)
                 s2 = tuple(x for x in stale if x != wid)   # ack renews
@@ -856,7 +926,7 @@ class FleetModel:
                 t2, p2 = target, _release_holds(pending, wid)
                 if wid in members:
                     t2, p2 = _rebalance(m2, t2, p2, P, self.mut,
-                                        released_set)
+                                        released_set, leases)
                 w2 = list(workers)
                 w2[wid] = (_LEFT, (), (-1,) * P, (-1,) * P, False, False)
                 nxt = (m2, s2, t2, p2, committed, tuple(w2),
@@ -912,7 +982,7 @@ class FleetModel:
                     t2, p2 = target, _release_holds(pending, wid)
                     if wid in members:
                         t2, p2 = _rebalance(m2, t2, p2, P, self.mut,
-                                            released_set)
+                                            released_set, leases)
                     w2 = list(workers)
                     w2[wid] = (_CRASH, (), (-1,) * P, (-1,) * P, False,
                                False)
@@ -942,7 +1012,8 @@ class FleetModel:
             p2 = pending
             for e in expired:
                 p2 = _release_holds(p2, e)
-            t2, p2 = _rebalance(m2, target, p2, P, self.mut, released_set)
+            t2, p2 = _rebalance(m2, target, p2, P, self.mut, released_set,
+                                leases)
             w2 = _mark_zombies(workers, expired)
             nxt = (m2, (), t2, p2, committed, w2, crashes, lapses, coord)
             yield (Step("coord", "tick",
@@ -960,17 +1031,25 @@ class FleetModel:
         # are coordinator control-plane moves.
         if have_leader:
             for wid, worker in enumerate(workers):
-                if worker[0] != _UNPROV:
+                # Livelock mutant: a zero-cooldown policy will relaunch
+                # the very worker it just released — the scale decisions
+                # chase each other and capacity flaps forever.
+                flap = ("zero_cooldown_flap" in self.mut
+                        and worker[0] == _LEFT)
+                if worker[0] != _UNPROV and not flap:
                     continue
                 w2 = list(workers)
                 w2[wid] = (_INIT, (), (-1,) * P, (-1,) * P, False, False)
                 nxt = (members, stale, target, pending, committed,
                        tuple(w2), crashes, lapses, coord)
-                yield (Step("coord", "scale_out",
-                            f"policy scales OUT: the provisioner launches "
-                            f"spare w{wid}, which will join through the "
-                            f"ordinary join path"),
-                       nxt, None)
+                detail = (f"policy scales OUT with ZERO COOLDOWN: the "
+                          f"provisioner relaunches w{wid}, the worker the "
+                          f"policy itself just released"
+                          if flap else
+                          f"policy scales OUT: the provisioner launches "
+                          f"spare w{wid}, which will join through the "
+                          f"ordinary join path")
+                yield Step("coord", "scale_out", detail), nxt, None
 
         # scale_in: the coordinator marks a member RELEASED and re-deals
         # its pairs among the remaining active members — moved pairs enter
@@ -985,12 +1064,15 @@ class FleetModel:
                 for wid in active:
                     rel2 = released_set | {wid}
                     t2, p2 = _rebalance(members, target, pending, P,
-                                        self.mut, rel2)
+                                        self.mut, rel2, leases)
                     w2 = list(workers)
                     ws, wl, wpos, wbase, wz, _ = workers[wid]
                     w2[wid] = (ws, wl, wpos, wbase, wz, True)
+                    # Livelock mutant: scale-in decisions cost no budget
+                    # (the zero-cooldown policy never runs out of them).
+                    spent_in = 0 if "zero_cooldown_flap" in self.mut else 1
                     c2 = (leading, standby, czombie, term, ccrashes,
-                          clapses, scale_ins + 1)
+                          clapses, scale_ins + spent_in)
                     nxt = (members, stale, t2, p2, committed, tuple(w2),
                            crashes, lapses, c2)
                     yield (Step("coord", "scale_in",
@@ -1045,7 +1127,21 @@ class FleetModel:
         # fence can reject the superseded leader's late decisions
         # (drop_coordinator_lease skips the lease CAS: no term advance;
         # forget_holds_on_failover drops the inherited holds).
-        if not have_leader and standby > 0:
+        if not have_leader and standby > 0 \
+                and "election_ping_pong" in self.mut:
+            # Livelock mutant: TOTAL beacon loss eats the claim. The
+            # standby wins the CAS but no peer (nor the standby itself)
+            # ever observes the win, so it steps straight back to standby
+            # and the role stays vacant — the election is a self-loop
+            # that can repeat forever.
+            yield (Step("coord", "elect",
+                        f"standby candidate claims the vacant role at "
+                        f"term {term + 1}, but TOTAL BEACON LOSS eats the "
+                        f"claim: no peer observes the win, the claimer "
+                        f"hears no echo of its own beacon and steps back "
+                        f"to standby — the role is vacant again"),
+                   state, None)
+        elif not have_leader and standby > 0:
             term2 = term if "drop_coordinator_lease" in self.mut \
                 else term + 1
             p2 = pending
@@ -1210,6 +1306,368 @@ def check(cfg: CheckConfig) -> CheckResult:
 
     return CheckResult(True, None, states, transitions, depth,
                        time.perf_counter() - start, coverage=coverage)
+
+
+# ---------------------------------------------------------------------------
+# liveness: lasso detection under weak fairness
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Lasso:
+    """A liveness counterexample: a reachable FAIR cycle on which the
+    obligation never discharges. ``stem`` reaches the cycle's entry state
+    from the initial state; ``cycle`` returns to that entry state and can
+    repeat forever under a weakly-fair scheduler — the run it denotes is
+    infinite, which no finite safety trace can say."""
+
+    invariant: str
+    detail: str
+    stem: Tuple[Step, ...]
+    cycle: Tuple[Step, ...]
+
+
+@dataclass
+class LivenessResult:
+    ok: bool
+    lasso: Optional[Lasso]
+    states: int
+    transitions: int
+    sccs: int
+    elapsed: float
+    budget_exhausted: bool = False
+    budget_reason: str = ""
+    checked: Tuple[str, ...] = EVENTUALLY_INVARIANTS
+
+
+#: Adversary moves: weak fairness never obliges the environment to keep
+#: acting — crash/stall budgets may go unspent, the zombie's delayed
+#: record may never arrive, the scaling policy may never issue another
+#: decision. Everything else is protocol work whose continuous enablement
+#: means it is eventually scheduled (the declared fairness constraints:
+#: the environment cannot crash/lapse forever, a candidate's election
+#: tick is eventually scheduled). ``lapse`` is split by actor state in
+#: :func:`_fair_label`: a DEAD worker's ttl elapsing is inevitable
+#: (fair), a live worker stalling past its ttl is the budgeted adversary.
+_UNFAIR_ACTIONS = frozenset({
+    "crash", "coord_crash", "coord_lapse", "scale_in", "scale_out",
+    "stale_assign",
+})
+
+
+def _fair_label(label: Tuple[str, str], state) -> bool:
+    actor, action = label
+    if action in _UNFAIR_ACTIONS:
+        return False
+    if action == "lapse":
+        return state[5][int(actor[1:])][0] == _CRASH
+    return True
+
+
+def _pending_rows(state, K: int) -> bool:
+    return any(c < K for c in state[4])
+
+
+def _pending_drain(state, K: int) -> bool:
+    return any(w[0] == _DRAIN for w in state[5])
+
+
+def _pending_election(state, K: int) -> bool:
+    return state[8][0] == 0
+
+
+def _pending_autoscale(state, K: int) -> bool:
+    return any(w[5] for w in state[5])
+
+
+#: name -> (pending predicate, flagged actions, meaning). A fair cycle
+#: violates an eventually-invariant two ways: the obligation is pending
+#: at EVERY state of the cycle (it never discharges), or a FLAGGED action
+#: — one a converging run performs only finitely often — labels one of
+#: the cycle's edges (it recurs forever). Ordered as
+#: EVENTUALLY_INVARIANTS: most specific obligation first, so each
+#: livelock mutant deterministically names the invariant it breaks.
+_EVENTUALLY_DEFS: Tuple[Tuple[str, object, FrozenSet[str], str], ...] = (
+    ("election_eventually_converges", _pending_election,
+     frozenset({"elect"}),
+     "the coordinator role never converges to a stable leader"),
+    ("autoscale_eventually_stabilizes", _pending_autoscale,
+     frozenset({"scale_in", "scale_out", "release"}),
+     "scaling decisions never quiesce — capacity flaps forever"),
+    ("every_drain_eventually_acked", _pending_drain, frozenset(),
+     "a draining worker never completes its barrier ack"),
+    ("every_row_eventually_committed", _pending_rows, frozenset(),
+     "rows stay undelivered at every state of a fair cycle"),
+)
+
+
+def _step_for(model: FleetModel, cfg: CheckConfig, u, label, v) -> Step:
+    """Regenerate the Step for edge ``u --label--> v`` (the graph stores
+    only interned (actor, action) labels; details are re-derived on the
+    witness path alone). Deterministic: successors() is."""
+    for step, succ, _violation in model.successors(u):
+        if (step.actor, step.action) == label \
+                and _canonical(succ, cfg) == v:
+            return step
+    # Unreachable: the edge came from the same generator.
+    return Step(label[0], label[1], "")  # pragma: no cover
+
+
+def check_liveness(cfg: CheckConfig) -> LivenessResult:
+    """Lasso detection for the EVENTUALLY_INVARIANTS.
+
+    Builds the full reachable state graph (same macro-step fusion and
+    worker-symmetry reductions as :func:`check` — exploration happens in
+    canonical space, so trace actor labels are canonical worker ids),
+    decomposes it into strongly-connected components (iterative Tarjan),
+    drops the UNFAIR components — a component is fair iff every
+    (actor, action) that is fair-enabled at EVERY one of its states
+    labels some edge inside it; weak fairness at cycle granularity: an
+    action continuously enabled along a loop must eventually be taken ON
+    the loop, so a cycle that merely starves a ready worker is a
+    scheduling artifact, not a livelock — and reports the first fair
+    component on which an obligation never discharges, rendered as a
+    stem reaching the cycle plus the repeating cycle itself."""
+    cfg.validate()
+    model = FleetModel(cfg)
+    K = cfg.keys_per_partition
+    start = time.perf_counter()
+
+    def budget(reason: str, n_states: int, n_trans: int, n_sccs: int = 0):
+        return LivenessResult(
+            False, None, n_states, n_trans, n_sccs,
+            time.perf_counter() - start, budget_exhausted=True,
+            budget_reason=reason)
+
+    # -- phase 1: the reachable graph, edges kept this time ---------------
+    init = _canonical(model.initial(), cfg)
+    adj: Dict[object, List[Tuple[Tuple[str, str], object]]] = {init: []}
+    parents: Dict[object, Tuple[object, Step]] = {}
+    depth: Dict[object, int] = {init: 0}
+    labels: Dict[Tuple[str, str], Tuple[str, str]] = {}
+    transitions = 0
+    frontier = [init]
+    while frontier:
+        nxt_frontier = []
+        for state in frontier:
+            out = adj[state]
+            for step, succ, _violation in model.successors(state):
+                # Liveness ignores the safety oracles: a violating edge
+                # is still an edge of the graph (``check`` owns the
+                # safety verdict).
+                transitions += 1
+                canon = _canonical(succ, cfg)
+                label = labels.setdefault((step.actor, step.action),
+                                          (step.actor, step.action))
+                out.append((label, canon))
+                if canon not in adj:
+                    adj[canon] = []
+                    parents[canon] = (state, step)
+                    depth[canon] = depth[state] + 1
+                    nxt_frontier.append(canon)
+                    if len(adj) > cfg.max_states:
+                        return budget(
+                            f"state budget exceeded ({cfg.max_states})",
+                            len(adj), transitions)
+            if time.perf_counter() - start > cfg.max_seconds:
+                return budget(
+                    f"wall budget exceeded ({cfg.max_seconds}s)",
+                    len(adj), transitions)
+        frontier = nxt_frontier
+
+    # -- phase 2: SCC decomposition (iterative Tarjan) --------------------
+    index: Dict[object, int] = {}
+    low: Dict[object, int] = {}
+    on_stack = set()
+    stack: List[object] = []
+    sccs: List[List[object]] = []
+    order = 0
+    for root in adj:
+        if root in index:
+            continue
+        call = [(root, iter(adj[root]))]
+        index[root] = low[root] = order
+        order += 1
+        stack.append(root)
+        on_stack.add(root)
+        while call:
+            node, it = call[-1]
+            pushed = False
+            for _label, succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = order
+                    order += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    call.append((succ, iter(adj[succ])))
+                    pushed = True
+                    break
+                if succ in on_stack and index[succ] < low[node]:
+                    low[node] = index[succ]
+            if pushed:
+                continue
+            call.pop()
+            if call and low[node] < low[call[-1][0]]:
+                low[call[-1][0]] = low[node]
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    s = stack.pop()
+                    on_stack.discard(s)
+                    comp.append(s)
+                    if s == node:
+                        break
+                sccs.append(comp)
+        if time.perf_counter() - start > cfg.max_seconds:
+            return budget(f"wall budget exceeded ({cfg.max_seconds}s)",
+                          len(adj), transitions, len(sccs))
+
+    # -- phase 3: fairness filter -----------------------------------------
+    fair_comps = []
+    for comp in sccs:
+        if len(comp) == 1 and all(v != comp[0] for _l, v in adj[comp[0]]):
+            continue              # trivial SCC, no self-loop: no cycle
+        compset = frozenset(comp)
+        edge_labels = set()
+        for u in comp:
+            for lab, v in adj[u]:
+                if v in compset:
+                    edge_labels.add(lab)
+        required = None
+        for s in comp:
+            enabled = {lab for lab, v in adj[s] if _fair_label(lab, s)}
+            required = enabled if required is None \
+                else required & enabled
+            if not required:
+                break
+        if required and not required <= edge_labels:
+            continue              # a continuously-enabled fair action is
+                                  # starved only by scheduling: unfair
+        fair_comps.append((comp, compset, edge_labels))
+
+    # -- phase 4: the obligations -----------------------------------------
+    lasso = None
+    for name, pending, flagged, meaning in _EVENTUALLY_DEFS:
+        if lasso is not None:
+            break
+        for comp, compset, edge_labels in fair_comps:
+            flagged_hit = sorted(
+                {a for _actor, a in edge_labels if a in flagged})
+            if not flagged_hit and not all(pending(s, K) for s in comp):
+                continue
+            entry = comp[0]
+            for s in comp:
+                if depth[s] < depth[entry]:
+                    entry = s
+            stem: List[Step] = []
+            cur = entry
+            while cur in parents:
+                cur, step = parents[cur]
+                stem.append(step)
+            stem.reverse()
+            cycle = _cycle_steps(model, cfg, adj, compset, entry,
+                                 frozenset(flagged_hit))
+            if flagged_hit:
+                detail = (
+                    f"{meaning}: the fair cycle performs "
+                    f"{', '.join(flagged_hit)} on every lap, so under "
+                    f"weak fairness the action recurs forever instead of "
+                    f"happening finitely often and settling")
+            else:
+                detail = (
+                    f"{meaning}: the obligation is pending at every "
+                    f"state of the cycle, every fair action that is "
+                    f"continuously enabled is taken ON the cycle, and "
+                    f"none of them discharges it — a livelock no "
+                    f"fairness assumption excuses")
+            lasso = Lasso(name, detail, tuple(stem), tuple(cycle))
+            break
+
+    return LivenessResult(lasso is None, lasso, len(adj), transitions,
+                          len(sccs), time.perf_counter() - start)
+
+
+def _cycle_steps(model, cfg, adj, compset, entry,
+                 flagged: FrozenSet[str]) -> List[Step]:
+    """The witness cycle: a shortest closed walk entry -> entry inside
+    the component, routed through a flagged edge when the violation is
+    action-recurrence. Steps are regenerated from the model so the
+    rendered trace carries full details."""
+    def bfs(srcs, reverse=False):
+        """dist/prev maps from the (possibly reversed) edge relation."""
+        if reverse:
+            radj: Dict[object, List[Tuple[Tuple[str, str], object]]] = {}
+            for u in compset:
+                for lab, v in adj[u]:
+                    if v in compset:
+                        radj.setdefault(v, []).append((lab, u))
+            rel = lambda s: radj.get(s, ())
+        else:
+            rel = lambda s: [(lab, v) for lab, v in adj[s]
+                             if v in compset]
+        dist = {s: 0 for s in srcs}
+        prev: Dict[object, Tuple[object, Tuple[str, str]]] = {}
+        queue = list(srcs)
+        while queue:
+            nxt_queue = []
+            for u in queue:
+                for lab, v in rel(u):
+                    if v in dist:
+                        continue
+                    dist[v] = dist[u] + 1
+                    prev[v] = (u, lab)
+                    nxt_queue.append(v)
+            queue = nxt_queue
+        return dist, prev
+
+    def walk_from(prev, node, src):
+        """[(u, label, v)] edges along prev-pointers src -> node."""
+        edges = []
+        while node != src:
+            u, lab = prev[node]
+            edges.append((u, lab, node))
+            node = u
+        edges.reverse()
+        return edges
+
+    fwd_dist, fwd_prev = bfs([entry])
+    rev_dist, rev_prev = bfs([entry], reverse=True)
+    edges: List[Tuple[object, Tuple[str, str], object]] = []
+    if flagged:
+        # Route through the flagged edge minimizing the total lap.
+        best = None
+        for u in compset:
+            if u not in fwd_dist:
+                continue
+            for lab, v in adj[u]:
+                if v not in compset or lab[1] not in flagged \
+                        or v not in rev_dist:
+                    continue
+                cost = fwd_dist[u] + 1 + rev_dist[v]
+                if best is None or cost < best[0]:
+                    best = (cost, u, lab, v)
+        _cost, u, lab, v = best
+        edges = walk_from(fwd_prev, u, entry) + [(u, lab, v)]
+        # rev_prev walks the REVERSED relation: prev[x] = (y, lab) means
+        # a real edge x --lab--> y; follow it v -> entry.
+        node = v
+        while node != entry:
+            y, lab2 = rev_prev[node]
+            edges.append((node, lab2, y))
+            node = y
+    else:
+        # Shortest closed walk: the first edge back to entry found in
+        # BFS order closes it.
+        best = None
+        for u in sorted(fwd_dist, key=fwd_dist.get):
+            for lab, v in adj[u]:
+                if v == entry and v in compset:
+                    best = (u, lab)
+                    break
+            if best:
+                break
+        u, lab = best
+        edges = walk_from(fwd_prev, u, entry) + [(u, lab, entry)]
+    return [_step_for(model, cfg, u, lab, v) for u, lab, v in edges]
 
 
 def spec_transition_names() -> FrozenSet[str]:

@@ -76,6 +76,10 @@ RULES: Dict[str, tuple] = {
     "FC504": ("protocol-model-violation",
               "the fleet protocol model checker found an invariant-"
               "violating interleaving (counterexample trace attached)"),
+    "FC505": ("trace-nonconformance",
+              "a recorded control-lane run is not a valid word of the "
+              "declared role state machines (unknown transition, "
+              "out-of-order step, seq gap, or stale-term record)"),
 }
 
 

@@ -68,6 +68,7 @@ CONTROL_KINDS = WORKER_OPS + CANDIDATE_KINDS + ("snapshot",)
 
 _COMPACT_AT = 4096      # in-memory log bound before compaction
 _OUTBOX_KEEP = 1024     # uncovered-op retry buffer bound
+_JOURNAL_KEEP = 16384   # conformance journal bound (never compacted)
 
 
 @dataclass(frozen=True)
@@ -128,6 +129,14 @@ class ControlBus:
         self._next_seq: Dict[str, int] = {}     # sender -> last assigned
         self._wire: List[ControlRecord] = []    # in-memory transport
         self._log: List[ControlRecord] = []     # accepted, compacted
+        # Conformance journal: every accepted record in delivery order,
+        # NEVER compacted (compaction keeps what a successor needs; the
+        # journal keeps what an auditor needs — `flightcheck conform`
+        # replays it against the FLEET_PROTOCOLS role machines). Bounded;
+        # overflow drops the oldest and counts, so a long-lived fleet
+        # degrades to a suffix audit instead of unbounded memory.
+        self._journal: List[ControlRecord] = []
+        self.journal_dropped = 0
         self._seen: Dict[str, Set[int]] = {}    # sender -> delivered seqs
         self._high: Dict[str, int] = {}         # sender -> highest delivered
         self.published = 0
@@ -218,6 +227,11 @@ class ControlBus:
                 self.delivered += 1
                 accepted.append(rec)
                 self._log.append(rec)
+                self._journal.append(rec)
+            if len(self._journal) > _JOURNAL_KEEP:
+                drop = len(self._journal) - _JOURNAL_KEEP
+                del self._journal[:drop]
+                self.journal_dropped += drop
             if len(self._log) > _COMPACT_AT:
                 self._compact_locked()
             return accepted
@@ -264,6 +278,16 @@ class ControlBus:
         with self._lock:
             return self._lamport
 
+    def export_trace(self) -> List[dict]:
+        """The conformance journal as JSON-ready dicts, delivery order.
+
+        This is the `flightcheck conform` seam: game days persist it in
+        their evidence (``succession.trace``) and the conformance checker
+        replays it against the declared role machines
+        (analysis/entrypoints.py FLEET_PROTOCOLS)."""
+        with self._lock:
+            return [r.as_dict() for r in self._journal]
+
     def lost(self) -> int:
         """Records definitely lost below each sender's delivery high
         watermark (in-flight records above it don't count yet)."""
@@ -285,6 +309,8 @@ class ControlBus:
                 "stale_snapshots_rejected": self.stale_snapshots_rejected,
                 "log": len(self._log),
                 "compactions": self.compactions,
+                "journal": len(self._journal),
+                "journal_dropped": self.journal_dropped,
             }
 
     def _compact_locked(self) -> None:
@@ -577,6 +603,11 @@ class SuccessionCoordinator:
     # ------------------------------------------------------------------
 
     def tick(self) -> dict:
+        # Drain the wire every tick: delivery accounting (lost/reordered)
+        # and the conformance journal must not wait for an election's
+        # poll — an incumbent that never dies still records an auditable
+        # run (`flightcheck conform`).
+        self.control.poll()
         with self._lock:
             coordinator = self.coordinator
             leader = self.leader_id
@@ -836,4 +867,7 @@ class SuccessionCoordinator:
             "elections": elections,
             "handoffs": handoffs,
             "control": self.control.stats(),
+            # The full conformance journal — `flightcheck conform` replays
+            # this against the FLEET_PROTOCOLS role machines.
+            "trace": self.control.export_trace(),
         }

@@ -83,6 +83,15 @@ class FleetCoordinator:
         self._members: Dict[str, dict] = {}   # wid -> {renewed, joined}
         self._target: Dict[str, Set[tuple]] = {}
         self._pending: Dict[tuple, str] = {}  # pair -> live holder draining it
+        # Last GRANTED set actually issued to each worker (join/sync/ack
+        # response). A re-deal may only create a NEW barrier hold for a
+        # pair its previous owner was really issued — a pair that merely
+        # transited a member's target between two of its syncs leaves no
+        # read-ahead to drain, and a phantom hold for it would never be
+        # acked (the member's lease never changes, so it never drains),
+        # withholding the pair from its new owner FOREVER (flightcheck
+        # check_liveness lasso, `every_row_eventually_committed`).
+        self._issued: Dict[str, Set[tuple]] = {}
         # Members the autoscaler asked to leave (scale-in): excluded from
         # every re-deal but still live barrier HOLDERS until they drain,
         # commit, and ack — release rides the EXISTING revoke barrier.
@@ -162,6 +171,7 @@ class FleetCoordinator:
         its partitions reassign immediately — no barrier, no ttl wait."""
         with self._lock:
             self._released.discard(worker_id)
+            self._issued.pop(worker_id, None)
             if worker_id not in self._members:
                 return
             del self._members[worker_id]
@@ -242,6 +252,13 @@ class FleetCoordinator:
                 # released member OUT of its re-deals, or failover would
                 # silently cancel the voluntary leave mid-drain.
                 "released": sorted(self._released),
+                # Issued leases travel too: without them a successor's
+                # first re-deal could not tell a pair with real
+                # read-ahead behind it from one that merely transited a
+                # target — it would either drop a needed hold (barrier
+                # breach) or mint a phantom one (livelock).
+                "issued": {w: sorted([t, p] for (t, p) in pairs)
+                           for w, pairs in self._issued.items()},
                 "rebalances": self.rebalances,
                 "expirations": self.expirations,
                 "ticks": self._ticks,
@@ -271,6 +288,17 @@ class FleetCoordinator:
                 if holder in self._members}
             self._released = {w for w in (state.get("released") or [])
                               if w in self._members}
+            # Snapshots from before the issued-lease field default to
+            # "everything targeted was issued": conservative — it can
+            # mint a phantom hold, never drop a real one.
+            issued = state.get("issued")
+            if issued is None:
+                self._issued = {w: set(pairs)
+                                for w, pairs in self._target.items()}
+            else:
+                self._issued = {w: {(t, p) for t, p in pairs}
+                                for w, pairs in issued.items()
+                                if w in self._members}
             self._generation = int(state.get("generation") or 0)
             self.rebalances = int(state.get("rebalances") or 0)
             self.expirations = int(state.get("expirations") or 0)
@@ -288,6 +316,9 @@ class FleetCoordinator:
         for w in stale:
             del self._members[w]
             self._released.discard(w)
+            # A dead incarnation's issued lease must not vouch for its
+            # successor: a rejoin starts with nothing issued.
+            self._issued.pop(w, None)
             # Expiry IS the drain barrier for a dead worker: release its
             # holds — the committed offsets are the resume point.
             for pair in [p for p, h in self._pending.items() if h == w]:
@@ -344,14 +375,32 @@ class FleetCoordinator:
         # nobody to give to yet (every dealable member released mid-scale-
         # in) keeps its live holder's hold — the hold protects the pair's
         # NEXT owner, whoever that turns out to be.
+        # A NEW hold (no existing one) additionally requires the previous
+        # owner to have been ISSUED the pair: only a granted lease can
+        # carry read-ahead worth draining. Without this gate, a pair that
+        # bounced through a member's target while it never synced (expired
+        # peer's pair parked on it, then re-dealt away) acquires a hold
+        # its "holder" can never ack — found as a
+        # `every_row_eventually_committed` lasso by flightcheck's
+        # liveness checker (regression: tests/test_fleet.py
+        # test_coordinator_no_phantom_hold_for_unissued_pair).
         new_owner = {pair: w for w, pairs in self._target.items()
                      for pair in pairs}
         self._pending = {
             pair: holder
             for pair in self._all_pairs
-            for holder in (self._pending.get(pair, old.get(pair)),)
+            for holder in (self._pending.get(pair)
+                           if pair in self._pending
+                           else self._issued_holder_locked(pair, old),)
             if holder is not None and holder != new_owner.get(pair)
             and holder in self._members}
+
+    def _issued_holder_locked(self, pair, old) -> Optional[str]:
+        holder = old.get(pair)
+        if holder is not None \
+                and pair not in self._issued.get(holder, ()):
+            return None
+        return holder
 
     def _lease_locked(self, worker_id: str) -> Lease:
         target = self._target.get(worker_id, set())
@@ -359,6 +408,7 @@ class FleetCoordinator:
             p for p in target
             if self._pending.get(p) not in (None, worker_id)))
         granted = tuple(sorted(p for p in target if p not in withheld))
+        self._issued[worker_id] = set(granted)
         return Lease(worker_id, self._generation, granted, withheld,
                      released=worker_id in self._released)
 

@@ -143,6 +143,14 @@ def _bench_env(tmp_path):
         "BENCH_MSGS": "400", "BENCH_RUNS": "2", "BENCH_BATCH": "128",
         "BENCH_DEPTH": "2", "BENCH_TREES": "0", "BENCH_LOAD_SWEEP": "0",
         "BENCH_TRAIN": "0", "BENCH_FEAT_ROWS": "512", "BENCH_FEAT_REPS": "1",
+        # Sections with their own dedicated suites (game days, autoscale,
+        # learn loop, sentinel, fleet, int8, flightcheck) stay off: this
+        # file pins the HARNESS contract — merge/flush/reprint — not the
+        # legs, and each default-on leg added minutes to what is meant to
+        # be a trimmed run.
+        "BENCH_FLEET": "0", "BENCH_SCENARIOS": "0", "BENCH_AUTOSCALE": "0",
+        "BENCH_LEARN": "0", "BENCH_ALERTS": "0", "BENCH_SLOTSERVE": "0",
+        "BENCH_INT8": "0", "BENCH_FLIGHTCHECK": "0",
         "BENCH_PARTIAL": str(tmp_path / "partial.json"),
     })
     return env

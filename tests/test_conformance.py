@@ -1,0 +1,360 @@
+"""Trace conformance (`flightcheck conform`, FC505) — ISSUE 20.
+
+Pins, in order:
+
+1. the analysis-side control vocabulary is in LOCKSTEP with
+   fleet/control.py (conformance.py mirrors it to stay import-light);
+2. the role NFAs replay honest journals silently and reject each
+   doctored-log class the issue names — dropped ack (seq-gap),
+   reordered fence (stale-term), phantom commit (unknown-kind) — plus
+   out-of-order protocol steps and handoff-fence regressions, always
+   citing the FIRST offending record;
+3. transport budgets: the recorded ``lost``/``reordered`` counters are
+   tolerated exactly; one violation beyond them is a finding;
+4. a REAL run conforms end to end: an in-process lossy-lane succession
+   journal replays clean, and FC505 findings ride valid SARIF;
+5. the ``conform`` CLI exit codes: 0 conformant, 1 violations,
+   2 unreadable/shape errors.
+"""
+
+import json
+
+import pytest
+
+from fraud_detection_tpu.analysis import conformance, sarif
+from fraud_detection_tpu.fleet import control as fleet_control
+
+
+# ---------------------------------------------------------------------------
+# helpers — synthetic journals in ControlRecord.as_dict() shape
+# ---------------------------------------------------------------------------
+
+def _rec(kind, sender, seq, term=1, lamport=None, payload=None):
+    return {"kind": kind, "sender": sender, "seq": seq, "term": term,
+            "lamport": lamport if lamport is not None else seq,
+            "payload": payload or {}}
+
+
+def _drain_cycle(sender="w0"):
+    """A worker's full honest life on the bus: join, sync into a drain,
+    ack out of it, leave."""
+    return [
+        _rec("join", sender, 1),
+        _rec("sync", sender, 2),
+        _rec("ack", sender, 3),
+        _rec("leave", sender, 4),
+    ]
+
+
+def _succession():
+    """Incumbent c0 leads then hands off to c1 via a claim at term 2."""
+    return [
+        _rec("beacon", "c0", 1, term=1),
+        _rec("snapshot", "c0", 2, term=1),
+        _rec("claim", "c1", 1, term=2),
+        _rec("beacon", "c1", 2, term=2),
+    ]
+
+
+def _rules(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# 1. vocabulary lockstep + NFA construction
+# ---------------------------------------------------------------------------
+
+def test_control_vocabulary_lockstep_with_fleet():
+    """conformance.py mirrors the bus vocabulary instead of importing it
+    (analysis/ stays import-light); this pin is what makes that safe."""
+    assert conformance.WORKER_OPS == fleet_control.WORKER_OPS
+    assert conformance.CANDIDATE_KINDS == fleet_control.CANDIDATE_KINDS
+    assert conformance.CONTROL_KINDS == fleet_control.CONTROL_KINDS
+
+
+def test_worker_nfa_shapes():
+    nfa = conformance._worker_nfa()
+    assert nfa.states == {"init"}
+    assert nfa.step("join") and "running" in nfa.states
+    # sync may begin a drain: the subset tracks both possibilities
+    assert nfa.step("sync")
+    assert {"running", "draining"} <= nfa.states
+    assert nfa.step("ack") and nfa.step("leave")
+
+
+def test_candidate_nfa_bootstrap_leads_without_claim():
+    """The bootstrap candidate (c0) never publishes a claim — it leads
+    from construction, so `beacon` must be explicable immediately."""
+    nfa = conformance._candidate_nfa()
+    assert {"standby", "leading"} <= nfa.states
+    assert nfa.step("beacon")
+    assert nfa.step("abdicate")
+
+
+# ---------------------------------------------------------------------------
+# 2. honest journals replay clean; doctored classes each die
+# ---------------------------------------------------------------------------
+
+def test_clean_worker_and_succession_journals_conform():
+    assert conformance.check_records(_drain_cycle()) == []
+    assert conformance.check_records(_succession()) == []
+
+
+def test_doctored_dropped_ack_is_a_seq_gap():
+    """ISSUE acceptance: delete the ack from an honest drain cycle — the
+    checker must reject the log citing the first non-conforming record."""
+    recs = _drain_cycle()
+    del recs[2]  # the ack (seq 3)
+    violations = conformance.check_records(recs)
+    assert "seq-gap" in _rules(violations)
+    first = violations[0]
+    assert first.index == 2  # the leave, whose arrival opened the hole
+    assert "seq 3 was never delivered" in first.detail
+    assert "2 -> 4" in first.detail
+    assert "record 2" in first.render()
+
+
+def test_doctored_reordered_fence_is_stale_term():
+    """Move the new leader's claim BEFORE the old leader's last publishes:
+    c0's term-1 records now trail an observed term 2 — zombie writes."""
+    recs = _succession()
+    recs.insert(0, recs.pop(2))  # claim(term=2) first
+    violations = conformance.check_records(recs)
+    assert _rules(violations).count("stale-term") == 2
+    assert "zombie" in violations[0].detail
+
+
+def test_doctored_phantom_commit_is_unknown_kind():
+    recs = _drain_cycle()
+    recs.insert(2, _rec("commit", "w0", 99))
+    violations = conformance.check_records(recs)
+    assert [v.rule for v in violations][0] == "unknown-kind"
+    assert violations[0].index == 2
+    assert "phantom" in violations[0].detail
+
+
+def test_out_of_order_protocol_step_is_unknown_transition():
+    """An ack from a worker that never drained: sequence discipline is
+    fine, but no Worker transition explains it from {init}."""
+    violations = conformance.check_records([_rec("ack", "w0", 1)])
+    assert _rules(violations) == ["unknown-transition"]
+    assert "'ack'" in violations[0].detail
+    assert "['init']" in violations[0].detail
+
+
+def test_role_confusion_and_duplicate_delivery():
+    recs = [_rec("join", "w0", 1), _rec("beacon", "w0", 2),
+            _rec("sync", "w0", 2)]
+    violations = conformance.check_records(recs)
+    assert _rules(violations) == ["role-confusion", "duplicate-delivery"]
+
+
+def test_election_fence_rejects_non_advancing_claim():
+    recs = _succession() + [_rec("claim", "c2", 1, term=2)]
+    violations = conformance.check_records(recs)
+    assert _rules(violations) == ["election-fence"]
+    assert "strictly advance" in violations[0].detail
+
+
+def test_handoff_fence_requires_increasing_terms():
+    violations = conformance.check_records(
+        [], handoffs=[{"to": "c1", "term": 2}, {"to": "c2", "term": 2}])
+    assert _rules(violations) == ["handoff-fence"]
+    assert violations[0].index == -1
+    assert violations[0].render().startswith("handoff log")
+
+
+def test_malformed_record_is_cited_not_crashed():
+    violations = conformance.check_records(
+        ["not-a-dict", _rec("join", "w0", None)])
+    assert _rules(violations) == ["malformed-record", "malformed-record"]
+
+
+# ---------------------------------------------------------------------------
+# 3. transport budgets: recorded casualties tolerated, one more is not
+# ---------------------------------------------------------------------------
+
+def test_loss_budget_tolerates_exactly_the_recorded_casualties():
+    recs = _drain_cycle()
+    del recs[2]  # one record missing
+    assert conformance.check_records(recs, lost=1) == []
+    # a second hole exceeds the budget
+    del recs[1]
+    violations = conformance.check_records(recs, lost=1)
+    assert "seq-gap" in _rules(violations)
+
+
+def test_reorder_budget_tolerates_exactly_the_recorded_inversions():
+    """One transport inversion shows up as a hole that a later record
+    fills PLUS an inversion — it must cost one reorder, zero losses, and
+    never cascade into the role machine (which replays in the sender's
+    own seq order)."""
+    recs = _drain_cycle()
+    recs[1], recs[2] = recs[2], recs[1]  # one inversion
+    assert conformance.check_records(recs, reordered=1) == []
+    violations = conformance.check_records(recs, reordered=0)
+    assert _rules(violations) == ["out-of-order"]
+    assert violations[0].index == 2  # the sync, arriving late
+
+
+# ---------------------------------------------------------------------------
+# 4. real journal end to end + extract_trace shapes + SARIF
+# ---------------------------------------------------------------------------
+
+def test_real_succession_journal_conforms():
+    """Drive an actual SuccessionCoordinator through worker traffic and a
+    graceful leader handoff; the journal its succession_report() exports
+    must replay clean under its own recorded transport budgets — the
+    conform gate can never flag an honest run."""
+    from fraud_detection_tpu.fleet.control import SuccessionCoordinator
+    from fraud_detection_tpu.stream.faults import CoordinatorKillSpec
+
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = _Clock()
+    kill = CoordinatorKillSpec(seed=1, kills=1, min_ticks=2, max_ticks=2,
+                               modes=("graceful",))
+    sc = SuccessionCoordinator(["in"], 2, candidates=2, role_ttl=5.0,
+                               kill=kill, clock=clock, wall=clock)
+    sc.join("w0")
+    sc.join("w1")
+    for _ in range(4):
+        clock.t += 0.05
+        sc.tick()
+    sc.step("c1")                       # successor claims the vacancy
+    sc.sync("w0")
+    sc.ack("w0")
+    sc.leave("w1")
+    report = sc.succession_report()
+    records, ctx = conformance.extract_trace(report)
+    assert len(records) >= 6, "the journal recorded almost nothing"
+    kinds = {r["kind"] for r in records}
+    assert "claim" in kinds and kinds & set(conformance.WORKER_OPS)
+    violations = conformance.check_records(
+        records, handoffs=ctx.get("handoffs"),
+        lost=ctx["lost"], reordered=ctx["reordered"])
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+def test_extract_trace_shapes():
+    recs = _drain_cycle()
+    succ = {"trace": recs, "control": {"lost": 3, "reordered": 1},
+            "handoffs": [{"to": "c1", "term": 2}]}
+    for shape in (recs, {"records": recs}, succ,
+                  {"evidence": {"succession": succ}},
+                  {"succession": succ}):
+        got, ctx = conformance.extract_trace(shape)
+        assert got == recs
+    assert ctx["lost"] == 3 and ctx["reordered"] == 1
+    assert ctx["handoffs"] == [{"to": "c1", "term": 2}]
+    with pytest.raises(ValueError):
+        conformance.extract_trace({"nothing": "here"})
+
+
+def test_summarize_and_findings_ride_sarif_as_fc505():
+    recs = _drain_cycle()
+    recs.insert(2, _rec("commit", "w0", 99))
+    violations = conformance.check_records(recs)
+    summary = conformance.summarize(violations, len(recs))
+    assert summary["violation_count"] == len(violations)
+    assert summary["rules"].get("unknown-kind") == 1
+    assert summary["first"].startswith("record 2")
+    findings = conformance.to_findings(violations)
+    assert all(f.rule == "FC505" for f in findings)
+    assert findings[0].path == "fleet/control.py"
+    doc = sarif.build(findings, suppressed=0, n_files=0)
+    assert sarif.validate(doc) == []
+    assert doc["runs"][0]["results"][0]["ruleId"] == "FC505"
+
+
+def test_render_report_verdict_lines():
+    clean = conformance.render_report([], 4, "x.json")
+    assert "CONFORMANT" in clean
+    violations = conformance.check_records([_rec("ack", "w0", 1)])
+    bad = conformance.render_report(violations, 1, "x.json")
+    assert "NONCONFORMANT: 1 violation(s)" in bad
+    assert "first at record 0" in bad
+
+
+# ---------------------------------------------------------------------------
+# 5. the conform CLI
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, obj):
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_cli_conform_clean_and_json(tmp_path, capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    path = _write(tmp_path, {"records": _drain_cycle()})
+    assert main(["conform", "--input", path]) == 0
+    assert "CONFORMANT" in capsys.readouterr().out
+
+    assert main(["conform", "--input", path, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert payload["summary"]["violation_count"] == 0
+
+
+def test_cli_conform_rejects_doctored_log(tmp_path, capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    recs = _drain_cycle()
+    del recs[2]
+    sarif_file = tmp_path / "conform.sarif"
+    path = _write(tmp_path, recs)
+    assert main(["conform", "--input", path,
+                 "--sarif", str(sarif_file)]) == 1
+    out = capsys.readouterr().out
+    assert "NONCONFORMANT" in out and "seq-gap" in out
+    doc = json.loads(sarif_file.read_text())
+    assert sarif.validate(doc) == []
+    assert doc["runs"][0]["results"][0]["ruleId"] == "FC505"
+
+
+def test_cli_conform_unreadable_inputs_exit_2(tmp_path, capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    assert main(["conform", "--input",
+                 str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+    bad_shape = _write(tmp_path, {"nothing": "here"})
+    assert main(["conform", "--input", bad_shape]) == 2
+    assert "no control-lane trace" in capsys.readouterr().err
+
+
+def test_bench_trend_carries_flightcheck_fields(tmp_path):
+    """The bench trend record diffs liveness wall/states and the
+    conformance replay wall round over round (bench.py flightcheck
+    section, ISSUE 20)."""
+    import bench
+
+    line = {"metric": "m", "value": 1.0,
+            "flightcheck": {"liveness_ok": True, "liveness_wall_s": 6.3,
+                            "liveness_states": 120_000,
+                            "liveness_transitions": 400_000,
+                            "liveness_sccs": 90_000,
+                            "liveness_checked": 4,
+                            "conform_wall_s": 0.02,
+                            "conform_records": 2000,
+                            "conform_records_per_s": 100_000,
+                            "conform_violations": 0}}
+    rec = bench.append_bench_trend(line, str(tmp_path / "t.json"), now=1.0)
+    fc = rec["flightcheck"]
+    assert fc["liveness_ok"] is True
+    assert fc["liveness_wall_s"] == 6.3
+    assert fc["liveness_states"] == 120_000
+    assert fc["liveness_sccs"] == 90_000
+    assert fc["conform_wall_s"] == 0.02
+    assert fc["conform_records"] == 2000
+    # an errored or absent section leaves the field null, not a crash
+    assert bench.append_bench_trend(
+        {"metric": "m", "value": 1.0, "flightcheck": {"error": "boom"}},
+        str(tmp_path / "t.json"), now=2.0)["flightcheck"] is None

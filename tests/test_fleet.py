@@ -181,6 +181,36 @@ def test_coordinator_barrier_survives_consecutive_rebalances():
                     | set(c.sync("w0").partitions))
 
 
+def test_coordinator_no_phantom_hold_for_unissued_pair():
+    """Flightcheck LIVENESS true positive (ISSUE 20): a re-deal used to
+    open a revoke barrier for any pair leaving a live member's TARGET —
+    including a pair that merely transited the target between two of the
+    member's syncs (an expired peer's pair parked on it, then re-dealt
+    away before it ever synced). The "holder" was never issued the pair,
+    has no read-ahead to drain, and its own lease never shrinks — so it
+    never acks, and the hold withholds the pair from its new owner
+    forever (`every_row_eventually_committed` lasso). A NEW hold must
+    require the previous owner to have been ISSUED the pair."""
+    clock = [0.0]
+    c = FleetCoordinator(["in"], 2, lease_ttl=1.0, clock=lambda: clock[0])
+    c.join("w0")                        # issued both pairs
+    c.join("w1")                        # ("in", 1) moves to w1, held by w0
+    c.ack("w0")                         # drain done: w0 issued ("in", 0)
+    c.sync("w1")                        # w1 issued ("in", 1)
+    clock[0] = 0.6
+    c.sync("w0")                        # w0 renews; w1 goes silent
+    clock[0] = 1.3                      # w1 stale (1.3s), w0 fresh (0.7s)
+    c.tick()                            # w1 expires: ("in", 1) parks on
+    assert c.expirations == 1           # w0's TARGET — but w0 never syncs,
+    assert not c._pending               # so it is never ISSUED the pair
+    l2 = c.join("w2")                   # re-deal hands ("in", 1) to w2
+    assert ("in", 1) in l2.partitions, "deal shape changed under the test"
+    assert not l2.pending, (
+        f"phantom hold for a pair its holder was never issued: "
+        f"{l2.pending}")
+    assert not c._pending
+
+
 def test_coordinator_fence_blocks_withheld_target():
     """Second flightcheck model-checker true positive (ISSUE 9): the fence
     used to pass any pair in the worker's TARGET set — including pairs

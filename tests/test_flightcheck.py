@@ -613,6 +613,74 @@ def test_cache_salt_stable_across_processes():
     assert a.stdout.strip() == b.stdout.strip()
 
 
+def test_cache_salt_folds_in_checker_and_spec_sources(tmp_path, monkeypatch):
+    """ISSUE 20 cache audit: the salt must cover the model checker, the
+    trace-conformance module, and the protocol-spec registry — editing an
+    eventually-invariant or a role machine changes what pragma context
+    and FC5xx findings mean, so it must invalidate every cache entry."""
+    from fraud_detection_tpu.analysis import cache as cache_mod
+    from fraud_detection_tpu.analysis import checker, conformance, entrypoints
+
+    before = cache_mod._registry_salt()
+    for mod in (checker, conformance, entrypoints):
+        short = mod.__name__.rsplit(".", 1)[-1]
+        variant = tmp_path / f"{short}.py"
+        variant.write_text(open(mod.__file__).read() + "\n# edited\n")
+        monkeypatch.setattr(mod, "__file__", str(variant))
+        assert cache_mod._registry_salt() != before, (
+            f"editing {short}.py did not change the cache salt")
+        monkeypatch.undo()
+        assert cache_mod._registry_salt() == before
+    # the parsed FLEET_PROTOCOLS registry is folded in on its own too
+    monkeypatch.setattr(entrypoints, "FLEET_PROTOCOLS", ())
+    assert cache_mod._registry_salt() != before
+
+
+#: pragma audit (ISSUE 20): every suppression in the tree, pinned. A new
+#: pragma (or a deleted one) must show up here as a conscious edit, with
+#: the docs' census (docs/static_analysis.md "Pragmas") kept in step.
+_EXPECTED_PRAGMAS = {
+    ("fleet/worker.py", "FC102"): 1,          # lock-free stop latch
+    ("stream/engine.py", "FC102"): 2,         # lock-free stop latches
+    ("stream/annotations.py", "FC102"): 5,    # worker-only counters
+    ("ops/histogram.py", "FC201"): 1,         # one-shot capability probe
+    ("models/pipeline.py", "FC201"): 1,       # one-shot donation probe
+    ("models/train_llm.py", "FC201"): 1,      # once-per-run opt-state init
+}
+
+
+def test_pragma_audit_every_suppression_is_pinned_and_justified():
+    """Counts the tree's ``# flightcheck: ignore[...]`` pragmas with the
+    analyzer's own parser and pins them per (file, rule); every pragma
+    line must carry a justification string after the bracket."""
+    found: dict = {}
+    for sf in load_package(PKG):
+        lines = sf.text.splitlines()
+        for lineno, rules in sorted(sf.ignores.items()):
+            line = lines[lineno - 1]
+            tail = line.split("]", 1)[1]
+            assert tail.strip(" -—#"), (
+                f"{sf.relpath}:{lineno}: pragma without a justification "
+                f"string: {line.strip()!r}")
+            for rule in rules:
+                key = (sf.relpath, rule)
+                found[key] = found.get(key, 0) + 1
+    assert found == _EXPECTED_PRAGMAS, (
+        "pragma census drifted — update _EXPECTED_PRAGMAS AND the count "
+        "in docs/static_analysis.md consciously")
+    total = sum(_EXPECTED_PRAGMAS.values())
+    doc = open(os.path.join(REPO, "docs", "static_analysis.md")).read()
+    assert f"currently carries {_spell(total)}" in doc, (
+        f"docs/static_analysis.md pragma census out of step with the "
+        f"tree's {total}")
+
+
+def _spell(n: int) -> str:
+    words = {7: "seven", 8: "eight", 9: "nine", 10: "ten", 11: "eleven",
+             12: "twelve"}
+    return words.get(n, str(n))
+
+
 def test_analyzer_runtime_budget():
     """Pinned analyzer-runtime budget: the whole-program pass must stay a
     sub-minute CI gate, not a soak. 30s is ~10x the measured cost on a

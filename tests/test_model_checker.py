@@ -32,9 +32,16 @@ from fraud_detection_tpu.analysis import model, sarif
 from fraud_detection_tpu.analysis.checker import (ACTION_IMPLEMENTS,
                                                   AUTOSCALE_ACTIONS,
                                                   AUTOSCALE_CONFIG,
-                                                  INVARIANTS, MUTATIONS,
+                                                  EVENTUALLY_INVARIANTS,
+                                                  INVARIANTS,
+                                                  LIVELOCK_MUTATIONS,
+                                                  MUTATIONS,
+                                                  SAFETY_MUTATIONS,
                                                   SUCCESSION_ACTIONS,
-                                                  CheckConfig, check,
+                                                  SUCCESSION_CONFIG,
+                                                  CheckConfig, FleetModel,
+                                                  _canonical, check,
+                                                  check_liveness,
                                                   spec_transition_names)
 from fraud_detection_tpu.analysis.core import SourceFile, load_package
 from fraud_detection_tpu.analysis.entrypoints import (
@@ -132,7 +139,7 @@ _MUTATION_KW = {
 }
 
 
-@pytest.mark.parametrize("mutation", MUTATIONS)
+@pytest.mark.parametrize("mutation", SAFETY_MUTATIONS)
 def test_every_mutation_yields_counterexample(mutation):
     kw = _MUTATION_KW.get(mutation, {})
     cfg = CheckConfig(mutations=frozenset({mutation}), **kw)
@@ -143,6 +150,15 @@ def test_every_mutation_yields_counterexample(mutation):
     # the trace is replayable prose: every step has actor/action/detail
     for step in result.violation.trace:
         assert step.actor and step.action and step.detail
+
+
+def test_mutation_catalog_split_is_total():
+    """The safety/livelock split partitions MUTATIONS exactly (each
+    class is checked by its own engine: check vs check_liveness)."""
+    assert set(SAFETY_MUTATIONS) | set(LIVELOCK_MUTATIONS) == set(MUTATIONS)
+    assert not set(SAFETY_MUTATIONS) & set(LIVELOCK_MUTATIONS)
+    assert set(SAFETY_MUTATIONS) == set(_EXPECTED)
+    assert set(LIVELOCK_MUTATIONS) == set(_LIVELOCK_EXPECTED)
 
 
 def test_mutation_counterexamples_are_shortest_first():
@@ -188,6 +204,131 @@ def test_symmetry_reduction_preserves_the_verdict():
 
 
 # ---------------------------------------------------------------------------
+# 1b. liveness: lasso detection under weak fairness (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+#: livelock mutation -> the eventually-invariant its lasso must name.
+_LIVELOCK_EXPECTED = {
+    "election_ping_pong": "election_eventually_converges",
+    "zero_cooldown_flap": "autoscale_eventually_stabilizes",
+    "drain_requeues_revoke": "every_drain_eventually_acked",
+}
+
+#: per-mutation topology: ping-pong needs a contested role with a crash
+#: to vacate it; the flap needs a scale-in budget so a voluntary leave
+#: exists for the zero-cooldown relaunch to undo; the re-queued revoke
+#: reproduces in the default drain topology.
+_LIVELOCK_KW = {
+    "election_ping_pong": dict(workers=2, partitions=2,
+                               keys_per_partition=1, max_crashes=0,
+                               max_lapses=0, candidates=2,
+                               max_coord_crashes=1),
+    "zero_cooldown_flap": dict(workers=2, partitions=2,
+                               keys_per_partition=1, max_crashes=0,
+                               max_lapses=0, max_scale_ins=1),
+    "drain_requeues_revoke": {},
+}
+
+
+def test_liveness_clean_default_verifies():
+    """All four eventually-invariants hold on the default configuration:
+    no reachable weakly-fair cycle starves a row, a drain, an election,
+    or the autoscaler."""
+    result = check_liveness(CheckConfig())
+    assert result.ok, (result.budget_reason if result.budget_exhausted
+                       else traces.render_lasso(result.lasso))
+    assert not result.budget_exhausted
+    assert result.states > 10_000 and result.sccs > 0
+    assert result.checked == EVENTUALLY_INVARIANTS
+
+
+def test_liveness_autoscale_config_verifies():
+    result = check_liveness(CheckConfig(**AUTOSCALE_CONFIG))
+    assert result.ok, (result.budget_reason if result.budget_exhausted
+                       else traces.render_lasso(result.lasso))
+    assert not result.budget_exhausted
+
+
+@pytest.mark.slow
+def test_liveness_succession_config_verifies():
+    """The headline succession configuration (W=3/P=3, 3 candidates on a
+    lossy control lane) is livelock-free — ~40 s of exploration, so the
+    CI liveness-smoke step carries this gate for tier-1."""
+    result = check_liveness(CheckConfig(**SUCCESSION_CONFIG))
+    assert result.ok, (result.budget_reason if result.budget_exhausted
+                       else traces.render_lasso(result.lasso))
+    assert not result.budget_exhausted
+
+
+@pytest.mark.parametrize("mutation", LIVELOCK_MUTATIONS)
+def test_every_livelock_mutation_yields_lasso(mutation):
+    """Each seeded livelock MUST die with a stem+cycle lasso naming its
+    own invariant — the liveness engine checking itself."""
+    cfg = CheckConfig(mutations=frozenset({mutation}),
+                      **_LIVELOCK_KW[mutation])
+    result = check_liveness(cfg)
+    assert result.lasso is not None, f"{mutation}: no lasso"
+    assert result.lasso.invariant == _LIVELOCK_EXPECTED[mutation]
+    assert len(result.lasso.cycle) >= 1
+    for step in result.lasso.stem + result.lasso.cycle:
+        assert step.actor and step.action and step.detail
+    text = traces.render_lasso(result.lasso)
+    assert "cycle (repeats forever" in text and "LIVELOCK:" in text
+    assert f"`{_LIVELOCK_EXPECTED[mutation]}`" in text
+
+
+def _replay_lasso(lasso, cfg):
+    """Re-run the rendered steps through the model in canonical space;
+    returns (state reached by the stem, state reached after one lap)."""
+    fleet_model = FleetModel(cfg)
+
+    def advance(cur, step):
+        targets = {
+            _canonical(succ, cfg)
+            for s, succ, _v in fleet_model.successors(cur)
+            if (s.actor, s.action, s.detail)
+            == (step.actor, step.action, step.detail)}
+        assert len(targets) == 1, (step, targets)
+        return targets.pop()
+
+    cur = _canonical(fleet_model.initial(), cfg)
+    for step in lasso.stem:
+        cur = advance(cur, step)
+    entry = cur
+    for step in lasso.cycle:
+        cur = advance(cur, step)
+    return entry, cur
+
+
+@pytest.mark.parametrize("mutation", LIVELOCK_MUTATIONS)
+def test_lasso_is_replayable_and_closes(mutation):
+    """The satellite pin: a rendered lasso is not prose — re-running its
+    steps through the model reaches the cycle entry and one lap returns
+    EXACTLY there (stable under the worker-symmetry canonicalization the
+    exploration runs in: every step resolves to one canonical state)."""
+    cfg = CheckConfig(mutations=frozenset({mutation}),
+                      **_LIVELOCK_KW[mutation])
+    result = check_liveness(cfg)
+    entry, back = _replay_lasso(result.lasso, cfg)
+    assert back == entry, "the cycle does not close on its entry state"
+
+
+def test_lasso_deterministic_across_runs():
+    cfg = CheckConfig(mutations=frozenset({"zero_cooldown_flap"}),
+                      **_LIVELOCK_KW["zero_cooldown_flap"])
+    a, b = check_liveness(cfg).lasso, check_liveness(cfg).lasso
+    assert a == b
+
+
+def test_liveness_budget_exhaustion_is_honest():
+    result = check_liveness(CheckConfig(max_states=200))
+    assert not result.ok and result.budget_exhausted
+    assert result.lasso is None
+    report = traces.render_liveness(result, CheckConfig())
+    assert "BUDGET EXHAUSTED" in report
+
+
+# ---------------------------------------------------------------------------
 # 2. spec <-> checker <-> code three-way pin
 # ---------------------------------------------------------------------------
 
@@ -206,6 +347,8 @@ def test_invariant_catalog_and_mutations_documented():
     doc = open(os.path.join(REPO, "docs", "static_analysis.md")).read()
     for inv in INVARIANTS:
         assert inv in doc, f"invariant {inv} missing from docs"
+    for inv in EVENTUALLY_INVARIANTS:
+        assert inv in doc, f"eventually-invariant {inv} missing from docs"
     for m in MUTATIONS:
         assert m in doc, f"mutation {m} missing from docs"
 
@@ -356,6 +499,26 @@ def test_counterexample_rides_sarif_as_fc504():
     assert "no_self_expiry" in res["message"]["text"]
 
 
+def test_lasso_rides_sarif_as_fc504():
+    """Liveness counterexamples ride the SAME FC504 rail as safety ones:
+    the lasso finding names the invariant, carries stem AND cycle, and
+    the document validates."""
+    cfg = CheckConfig(mutations=frozenset({"zero_cooldown_flap"}),
+                      **_LIVELOCK_KW["zero_cooldown_flap"])
+    result = check_liveness(cfg)
+    finding = traces.lasso_to_finding(result.lasso)
+    assert finding.rule == "FC504"
+    assert finding.path == "fleet/autoscale/controller.py"
+    assert "autoscale_eventually_stabilizes" in finding.message
+    assert "stem:" in finding.message
+    assert "cycle (repeats forever):" in finding.message
+    doc = sarif.build([finding], suppressed=0, n_files=0)
+    assert sarif.validate(doc) == []
+    res, = doc["runs"][0]["results"]
+    assert res["ruleId"] == "FC504"
+    assert "lasso" in res["message"]["text"]
+
+
 # ---------------------------------------------------------------------------
 # 4. CLI
 # ---------------------------------------------------------------------------
@@ -404,6 +567,51 @@ def test_cli_model_budget_exit_code(capsys):
     from fraud_detection_tpu.analysis.__main__ import main
 
     assert main(["model", "--max-states", "150"]) == 2
+    assert "BUDGET EXHAUSTED" in capsys.readouterr().out
+
+
+def test_cli_model_liveness_clean(tmp_path, capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    assert main(["model", "--liveness", "--json", "--workers", "2",
+                 "--partitions", "2", "--keys", "1",
+                 "--max-crashes", "0", "--max-lapses", "0"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True and payload["liveness"] is True
+    assert payload["invariant_violated"] is None
+    assert list(payload["checked"]) == list(EVENTUALLY_INVARIANTS)
+    assert payload["sccs"] > 0
+
+
+def test_cli_model_liveness_mutant_exit_code(tmp_path, capsys):
+    """The ISSUE acceptance pin: the flap mutant exits 1 and the output
+    names `autoscale_eventually_stabilizes` with a rendered stem+cycle
+    (same contract the CI liveness-smoke step greps for)."""
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    trace_file = tmp_path / "lasso.txt"
+    sarif_file = tmp_path / "lasso.sarif"
+    rc = main(["model", "--liveness", "--mutate", "zero_cooldown_flap",
+               "--workers", "2", "--partitions", "2", "--keys", "1",
+               "--max-crashes", "0", "--max-lapses", "0",
+               "--max-scale-ins", "1",
+               "--trace-file", str(trace_file),
+               "--sarif", str(sarif_file)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "autoscale_eventually_stabilizes" in out
+    assert "lasso counterexample" in out
+    assert "cycle (repeats forever" in out
+    assert "lasso counterexample" in trace_file.read_text()
+    doc = json.loads(sarif_file.read_text())
+    assert sarif.validate(doc) == []
+    assert doc["runs"][0]["results"][0]["ruleId"] == "FC504"
+
+
+def test_cli_model_liveness_budget_exit_code(capsys):
+    from fraud_detection_tpu.analysis.__main__ import main
+
+    assert main(["model", "--liveness", "--max-states", "150"]) == 2
     assert "BUDGET EXHAUSTED" in capsys.readouterr().out
 
 

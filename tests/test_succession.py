@@ -133,9 +133,14 @@ def test_in_memory_publish_poll_dedup_and_stats():
     s = bus.stats()
     assert set(s) == {"published", "delivered", "lost",
                       "duplicates_dropped", "reordered",
-                      "stale_snapshots_rejected", "log", "compactions"}
+                      "stale_snapshots_rejected", "log", "compactions",
+                      "journal", "journal_dropped"}
     assert s["published"] == 3 and s["delivered"] == 3
     assert s["duplicates_dropped"] == 2 and s["lost"] == 0
+    # The conformance journal holds every accepted record, delivery
+    # order, duplicates excluded — the `flightcheck conform` input.
+    assert s["journal"] == 3 and s["journal_dropped"] == 0
+    assert bus.export_trace() == [r.as_dict() for r in recs]
 
 
 def test_replay_picks_newest_term_snapshot_and_rejects_stale():
@@ -306,7 +311,7 @@ def test_crash_failover_reconstructs_state_and_inherits_holds():
     assert sc.term == 2 and sc.leader_id == "c1"
     report = sc.succession_report()
     assert set(report) == {"term", "leader", "candidates", "elections",
-                           "handoffs", "control"}
+                           "handoffs", "control", "trace"}
     (handoff,) = report["handoffs"]
     assert handoff["mode"] == "crash" and handoff["to"] == "c1"
     assert handoff["failover_s"] >= 1.0     # paid the detection delay
